@@ -1,0 +1,424 @@
+"""Debug & testing API.
+
+Parity: reference ``python/pathway/debug/__init__.py`` — ``table_from_markdown`` (``:429``),
+``table_from_pandas`` (``:343``), ``compute_and_print`` (``:207``),
+``compute_and_print_update_stream`` (``:235``), ``table_to_pandas``, ``StreamGenerator``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pathway_tpu.engine.columnar import Delta
+from pathway_tpu.engine.datasource import StaticDataSource, StreamingDataSource
+from pathway_tpu.engine.runner import GraphRunner
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import Pointer, pointer_from, sequential_keys
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+_SPECIAL_COLUMNS = {"__time__", "__diff__"}
+
+
+def _parse_value(token: str) -> Any:
+    token = token.strip()
+    if token in ("", "None"):
+        return None
+    if token == "True":
+        return True
+    if token == "False":
+        return False
+    try:
+        return int(token)
+    except ValueError:
+        pass
+    try:
+        return float(token)
+    except ValueError:
+        pass
+    return token
+
+
+def table_from_markdown(
+    table_def: str,
+    *,
+    id_from: list[str] | None = None,
+    schema: Any = None,
+    unsafe_trusted_ids: bool = False,
+    split_on_whitespace_only: bool = False,
+) -> Table:
+    """Build a static table from a markdown-ish definition (reference ``debug:429``).
+
+    Supports an optional unnamed leading id column and ``__time__``/``__diff__`` columns for
+    simulating update streams.
+    """
+    lines = [l for l in table_def.strip().splitlines() if l.strip() and not set(l.strip()) <= {"-", "|", " "}]
+    if not lines:
+        raise ValueError("empty table definition")
+    if split_on_whitespace_only:
+        header = re.split(r"\s+", lines[0].strip())
+        rows_raw = [re.split(r"\s+", l.strip()) for l in lines[1:]]
+    else:
+        header = [h.strip() for h in lines[0].split("|")]
+        rows_raw = [[c for c in l.split("|")] for l in lines[1:]]
+
+    has_id_col = header[0] == ""
+    if has_id_col:
+        header = header[1:]
+    names = [h for h in header]
+
+    rows: List[dict] = []
+    keys: List[Pointer] = []
+    times: List[int] = []
+    diffs: List[int] = []
+    for cells in rows_raw:
+        cells = [c.strip() for c in cells]
+        if has_id_col:
+            row_id, cells = cells[0], cells[1:]
+            keys.append(pointer_from(row_id, "mkdtable"))
+        if len(cells) != len(names):
+            raise ValueError(f"row {cells!r} does not match header {names!r}")
+        row = {}
+        t, d = 0, 1
+        for name, cell in zip(names, cells):
+            value = _parse_value(cell)
+            if name == "__time__":
+                t = int(value)
+            elif name == "__diff__":
+                d = int(value)
+            else:
+                row[name] = value
+        rows.append(row)
+        times.append(t)
+        diffs.append(d)
+
+    data_names = [n for n in names if n not in _SPECIAL_COLUMNS]
+    if schema is not None:
+        schema_cls = schema
+        for row in rows:
+            for name, col in schema_cls.columns().items():
+                if name in row and row[name] is not None:
+                    row[name] = _coerce_to(row[name], col.dtype)
+        pk = schema_cls.primary_key_columns()
+        if pk:
+            keys = [pointer_from(*(row[c] for c in pk)) for row in rows]
+    else:
+        schema_cls = _infer_schema(rows, data_names)
+        if id_from:
+            keys = [pointer_from(*(row[c] for c in id_from)) for row in rows]
+
+    streaming = any(n in _SPECIAL_COLUMNS for n in names)
+    if streaming:
+        source: Any = _TimedSource(rows, keys if keys else None, times, diffs)
+    else:
+        key_arr = None
+        if keys:
+            from pathway_tpu.internals.keys import pointers_to_keys
+
+            key_arr = pointers_to_keys(keys)
+        source = StaticDataSource(rows, keys=key_arr)
+    node = G.add_node(pg.InputNode(source=source, streaming=False))
+    return Table(node, schema_cls, name="markdown")
+
+
+# convenient aliases matching the reference API
+table_from_markdown.__doc__ = (table_from_markdown.__doc__ or "") + "\n(reference debug/__init__.py:429)"
+
+
+def _coerce_to(value: Any, dtype: dt.DType) -> Any:
+    base = dtype.strip_optional()
+    try:
+        if base == dt.INT:
+            return int(value)
+        if base == dt.FLOAT:
+            return float(value)
+        if base == dt.STR:
+            return str(value)
+        if base == dt.BOOL:
+            if isinstance(value, bool):
+                return value
+            return value == "True"
+    except (TypeError, ValueError):
+        pass
+    return value
+
+
+def _infer_schema(rows: List[dict], names: List[str]) -> sch.SchemaMetaclass:
+    columns: Dict[str, sch.ColumnSchema] = {}
+    for name in names:
+        values = [row.get(name) for row in rows]
+        non_null = [v for v in values if v is not None]
+        if not non_null:
+            dtype: dt.DType = dt.NONE
+        elif all(isinstance(v, bool) for v in non_null):
+            dtype = dt.BOOL
+        elif all(isinstance(v, int) and not isinstance(v, bool) for v in non_null):
+            dtype = dt.INT
+        elif all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in non_null):
+            dtype = dt.FLOAT
+        elif all(isinstance(v, str) for v in non_null):
+            dtype = dt.STR
+        else:
+            dtype = dt.ANY
+        if any(v is None for v in values) and dtype not in (dt.NONE, dt.ANY):
+            dtype = dt.Optional_(dtype)
+        columns[name] = sch.ColumnSchema(name, dtype)
+    return sch.schema_from_columns(columns, "markdown")
+
+
+class _TimedSource(StaticDataSource):
+    """Rows released per __time__ value, with __diff__ signs — update-stream simulation."""
+
+    def __init__(self, rows: List[dict], keys: List[Pointer] | None, times: List[int], diffs: List[int]):
+        super().__init__(rows)
+        self._times = times
+        self._diffs = diffs
+        self._pointers = keys
+        self._schedule = sorted(set(times))
+        self._pos = 0
+        self._occurrences: dict = {}
+
+    def on_start(self) -> None:
+        self._pos = 0
+        self._done = False
+        self._occurrences = {}
+
+    def next_batch(self, column_names: List[str]) -> Delta:
+        from pathway_tpu.internals.keys import pointers_to_keys
+
+        if self._pos >= len(self._schedule):
+            self._done = True
+            return Delta.empty(column_names)
+        t = self._schedule[self._pos]
+        self._pos += 1
+        if self._pos >= len(self._schedule):
+            self._done = True
+        idx = [i for i, ti in enumerate(self._times) if ti == t]
+        n = len(idx)
+        columns = {}
+        for name in column_names:
+            col = np.empty(n, dtype=object)
+            for j, i in enumerate(idx):
+                col[j] = self._rows[i].get(name)
+            from pathway_tpu.engine.expression_evaluator import _tidy
+
+            columns[name] = _tidy(col)
+        if self._pointers:
+            keys = pointers_to_keys([self._pointers[i] for i in idx])
+        else:
+            # value-derived keys so a later __diff__=-1 row retracts its matching insert;
+            # occurrence counters pair duplicate rows LIFO
+            from pathway_tpu.internals.keys import pointers_to_keys as _ptk
+
+            ptrs = []
+            for i in idx:
+                token = tuple(sorted(self._rows[i].items()))
+                if self._diffs[i] > 0:
+                    occ = self._occurrences.get(token, 0)
+                    self._occurrences[token] = occ + 1
+                else:
+                    occ = self._occurrences.get(token, 1) - 1
+                    self._occurrences[token] = occ
+                ptrs.append(pointer_from(repr(token), occ, "timedrow"))
+            keys = _ptk(ptrs)
+        diffs = np.array([self._diffs[i] for i in idx], dtype=np.int64)
+        return Delta(keys, diffs, columns)
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+def table_from_rows(
+    schema: sch.SchemaMetaclass,
+    rows: list[tuple],
+    unsafe_trusted_ids: bool = False,
+    is_stream: bool = False,
+) -> Table:
+    names = schema.column_names()
+    dict_rows = []
+    for row in rows:
+        if is_stream:
+            *values, t, d = row
+            r = dict(zip(names, values))
+            r["__time__"], r["__diff__"] = t, d
+        else:
+            r = dict(zip(names, row))
+        dict_rows.append(r)
+    pk = schema.primary_key_columns()
+    keys = [pointer_from(*(r[c] for c in pk)) for r in dict_rows] if pk else None
+    if is_stream:
+        source: Any = _TimedSource(
+            [{k: v for k, v in r.items() if k not in _SPECIAL_COLUMNS} for r in dict_rows],
+            keys,
+            [r["__time__"] for r in dict_rows],
+            [r["__diff__"] for r in dict_rows],
+        )
+    else:
+        key_arr = None
+        if keys:
+            from pathway_tpu.internals.keys import pointers_to_keys
+
+            key_arr = pointers_to_keys(keys)
+        source = StaticDataSource(dict_rows, keys=key_arr)
+    node = G.add_node(pg.InputNode(source=source))
+    return Table(node, schema, name="rows")
+
+
+def table_from_pandas(
+    df: Any, *, id_from: list[str] | None = None, unsafe_trusted_ids: bool = False, schema: Any = None
+) -> Table:
+    rows = []
+    for _, prow in df.iterrows():
+        row = {}
+        for col in df.columns:
+            v = prow[col]
+            if isinstance(v, np.integer):
+                v = int(v)
+            elif isinstance(v, np.floating):
+                v = float(v)
+            elif isinstance(v, np.bool_):
+                v = bool(v)
+            row[str(col)] = v
+        rows.append(row)
+    schema_cls = schema if schema is not None else sch.schema_from_pandas(df, id_from=id_from)
+    keys = None
+    if id_from:
+        from pathway_tpu.internals.keys import pointers_to_keys
+
+        keys = pointers_to_keys([pointer_from(*(r[c] for c in id_from)) for r in rows])
+    elif df.index is not None and not df.index.equals(type(df.index)(range(len(df)))):
+        from pathway_tpu.internals.keys import pointers_to_keys
+
+        keys = pointers_to_keys([pointer_from(i, "pandas") for i in df.index])
+    source = StaticDataSource(rows, keys=keys)
+    node = G.add_node(pg.InputNode(source=source))
+    return Table(node, schema_cls, name="pandas")
+
+
+def _capture_table(table: Table) -> Dict[bytes, dict]:
+    """Run the graph and return the table's final rows keyed by key bytes."""
+    from pathway_tpu.internals.keys import pointers_to_keys
+
+    captured: Dict[bytes, dict] = {}
+
+    def on_change(key: Pointer, row: dict, time: int, is_addition: bool) -> None:
+        kb = pointers_to_keys([key]).tobytes()
+        if is_addition:
+            captured[kb] = {"__key__": key, **row}
+        else:
+            captured.pop(kb, None)
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=on_change))
+    GraphRunner(G).run()
+    return captured
+
+
+def _capture_update_stream(table: Table) -> List[dict]:
+    updates: List[dict] = []
+
+    def on_change(key: Pointer, row: dict, time: int, is_addition: bool) -> None:
+        updates.append({"__key__": key, "__time__": time, "__diff__": 1 if is_addition else -1, **row})
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=on_change))
+    GraphRunner(G).run()
+    return updates
+
+
+def table_to_pandas(table: Table, *, include_id: bool = True) -> Any:
+    import pandas as pd
+
+    captured = _capture_table(table)
+    names = table.column_names()
+    data = {name: [row[name] for row in captured.values()] for name in names}
+    index = [row["__key__"] for row in captured.values()]
+    df = pd.DataFrame(data, index=index, columns=names)
+    return df
+
+
+def compute_and_print(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: int | None = None,
+    squash_updates: bool = True,
+    terminate_on_error: bool = True,
+) -> None:
+    captured = _capture_table(table)
+    names = table.column_names()
+    rows = sorted(captured.values(), key=lambda r: r["__key__"])
+    if n_rows is not None:
+        rows = rows[:n_rows]
+    header = ([""] if include_id else []) + names
+    print(" | ".join(header).strip())
+    for row in rows:
+        cells = []
+        if include_id:
+            key = row["__key__"]
+            cells.append(f"^{key.as_int():X}"[:12] + "..." if short_pointers else repr(key))
+        cells.extend(str(row[n]) for n in names)
+        print(" | ".join(cells))
+
+
+def compute_and_print_update_stream(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: int | None = None,
+    terminate_on_error: bool = True,
+) -> None:
+    updates = _capture_update_stream(table)
+    names = table.column_names() + ["__time__", "__diff__"]
+    if n_rows is not None:
+        updates = updates[:n_rows]
+    header = ([""] if include_id else []) + names
+    print(" | ".join(header).strip())
+    for row in updates:
+        cells = []
+        if include_id:
+            key = row["__key__"]
+            cells.append(f"^{key.as_int():X}"[:12] + "..." if short_pointers else repr(key))
+        cells.extend(str(row[n]) for n in names)
+        print(" | ".join(cells))
+
+
+class StreamGenerator:
+    """Scripted multi-worker stream fixture (reference ``debug/__init__.py:496``)."""
+
+    def __init__(self) -> None:
+        self._events: List[tuple] = []
+
+    def table_from_list_of_batches(self, batches: List[List[dict]], schema: sch.SchemaMetaclass) -> Table:
+        rows = []
+        for t, batch in enumerate(batches):
+            for row in batch:
+                r = dict(row)
+                r["__time__"] = t
+                r["__diff__"] = 1
+                rows.append(r)
+        names = schema.column_names()
+        source = _TimedSource(
+            [{k: v for k, v in r.items() if k not in _SPECIAL_COLUMNS} for r in rows],
+            None,
+            [r["__time__"] for r in rows],
+            [r["__diff__"] for r in rows],
+        )
+        node = G.add_node(pg.InputNode(source=source))
+        return Table(node, schema, name="stream_generator")
+
+    def table_from_list_of_batches_by_workers(
+        self, batches: Dict[int, List[List[dict]]], schema: sch.SchemaMetaclass
+    ) -> Table:
+        merged: List[List[dict]] = []
+        for worker_batches in batches.values():
+            for t, batch in enumerate(worker_batches):
+                while len(merged) <= t:
+                    merged.append([])
+                merged[t].extend(batch)
+        return self.table_from_list_of_batches(merged, schema)

@@ -1,0 +1,88 @@
+"""Synthetic demo streams (parity: reference ``demo/__init__.py`` — ``generate_custom_stream``
+``:28``, ``noisy_linear_stream``, ``range_stream``)."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Dict
+
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.io.python import ConnectorSubject, read
+
+
+def generate_custom_stream(
+    value_generators: Dict[str, Callable[[int], Any]],
+    *,
+    schema: sch.SchemaMetaclass,
+    nb_rows: int | None = None,
+    input_rate: float = 1.0,
+    autocommit_duration_ms: int = 100,
+    name: str = "demo",
+) -> Any:
+    class _Subject(ConnectorSubject):
+        def run(self) -> None:
+            i = 0
+            while nb_rows is None or i < nb_rows:
+                row = {name_: gen(i) for name_, gen in value_generators.items()}
+                self.next(**row)
+                i += 1
+                if input_rate and nb_rows is None or (nb_rows and nb_rows > 100):
+                    time.sleep(1.0 / input_rate if input_rate else 0)
+
+    return read(_Subject(), schema=schema, autocommit_duration_ms=autocommit_duration_ms, name=name)
+
+
+def noisy_linear_stream(nb_rows: int = 10, input_rate: float = 1.0) -> Any:
+    schema = sch.schema_from_types(x=float, y=float)
+    rng = random.Random(0)
+    return generate_custom_stream(
+        {
+            "x": lambda i: float(i),
+            "y": lambda i: float(i) + (2 * rng.random() - 1) / 10,
+        },
+        schema=schema,
+        nb_rows=nb_rows,
+        input_rate=input_rate,
+    )
+
+
+def range_stream(
+    nb_rows: int = 30, offset: int = 0, input_rate: float = 1.0, autocommit_duration_ms: int = 100
+) -> Any:
+    schema = sch.schema_from_types(value=int)
+    return generate_custom_stream(
+        {"value": lambda i: i + offset},
+        schema=schema,
+        nb_rows=nb_rows,
+        input_rate=input_rate,
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+
+
+def replay_csv(path: str, *, schema: Any, input_rate: float = 1.0) -> Any:
+    import csv as _csv
+
+    from pathway_tpu.internals import dtype as dt
+
+    class _Subject(ConnectorSubject):
+        def run(self) -> None:
+            dtypes = schema.dtypes()
+            with open(path, newline="") as f:
+                for rec in _csv.DictReader(f):
+                    row = {}
+                    for k, v in rec.items():
+                        if k not in dtypes:
+                            continue
+                        base = dtypes[k].strip_optional()
+                        if base == dt.INT:
+                            row[k] = int(v)
+                        elif base == dt.FLOAT:
+                            row[k] = float(v)
+                        else:
+                            row[k] = v
+                    self.next(**row)
+                    if input_rate:
+                        time.sleep(1.0 / input_rate)
+
+    return read(_Subject(), schema=schema)

@@ -1,0 +1,1 @@
+"""TPU-native incremental engine: columnar state, delta propagation, JAX kernels."""

@@ -1,0 +1,271 @@
+"""Columnar keyed state + update-stream deltas — the engine's data plane.
+
+This replaces the reference's differential-dataflow arrangements (``src/engine/dataflow.rs``
+``Column``/``Table`` over DD collections) with a batch-incremental columnar design: a table's
+materialized state is struct-of-arrays keyed by 128-bit keys; each commit moves a ``Delta``
+(keys, +1/-1 diffs, column values) through the operator graph. Dense numeric columns promote to
+jax arrays on the TPU for kernel work; boxed columns stay host-side numpy object arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.keys import KEY_DTYPE, Pointer, keys_to_pointers
+
+
+class Error:
+    """Singleton poisoned value (reference ``Value::Error``, ``value.rs:207``)."""
+
+    _instance: "Error | None" = None
+
+    def __new__(cls) -> "Error":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Error"
+
+
+ERROR = Error()
+
+
+def empty_keys() -> np.ndarray:
+    return np.empty(0, dtype=KEY_DTYPE)
+
+
+def objarray(values: Sequence[Any]) -> np.ndarray:
+    """1-D object array; safe for ndarray-valued cells (``np.array(list, dtype=object)``
+    would silently build a 2-D array when elements are equal-length ndarrays)."""
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def empty_column(dtype: dt.DType) -> np.ndarray:
+    return np.empty(0, dtype=dtype.np_dtype)
+
+
+@dataclass
+class Delta:
+    """A batch of row updates: parallel arrays of key, diff (+1 insert / -1 retract), values.
+
+    Retraction rows carry the values being retracted so downstream stateful operators
+    (groupby, joins) can subtract without a lookup.
+    """
+
+    keys: np.ndarray  # (n,) KEY_DTYPE
+    diffs: np.ndarray  # (n,) int64 in {+1, -1}
+    columns: Dict[str, np.ndarray]  # each (n,)
+
+    def __post_init__(self) -> None:
+        n = len(self.keys)
+        assert len(self.diffs) == n
+        for name, col in self.columns.items():
+            assert len(col) == n, f"column {name!r} length {len(col)} != {n}"
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @property
+    def column_names(self) -> list[str]:
+        return list(self.columns)
+
+    @staticmethod
+    def empty(column_names: Iterable[str]) -> "Delta":
+        return Delta(
+            keys=empty_keys(),
+            diffs=np.empty(0, dtype=np.int64),
+            columns={name: np.empty(0, dtype=object) for name in column_names},
+        )
+
+    def select(self, mask: np.ndarray) -> "Delta":
+        return Delta(
+            keys=self.keys[mask],
+            diffs=self.diffs[mask],
+            columns={name: col[mask] for name, col in self.columns.items()},
+        )
+
+    def with_columns(self, columns: Dict[str, np.ndarray]) -> "Delta":
+        return Delta(keys=self.keys, diffs=self.diffs, columns=columns)
+
+    def negated(self) -> "Delta":
+        return Delta(keys=self.keys, diffs=-self.diffs, columns=self.columns)
+
+    @staticmethod
+    def concat(deltas: Sequence["Delta"], column_names: Sequence[str]) -> "Delta":
+        deltas = [d for d in deltas if len(d)]
+        if not deltas:
+            return Delta.empty(column_names)
+        if len(deltas) == 1:
+            d = deltas[0]
+            return Delta(d.keys, d.diffs, {n: d.columns[n] for n in column_names})
+        keys = np.concatenate([d.keys for d in deltas])
+        diffs = np.concatenate([d.diffs for d in deltas])
+        columns = {}
+        for name in column_names:
+            parts = [d.columns[name] for d in deltas]
+            if any(p.dtype == object for p in parts):
+                merged = np.empty(sum(len(p) for p in parts), dtype=object)
+                offset = 0
+                for p in parts:
+                    merged[offset : offset + len(p)] = p
+                    offset += len(p)
+                columns[name] = merged
+            else:
+                columns[name] = np.concatenate(parts)
+        return Delta(keys, diffs, columns)
+
+    def consolidated(self) -> "Delta":
+        """Cancel matching (+1, -1) rows with identical key+values within the batch."""
+        if len(self) == 0:
+            return self
+        sig: Dict[Any, int] = {}
+        net: list[int] = []
+        order: list[tuple] = []
+        for i in range(len(self)):
+            token = (self.keys[i].tobytes(), _row_token(self.columns, i))
+            if token in sig:
+                net[sig[token]] += int(self.diffs[i])
+            else:
+                sig[token] = len(net)
+                net.append(int(self.diffs[i]))
+                order.append((i, token))
+        keep_rows = []
+        keep_diffs = []
+        for (i, token) in order:
+            d = net[sig[token]]
+            if d != 0:
+                keep_rows.append(i)
+                keep_diffs.append(d)
+        if len(keep_rows) == len(self) and all(
+            d == int(self.diffs[i]) for d, i in zip(keep_diffs, keep_rows)
+        ):
+            return self
+        idx = np.array(keep_rows, dtype=np.int64)
+        out = self.select(idx)
+        out.diffs = np.array(keep_diffs, dtype=np.int64)
+        # expand |diff|>1 into repeated unit rows to preserve row-per-key invariants downstream
+        if np.any(np.abs(out.diffs) > 1):
+            reps = np.abs(out.diffs).astype(np.int64)
+            signs = np.sign(out.diffs)
+            idx2 = np.repeat(np.arange(len(out.diffs)), reps)
+            out = Delta(
+                keys=out.keys[idx2],
+                diffs=np.repeat(signs, reps),
+                columns={n: c[idx2] for n, c in out.columns.items()},
+            )
+        return out
+
+
+def _hashable_value(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return ("__nd__", str(v.dtype), v.shape, v.tobytes())
+    if isinstance(v, (tuple, list)):
+        return tuple(_hashable_value(x) for x in v)
+    return v
+
+
+def _row_token(columns: Mapping[str, np.ndarray], i: int) -> tuple:
+    return tuple((name, _hashable_value(columns[name][i])) for name in sorted(columns))
+
+
+class StateTable:
+    """Materialized keyed state: the arrangement replacement.
+
+    Maintains insertion-ordered dense arrays with a hash index key->slot and a free list.
+    ``apply`` ingests a Delta (retractions then insertions).
+    """
+
+    def __init__(self, column_names: Sequence[str]):
+        self.column_names = list(column_names)
+        self._capacity = 0
+        self._keys = empty_keys()
+        self._columns: Dict[str, np.ndarray] = {
+            name: np.empty(0, dtype=object) for name in self.column_names
+        }
+        self._valid = np.empty(0, dtype=bool)
+        self._index: Dict[bytes, int] = {}
+        self._free: list[int] = []
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def _grow(self, needed: int) -> None:
+        new_cap = max(16, self._capacity * 2, self._capacity + needed)
+        keys = np.zeros(new_cap, dtype=KEY_DTYPE)
+        keys[: self._capacity] = self._keys
+        self._keys = keys
+        valid = np.zeros(new_cap, dtype=bool)
+        valid[: self._capacity] = self._valid
+        self._valid = valid
+        for name in self.column_names:
+            col = np.empty(new_cap, dtype=object)
+            col[: self._capacity] = self._columns[name]
+            self._columns[name] = col
+        self._free.extend(range(self._capacity, new_cap))
+        self._capacity = new_cap
+
+    def apply(self, delta: Delta) -> None:
+        retract = delta.diffs < 0
+        for i in np.nonzero(retract)[0]:
+            kb = delta.keys[i].tobytes()
+            slot = self._index.pop(kb, None)
+            if slot is None:
+                raise KeyError(f"retraction of absent key {delta.keys[i]!r}")
+            self._valid[slot] = False
+            for name in self.column_names:
+                self._columns[name][slot] = None
+            self._free.append(slot)
+        insert_rows = np.nonzero(~retract)[0]
+        if len(insert_rows) > len(self._free):
+            self._grow(len(insert_rows) - len(self._free))
+        for i in insert_rows:
+            kb = delta.keys[i].tobytes()
+            if kb in self._index:
+                raise KeyError(f"duplicate key {keys_to_pointers(delta.keys[i:i+1])[0]!r}")
+            slot = self._free.pop()
+            self._index[kb] = slot
+            self._keys[slot] = delta.keys[i]
+            self._valid[slot] = True
+            for name in self.column_names:
+                self._columns[name][slot] = delta.columns[name][i]
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Row slots for keys; -1 when absent."""
+        out = np.empty(len(keys), dtype=np.int64)
+        for i in range(len(keys)):
+            out[i] = self._index.get(keys[i].tobytes(), -1)
+        return out
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        return self.lookup(keys) >= 0
+
+    def snapshot(self) -> Delta:
+        """Current state as an insertion Delta (used for late subscribers / joins)."""
+        slots = np.nonzero(self._valid)[0]
+        return Delta(
+            keys=self._keys[slots].copy(),
+            diffs=np.ones(len(slots), dtype=np.int64),
+            columns={name: self._columns[name][slots].copy() for name in self.column_names},
+        )
+
+    def get_row(self, key_b: bytes) -> dict[str, Any] | None:
+        slot = self._index.get(key_b)
+        if slot is None:
+            return None
+        return {name: self._columns[name][slot] for name in self.column_names}
+
+    def column(self, name: str) -> np.ndarray:
+        slots = np.nonzero(self._valid)[0]
+        return self._columns[name][slots]
+
+    def keys(self) -> np.ndarray:
+        slots = np.nonzero(self._valid)[0]
+        return self._keys[slots].copy()

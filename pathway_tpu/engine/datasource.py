@@ -1,0 +1,156 @@
+"""Input sources feeding the commit loop.
+
+Parity: reference connector framework (``src/connectors/mod.rs`` — input thread + poller +
+commit ticks). Host-side by design: TPU engines keep IO on the host CPU and ship batched
+columns to the device.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as time_mod
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine.columnar import Delta
+from pathway_tpu.internals.keys import KEY_DTYPE, Pointer, keys_from_values, pointers_to_keys, sequential_keys
+
+
+class DataSource:
+    """One input's event feed; ``next_batch`` is called once per commit."""
+
+    def next_batch(self, column_names: List[str]) -> Delta:
+        raise NotImplementedError
+
+    def is_finished(self) -> bool:
+        raise NotImplementedError
+
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+
+class StaticDataSource(DataSource):
+    """All rows present at time 0 (batch mode)."""
+
+    def __init__(self, rows: List[tuple], keys: np.ndarray | None = None, column_names: List[str] | None = None):
+        # rows: list of dicts column->value OR tuples following column_names
+        self._rows = rows
+        self._keys = keys
+        self._column_names = column_names
+        self._done = False
+
+    def on_start(self) -> None:
+        # a fresh GraphRunner re-runs the whole graph (debug captures, repeated pw.run)
+        self._done = False
+
+    def next_batch(self, column_names: List[str]) -> Delta:
+        if self._done:
+            return Delta.empty(column_names)
+        self._done = True
+        n = len(self._rows)
+        columns: Dict[str, np.ndarray] = {}
+        for name in column_names:
+            col = np.empty(n, dtype=object)
+            for i, row in enumerate(self._rows):
+                col[i] = row[name] if isinstance(row, dict) else row[self._column_names.index(name)]
+            columns[name] = _tidy_col(col)
+        if self._keys is None:
+            keys = sequential_keys(0, n)
+        else:
+            keys = self._keys
+        return Delta(keys, np.ones(n, dtype=np.int64), columns)
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class StreamingDataSource(DataSource):
+    """Queue-fed source; a producer thread pushes (key, row, diff) events.
+
+    Mirrors the reference's per-connector input thread + mpsc channel + poller drain
+    (``connectors/mod.rs:461-529``).
+    """
+
+    _MAX_EVENTS_PER_COMMIT = 100_000  # reference drains <=100k entries/iteration
+
+    def __init__(self, subject: Any = None, autocommit_ms: float | None = None):
+        self.events: "queue.Queue[tuple]" = queue.Queue()
+        self._finished = threading.Event()
+        self._started = False
+        self.subject = subject
+        self._thread: threading.Thread | None = None
+        self._autocommit_ms = autocommit_ms
+        self._seq = 0
+
+    # producer API ----------------------------------------------------------
+
+    def push(self, values: dict, key: Pointer | None = None, diff: int = 1) -> None:
+        self.events.put(("data", key, values, diff))
+
+    def close(self) -> None:
+        self.events.put(("eof",))
+
+    # engine API ------------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self.subject is not None and not self._started:
+            self._started = True
+
+            def runner() -> None:
+                try:
+                    self.subject.run(self)
+                finally:
+                    self.close()
+
+            self._thread = threading.Thread(target=runner, daemon=True, name="pathway:connector")
+            self._thread.start()
+
+    def next_batch(self, column_names: List[str]) -> Delta:
+        rows: List[tuple] = []
+        deadline = time_mod.monotonic() + (self._autocommit_ms or 10) / 1000.0
+        while len(rows) < self._MAX_EVENTS_PER_COMMIT:
+            timeout = deadline - time_mod.monotonic()
+            try:
+                event = self.events.get(timeout=max(timeout, 0.001))
+            except queue.Empty:
+                break
+            if event[0] == "eof":
+                self._finished.set()
+                break
+            _, key, values, diff = event
+            rows.append((key, values, diff))
+            if time_mod.monotonic() > deadline and rows:
+                break
+        if not rows:
+            return Delta.empty(column_names)
+        n = len(rows)
+        keys = np.empty(n, dtype=KEY_DTYPE)
+        for i, (key, values, diff) in enumerate(rows):
+            if key is None:
+                key_arr = sequential_keys(self._seq, 1)
+                self._seq += 1
+                keys[i] = key_arr[0]
+            else:
+                keys[i] = pointers_to_keys([key])[0]
+        diffs = np.array([r[2] for r in rows], dtype=np.int64)
+        columns = {}
+        for name in column_names:
+            col = np.empty(n, dtype=object)
+            for i, (_, values, _) in enumerate(rows):
+                col[i] = values.get(name)
+            columns[name] = _tidy_col(col)
+        return Delta(keys, diffs, columns)
+
+    def is_finished(self) -> bool:
+        return self._finished.is_set() and self.events.empty()
+
+
+def _tidy_col(col: np.ndarray) -> np.ndarray:
+    from pathway_tpu.engine.expression_evaluator import _tidy
+
+    return _tidy(col)

@@ -1,0 +1,1105 @@
+"""Incremental operator evaluators — the differential-dataflow replacement.
+
+Each parse-graph node kind gets an evaluator that consumes input ``Delta`` batches and emits an
+output ``Delta`` per commit, maintaining whatever keyed state incrementality requires. This
+mirrors the reference's DD operator implementations in ``src/engine/dataflow.rs`` (joins,
+groupby, ix, concat, flatten, sort via prev/next) at batch granularity. Dense numeric work
+inside a batch (expression trees, reducer sums, KNN search) is delegated to vectorized
+numpy/JAX kernels.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from pathway_tpu.engine import expression_evaluator as ee
+from pathway_tpu.engine.columnar import ERROR, Delta, Error, StateTable, empty_keys, objarray
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.keys import (
+    KEY_DTYPE,
+    Pointer,
+    keys_to_pointers,
+    pointer_from,
+    pointers_to_keys,
+)
+from pathway_tpu.internals.reducers import _IdMarker, _SeqMarker
+
+
+class Evaluator:
+    def __init__(self, node: pg.Node, runner: Any):
+        self.node = node
+        self.runner = runner
+        self.output_columns: List[str] = (
+            node.output.column_names() if node.output is not None else []
+        )
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        raise NotImplementedError
+
+    # -- helpers ------------------------------------------------------------
+
+    def _resolver_for(self, table: Any, delta: Delta) -> Callable[[expr.ColumnReference], np.ndarray]:
+        """Resolve column refs against a delta of ``table``; cross-table refs hit state."""
+
+        def resolver(ref: expr.ColumnReference) -> np.ndarray:
+            if ref.table is table:
+                if ref.name == "id":
+                    out = np.empty(len(delta), dtype=object)
+                    out[:] = keys_to_pointers(delta.keys)
+                    return out
+                return delta.columns[ref.name]
+            # cross-table reference: same-universe lookup by key in materialized state
+            state = self.runner.state_of(ref.table._node)
+            if ref.name == "id":
+                out = np.empty(len(delta), dtype=object)
+                out[:] = keys_to_pointers(delta.keys)
+                return out
+            out = np.empty(len(delta), dtype=object)
+            for i in range(len(delta)):
+                row = state.get_row(delta.keys[i].tobytes())
+                out[i] = None if row is None else row[ref.name]
+            return ee._tidy(out)
+
+        return resolver
+
+    def _eval_exprs(
+        self, exprs: Dict[str, expr.ColumnExpression], table: Any, delta: Delta
+    ) -> Dict[str, np.ndarray]:
+        resolver = self._resolver_for(table, delta)
+        return {
+            name: ee.evaluate(e, len(delta), resolver, keys=delta.keys)
+            for name, e in exprs.items()
+        }
+
+
+class InputEvaluator(Evaluator):
+    """Source node: pulls batches from its DataSource each commit."""
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        source = self.node.config["source"]
+        return source.next_batch(self.output_columns)
+
+
+class RowwiseEvaluator(Evaluator):
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        table = self.node.inputs[0]
+        columns = self._eval_exprs(self.node.config["exprs"], table, delta)
+        return Delta(delta.keys, delta.diffs, columns)
+
+
+class FilterEvaluator(Evaluator):
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        table = self.node.inputs[0]
+        resolver = self._resolver_for(table, delta)
+        mask = ee.evaluate(self.node.config["expression"], len(delta), resolver)
+        if mask.dtype == object:
+            mask = np.frompyfunc(lambda v: bool(v) if not isinstance(v, Error) else False, 1, 1)(
+                mask
+            ).astype(bool)
+        return delta.select(mask.astype(bool))
+
+
+class ReindexEvaluator(Evaluator):
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        table = self.node.inputs[0]
+        resolver = self._resolver_for(table, delta)
+        new_ids = ee.evaluate(self.node.config["expression"], len(delta), resolver)
+        keys = pointers_to_keys(
+            [p if isinstance(p, Pointer) else pointer_from(p) for p in new_ids]
+        )
+        return Delta(keys, delta.diffs, dict(delta.columns))
+
+
+class ConcatEvaluator(Evaluator):
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        reindex = self.node.config.get("reindex", False)
+        parts = []
+        for i, delta in enumerate(input_deltas):
+            if len(delta) == 0:
+                continue
+            if reindex:
+                new_keys = np.empty(len(delta), dtype=KEY_DTYPE)
+                for j in range(len(delta)):
+                    p = pointer_from(Pointer(int(delta.keys[j]["hi"]), int(delta.keys[j]["lo"])), i)
+                    new_keys[j]["hi"], new_keys[j]["lo"] = p.hi, p.lo
+                delta = Delta(new_keys, delta.diffs, delta.columns)
+            parts.append(delta)
+        return Delta.concat(parts, self.output_columns)
+
+
+class GroupbyEvaluator(Evaluator):
+    """Incremental groupby-reduce (reference ``reduce.rs`` + DD reduce)."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.groups: Dict[bytes, Dict[str, Any]] = {}
+        # per output column that is a reducer tree: list of ReducerExpressions inside
+        self.reducer_leaves: List[expr.ReducerExpression] = []
+        self._collect_reducers(node.config["out_exprs"])
+        self.seq = 0
+
+    def _collect_reducers(self, out_exprs: Dict[str, expr.ColumnExpression]) -> None:
+        seen: set[int] = set()
+
+        def walk(e: expr.ColumnExpression) -> None:
+            if isinstance(e, expr.ReducerExpression):
+                if id(e) not in seen:
+                    seen.add(id(e))
+                    self.reducer_leaves.append(e)
+                return
+            for d in e._deps():
+                walk(d)
+
+        for e in out_exprs.values():
+            walk(e)
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        table = self.node.inputs[0]
+        resolver = self._resolver_for(table, delta)
+        n = len(delta)
+
+        grouping_vals = [
+            ee.evaluate(g, n, resolver) for g in self.node.config["grouping"]
+        ]
+        set_id = self.node.config.get("set_id", False)
+
+        # reducer argument values per leaf
+        leaf_args: List[List[np.ndarray]] = []
+        for leaf in self.reducer_leaves:
+            arrays = []
+            for a in leaf._args:
+                if isinstance(a, _IdMarker):
+                    ids = np.empty(n, dtype=object)
+                    ids[:] = keys_to_pointers(delta.keys)
+                    arrays.append(ids)
+                elif isinstance(a, _SeqMarker):
+                    seqs = np.arange(self.seq, self.seq + n, dtype=np.int64)
+                    arrays.append(seqs.astype(object))
+                else:
+                    arrays.append(ee.evaluate(a, n, resolver))
+            leaf_args.append(arrays)
+        self.seq += n
+
+        # group keys
+        group_keys: List[Pointer] = []
+        for i in range(n):
+            gvals = tuple(g[i] for g in grouping_vals)
+            if set_id:
+                gk = gvals[0] if isinstance(gvals[0], Pointer) else pointer_from(*gvals)
+            else:
+                gk = pointer_from(*gvals)
+            group_keys.append(gk)
+
+        touched: Dict[bytes, Pointer] = {}
+        old_rows: Dict[bytes, Optional[dict]] = {}
+
+        for i in range(n):
+            gk = group_keys[i]
+            gb = pointers_to_keys([gk]).tobytes()
+            if gb not in touched:
+                touched[gb] = gk
+                old_rows[gb] = self._current_row(gb)
+            group = self.groups.get(gb)
+            if group is None:
+                group = {
+                    "count": 0,
+                    "gvals": tuple(g[i] for g in grouping_vals),
+                    "accs": [leaf._reducer.make() for leaf in self.reducer_leaves],
+                }
+                self.groups[gb] = group
+            diff = int(delta.diffs[i])
+            vals_per_leaf = [tuple(arr[i] for arr in arrays) for arrays in leaf_args]
+            if diff > 0:
+                group["count"] += 1
+                for acc, vals in zip(group["accs"], vals_per_leaf):
+                    acc.insert(vals)
+            else:
+                group["count"] -= 1
+                for acc, vals in zip(group["accs"], vals_per_leaf):
+                    acc.retract(vals)
+            if group["count"] == 0:
+                del self.groups[gb]
+
+        # emit output deltas for touched groups
+        out_keys: List[Pointer] = []
+        out_diffs: List[int] = []
+        out_rows: List[dict] = []
+        for gb, gk in touched.items():
+            old = old_rows[gb]
+            new = self._current_row(gb)
+            if old == new:
+                continue
+            if old is not None:
+                out_keys.append(gk)
+                out_diffs.append(-1)
+                out_rows.append(old)
+            if new is not None:
+                out_keys.append(gk)
+                out_diffs.append(1)
+                out_rows.append(new)
+        if not out_keys:
+            return Delta.empty(self.output_columns)
+        columns = {
+            name: ee._tidy(objarray([r[name] for r in out_rows]))
+            for name in self.output_columns
+        }
+        return Delta(pointers_to_keys(out_keys), np.array(out_diffs, dtype=np.int64), columns)
+
+    def _current_row(self, gb: bytes) -> Optional[dict]:
+        group = self.groups.get(gb)
+        if group is None:
+            return None
+        leaf_values = {id(leaf): acc.value() for leaf, acc in zip(self.reducer_leaves, group["accs"])}
+        grouping_names = self.node.config["grouping_names"]
+        gval_map = dict(zip(grouping_names, group["gvals"]))
+
+        out = {}
+        for name, e in self.node.config["out_exprs"].items():
+            out[name] = self._eval_out_expr(e, leaf_values, gval_map)
+        return out
+
+    def _eval_out_expr(
+        self, e: expr.ColumnExpression, leaf_values: Dict[int, Any], gval_map: Dict[str, Any]
+    ) -> Any:
+        class _GroupEval(ee.ExpressionEvaluator):
+            def _eval_ReducerExpression(self, re: expr.ReducerExpression) -> np.ndarray:
+                out = np.empty(1, dtype=object)
+                out[0] = leaf_values[id(re)]
+                return out
+
+            def _eval_ColumnReference(self, ref: expr.ColumnReference) -> np.ndarray:
+                out = np.empty(1, dtype=object)
+                out[0] = gval_map[ref.name]
+                return out
+
+        ctx = ee.EvalContext(1, lambda ref: None)
+        result = _GroupEval(ctx).eval(e)
+        return result[0]
+
+
+class DeduplicateEvaluator(Evaluator):
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.current: Dict[bytes, Tuple[np.void, dict, Any]] = {}  # instance -> (key,row,value)
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        table = self.node.inputs[0]
+        resolver = self._resolver_for(table, delta)
+        n = len(delta)
+        value_e = self.node.config.get("value")
+        instance_e = self.node.config.get("instance")
+        acceptor = self.node.config.get("acceptor")
+        values = ee.evaluate(value_e, n, resolver) if value_e is not None else delta.keys
+        instances = (
+            ee.evaluate(instance_e, n, resolver)
+            if instance_e is not None
+            else np.zeros(n, dtype=object)
+        )
+        out_keys, out_diffs, out_rows = [], [], []
+        for i in range(n):
+            if delta.diffs[i] < 0:
+                continue  # append-only semantics (reference deduplicate is streaming-only)
+            inst = instances[i]
+            ib = repr(inst).encode()
+            row = {c: delta.columns[c][i] for c in delta.column_names}
+            val = values[i]
+            cur = self.current.get(ib)
+            if cur is None:
+                accept = True
+            else:
+                accept = bool(acceptor(val, cur[2])) if acceptor is not None else True
+            if not accept:
+                continue
+            ikey = pointer_from(inst if not isinstance(inst, np.void) else int(inst["lo"]), "dedup")
+            if cur is not None:
+                out_keys.append(ikey)
+                out_diffs.append(-1)
+                out_rows.append(cur[1])
+            out_keys.append(ikey)
+            out_diffs.append(1)
+            out_rows.append(row)
+            self.current[ib] = (delta.keys[i], row, val)
+        if not out_keys:
+            return Delta.empty(self.output_columns)
+        columns = {
+            name: ee._tidy(objarray([r[name] for r in out_rows]))
+            for name in self.output_columns
+        }
+        return Delta(pointers_to_keys(out_keys), np.array(out_diffs, dtype=np.int64), columns)
+
+
+class JoinEvaluator(Evaluator):
+    """Symmetric incremental hash join (reference DD join replacement)."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        from pathway_tpu.internals.joins import JoinKind
+
+        self.kind = node.config["kind"]
+        self.JoinKind = JoinKind
+        # jk_bytes -> {row_key_bytes: (Pointer, row_dict)}
+        self.left_map: Dict[bytes, Dict[bytes, tuple]] = defaultdict(dict)
+        self.right_map: Dict[bytes, Dict[bytes, tuple]] = defaultdict(dict)
+
+    def _join_keys(self, side: str, delta: Delta) -> List[bytes]:
+        table = self.node.inputs[0 if side == "left" else 1]
+        exprs = self.node.config["left_on" if side == "left" else "right_on"]
+        resolver = self._resolver_for(table, delta)
+        arrays = [ee.evaluate(e, len(delta), resolver) for e in exprs]
+        out = []
+        for i in range(len(delta)):
+            out.append(pointers_to_keys([pointer_from(*(a[i] for a in arrays))]).tobytes())
+        return out
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        left_delta, right_delta = input_deltas
+        JK = self.JoinKind
+        events: List[tuple] = []  # (diff, lrow|None, rrow|None); row = (Pointer, dict)
+
+        def run_side(delta: Delta, side: str) -> None:
+            if len(delta) == 0:
+                return
+            jks = self._join_keys(side, delta)
+            own_map = self.left_map if side == "left" else self.right_map
+            other_map = self.right_map if side == "left" else self.left_map
+            own_null = self.kind in (
+                (JK.LEFT, JK.OUTER) if side == "left" else (JK.RIGHT, JK.OUTER)
+            )
+            other_null = self.kind in (
+                (JK.RIGHT, JK.OUTER) if side == "left" else (JK.LEFT, JK.OUTER)
+            )
+            ptrs = keys_to_pointers(delta.keys)
+            for i in range(len(delta)):
+                jk = jks[i]
+                kb = delta.keys[i].tobytes()
+                d = int(delta.diffs[i])
+                row = (ptrs[i], {c: delta.columns[c][i] for c in delta.column_names})
+                matches = other_map.get(jk, {})
+                own_before = len(own_map.get(jk, {}))
+                for _, other_row in list(matches.items()):
+                    pair = (row, other_row) if side == "left" else (other_row, row)
+                    events.append((d, pair[0], pair[1]))
+                if own_null and not matches:
+                    pair = (row, None) if side == "left" else (None, row)
+                    events.append((d, pair[0], pair[1]))
+                if other_null and matches:
+                    if d > 0 and own_before == 0:
+                        for _, other_row in list(matches.items()):
+                            pair = (None, other_row) if side == "left" else (other_row, None)
+                            events.append((-1, pair[0], pair[1]))
+                    elif d < 0 and own_before == 1:
+                        for _, other_row in list(matches.items()):
+                            pair = (None, other_row) if side == "left" else (other_row, None)
+                            events.append((1, pair[0], pair[1]))
+                if d > 0:
+                    own_map[jk][kb] = row
+                else:
+                    own_map[jk].pop(kb, None)
+                    if not own_map[jk]:
+                        del own_map[jk]
+
+        run_side(left_delta, "left")
+        run_side(right_delta, "right")
+
+        if not events:
+            return Delta.empty(self.output_columns)
+        return self._emit(events).consolidated()
+
+    def _emit(self, events: List[tuple]) -> Delta:
+        left_table, right_table = self.node.inputs
+        exprs = self.node.config["exprs"]
+        id_expr = self.node.config.get("id_expr")
+        out_keys: List[Pointer] = []
+        out_diffs: List[int] = []
+        rows_cols: Dict[str, list] = {name: [] for name in self.output_columns}
+
+        for diff, lrow, rrow in events:
+            lptr = lrow[0] if lrow else None
+            rptr = rrow[0] if rrow else None
+            if id_expr is not None and lrow is not None:
+                key = self._eval_scalar(id_expr, lrow, rrow)
+            else:
+                key = pointer_from(lptr, rptr, "join")
+            out_keys.append(key)
+            out_diffs.append(diff)
+            for name, e in exprs.items():
+                rows_cols[name].append(self._eval_scalar(e, lrow, rrow))
+
+        columns = {
+            name: ee._tidy(objarray(vals)) for name, vals in rows_cols.items()
+        }
+        return Delta(pointers_to_keys(out_keys), np.array(out_diffs, dtype=np.int64), columns)
+
+    def _eval_scalar(self, e: expr.ColumnExpression, lrow: tuple | None, rrow: tuple | None) -> Any:
+        left_table, right_table = self.node.inputs
+        this = self
+
+        def resolver(ref: expr.ColumnReference) -> np.ndarray:
+            out = np.empty(1, dtype=object)
+            if ref.table is left_table:
+                if ref.name == "id":
+                    out[0] = lrow[0] if lrow else None
+                else:
+                    out[0] = lrow[1][ref.name] if lrow else None
+            elif ref.table is right_table:
+                if ref.name == "id":
+                    out[0] = rrow[0] if rrow else None
+                else:
+                    out[0] = rrow[1][ref.name] if rrow else None
+            else:
+                raise ValueError(f"join select references foreign table: {ref!r}")
+            return out
+
+        return ee.evaluate(e, 1, resolver)[0]
+
+
+class UpdateRowsEvaluator(Evaluator):
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.base = StateTable(self.output_columns)
+        self.patch = StateTable(self.output_columns)
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        base_delta, patch_delta = input_deltas
+        out_keys, out_diffs, out_rows = [], [], []
+
+        for i in range(len(base_delta)):
+            kb = base_delta.keys[i].tobytes()
+            d = int(base_delta.diffs[i])
+            row = {c: base_delta.columns[c][i] for c in self.output_columns}
+            if self.patch.get_row(kb) is None:
+                out_keys.append(base_delta.keys[i])
+                out_diffs.append(d)
+                out_rows.append(row)
+        self.base.apply(base_delta)
+
+        for i in range(len(patch_delta)):
+            kb = patch_delta.keys[i].tobytes()
+            d = int(patch_delta.diffs[i])
+            row = {c: patch_delta.columns[c][i] for c in self.output_columns}
+            base_row = self.base.get_row(kb)
+            if d > 0:
+                if base_row is not None and self.patch.get_row(kb) is None:
+                    out_keys.append(patch_delta.keys[i])
+                    out_diffs.append(-1)
+                    out_rows.append(base_row)
+                out_keys.append(patch_delta.keys[i])
+                out_diffs.append(1)
+                out_rows.append(row)
+            else:
+                out_keys.append(patch_delta.keys[i])
+                out_diffs.append(-1)
+                out_rows.append(row)
+                if base_row is not None:
+                    out_keys.append(patch_delta.keys[i])
+                    out_diffs.append(1)
+                    out_rows.append(base_row)
+        self.patch.apply(patch_delta)
+
+        return _delta_from_rows(
+            out_keys, out_diffs, out_rows, self.output_columns
+        ).consolidated()
+
+
+class UpdateCellsEvaluator(Evaluator):
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        patch_cols = [
+            c for c in node.inputs[1].column_names() if c in node.inputs[0].column_names()
+        ]
+        self.patch_cols = patch_cols
+        self.base = StateTable(self.output_columns)
+        self.patch = StateTable(patch_cols)
+
+    def _merged(self, kb: bytes, base_row: dict) -> dict:
+        patch_row = self.patch.get_row(kb)
+        if patch_row is None:
+            return base_row
+        merged = dict(base_row)
+        merged.update(patch_row)
+        return merged
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        base_delta, patch_delta = input_deltas
+        out_keys, out_diffs, out_rows = [], [], []
+
+        # patch first so base rows arriving same commit see it
+        self.patch.apply(
+            Delta(
+                patch_delta.keys,
+                patch_delta.diffs,
+                {c: patch_delta.columns[c] for c in self.patch_cols},
+            )
+        )
+        for i in range(len(base_delta)):
+            kb = base_delta.keys[i].tobytes()
+            row = {c: base_delta.columns[c][i] for c in self.output_columns}
+            out_keys.append(base_delta.keys[i])
+            out_diffs.append(int(base_delta.diffs[i]))
+            out_rows.append(self._merged(kb, row))
+        self.base.apply(base_delta)
+
+        # patch changes for keys NOT in this commit's base delta
+        seen = {base_delta.keys[i].tobytes() for i in range(len(base_delta))}
+        handled: set[bytes] = set()
+        for i in range(len(patch_delta)):
+            kb = patch_delta.keys[i].tobytes()
+            if kb in seen or kb in handled:
+                continue
+            handled.add(kb)
+            base_row = self.base.get_row(kb)
+            if base_row is None:
+                continue
+            # old merged (reconstruct patch state before this commit's patch delta)
+            old_patch: dict | None = None
+            for j in range(len(patch_delta)):
+                if patch_delta.keys[j].tobytes() == kb and patch_delta.diffs[j] < 0:
+                    old_patch = {c: patch_delta.columns[c][j] for c in self.patch_cols}
+            old_row = dict(base_row)
+            if old_patch is not None:
+                old_row.update(old_patch)
+            new_row = self._merged(kb, base_row)
+            if old_row != new_row:
+                out_keys.append(patch_delta.keys[i])
+                out_diffs.append(-1)
+                out_rows.append(old_row)
+                out_keys.append(patch_delta.keys[i])
+                out_diffs.append(1)
+                out_rows.append(new_row)
+        return _delta_from_rows(out_keys, out_diffs, out_rows, self.output_columns).consolidated()
+
+
+class _KeyPresenceMixin(Evaluator):
+    """Shared machinery for intersect/difference/restrict/having."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.base = StateTable(self.output_columns)
+        self.presence: List[set[bytes]] = [set() for _ in node.inputs[1:]]
+
+    def _emit_row(self, kb: bytes, key: np.void, diff: int, row: dict, out: list) -> None:
+        out.append((key, diff, row))
+
+    def _condition(self, kb: bytes) -> bool:
+        raise NotImplementedError
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        base_delta = input_deltas[0]
+        out: List[tuple] = []
+
+        # update presence sets, recording transitions
+        transitions: Dict[bytes, np.void] = {}
+        for idx, delta in enumerate(input_deltas[1:]):
+            for i in range(len(delta)):
+                kb = delta.keys[i].tobytes()
+                before = self._condition(kb)
+                if delta.diffs[i] > 0:
+                    self.presence[idx].add(kb)
+                else:
+                    self.presence[idx].discard(kb)
+                after = self._condition(kb)
+                if before != after:
+                    transitions[kb] = delta.keys[i]
+
+        for i in range(len(base_delta)):
+            kb = base_delta.keys[i].tobytes()
+            transitions.pop(kb, None)
+        # base rows: emit if condition currently holds
+        for i in range(len(base_delta)):
+            kb = base_delta.keys[i].tobytes()
+            if self._condition(kb):
+                row = {c: base_delta.columns[c][i] for c in self.output_columns}
+                out.append((base_delta.keys[i], int(base_delta.diffs[i]), row))
+        self.base.apply(base_delta)
+
+        for kb, key in transitions.items():
+            row = self.base.get_row(kb)
+            if row is None:
+                continue
+            diff = 1 if self._condition(kb) else -1
+            out.append((key, diff, row))
+
+        keys = [o[0] for o in out]
+        diffs = [o[1] for o in out]
+        rows = [o[2] for o in out]
+        return _delta_from_rows(keys, diffs, rows, self.output_columns)
+
+
+class IntersectEvaluator(_KeyPresenceMixin):
+    def _condition(self, kb: bytes) -> bool:
+        return all(kb in p for p in self.presence)
+
+
+class DifferenceEvaluator(_KeyPresenceMixin):
+    def _condition(self, kb: bytes) -> bool:
+        return kb not in self.presence[0]
+
+
+class RestrictEvaluator(_KeyPresenceMixin):
+    def _condition(self, kb: bytes) -> bool:
+        return kb in self.presence[0]
+
+
+class HavingEvaluator(Evaluator):
+    """Keep base rows whose key appears among the indexer pointer column's values."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.base = StateTable(self.output_columns)
+        self.indexers: List[expr.ColumnReference] = node.config["indexers"]
+        self.counts: List[Dict[bytes, int]] = [defaultdict(int) for _ in self.indexers]
+
+    def _condition(self, kb: bytes) -> bool:
+        return all(c.get(kb, 0) > 0 for c in self.counts)
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        base_delta = input_deltas[0]
+        out: List[tuple] = []
+        transitions: Dict[bytes, np.void] = {}
+        for idx, delta in enumerate(input_deltas[1:]):
+            ref = self.indexers[idx]
+            if len(delta) == 0:
+                continue
+            vals = delta.columns[ref.name]
+            for i in range(len(delta)):
+                p = vals[i]
+                if not isinstance(p, Pointer):
+                    continue
+                kb = pointers_to_keys([p]).tobytes()
+                before = self._condition(kb)
+                self.counts[idx][kb] += int(delta.diffs[i])
+                after = self._condition(kb)
+                if before != after:
+                    transitions[kb] = pointers_to_keys([p])[0]
+
+        for i in range(len(base_delta)):
+            kb = base_delta.keys[i].tobytes()
+            transitions.pop(kb, None)
+            if self._condition(kb):
+                row = {c: base_delta.columns[c][i] for c in self.output_columns}
+                out.append((base_delta.keys[i], int(base_delta.diffs[i]), row))
+        self.base.apply(base_delta)
+
+        for kb, key in transitions.items():
+            row = self.base.get_row(kb)
+            if row is None:
+                continue
+            diff = 1 if self._condition(kb) else -1
+            out.append((key, diff, row))
+        return _delta_from_rows(
+            [o[0] for o in out], [o[1] for o in out], [o[2] for o in out], self.output_columns
+        )
+
+
+class WithUniverseOfEvaluator(Evaluator):
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        return input_deltas[0]
+
+
+class FlattenEvaluator(Evaluator):
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        flat_name = self.node.config["flat_name"]
+        origin_id = self.node.config.get("origin_id")
+        out_keys, out_diffs, out_rows = [], [], []
+        ptrs = keys_to_pointers(delta.keys)
+        for i in range(len(delta)):
+            value = delta.columns[flat_name][i]
+            items = _iter_flatten(value)
+            for j, item in enumerate(items):
+                row = {c: delta.columns[c][i] for c in delta.column_names}
+                row[flat_name] = item
+                if origin_id:
+                    row[origin_id] = ptrs[i]
+                out_keys.append(pointer_from(ptrs[i], j, "flatten"))
+                out_diffs.append(int(delta.diffs[i]))
+                out_rows.append(row)
+        return _delta_from_rows(
+            pointers_to_keys(out_keys) if out_keys else [],
+            out_diffs,
+            out_rows,
+            self.output_columns,
+        )
+
+
+def _iter_flatten(value: Any) -> list:
+    from pathway_tpu.internals.json import Json
+
+    if isinstance(value, Json):
+        return [Json(v) if isinstance(v, (dict, list)) else v for v in value.value]
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    if isinstance(value, np.ndarray):
+        return list(value)
+    if isinstance(value, str):
+        return list(value)
+    raise TypeError(f"cannot flatten value of type {type(value).__name__}")
+
+
+class IxEvaluator(Evaluator):
+    """source-keyed lookup into target (reference ``ix``/``ix_ref``)."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.src_keys: Dict[bytes, bytes] = {}  # source key -> target key
+        self.reverse: Dict[bytes, set[bytes]] = defaultdict(set)
+        self.src_rows: Dict[bytes, np.void] = {}
+        self.emitted: Dict[bytes, dict] = {}  # source key -> last emitted output row
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        source_delta, target_delta = input_deltas
+        source_table, target_table = self.node.inputs
+        optional = self.node.config.get("optional", False)
+        target_state = self.runner.state_of(target_table._node)
+        out_keys, out_diffs, out_rows = [], [], []
+
+        handled_sources: set[bytes] = set()
+        if len(source_delta):
+            resolver = self._resolver_for(source_table, source_delta)
+            ixptrs = ee.evaluate(
+                self.node.config["key_expression"], len(source_delta), resolver
+            )
+            for i in range(len(source_delta)):
+                skb = source_delta.keys[i].tobytes()
+                handled_sources.add(skb)
+                d = int(source_delta.diffs[i])
+                p = ixptrs[i]
+                tkb = pointers_to_keys([p]).tobytes() if isinstance(p, Pointer) else None
+                if d > 0:
+                    self.src_keys[skb] = tkb
+                    self.src_rows[skb] = source_delta.keys[i]
+                    if tkb is not None:
+                        self.reverse[tkb].add(skb)
+                    row = None if tkb is None else target_state.get_row(tkb)
+                    if row is None:
+                        if not optional and tkb is not None:
+                            raise KeyError(f"ix: missing key {p!r} in target table")
+                        row = {c: None for c in self.output_columns}
+                    self.emitted[skb] = row
+                else:
+                    self.src_keys.pop(skb, None)
+                    self.src_rows.pop(skb, None)
+                    if tkb is not None:
+                        self.reverse[tkb].discard(skb)
+                    # retraction replays what was last emitted, regardless of target state
+                    row = self.emitted.pop(skb, {c: None for c in self.output_columns})
+                out_keys.append(source_delta.keys[i])
+                out_diffs.append(d)
+                out_rows.append(row)
+
+        # target-side changes re-emit affected source rows, preserving row-per-key:
+        # optional sources flip between the real row and an all-None row
+        none_row = {c: None for c in self.output_columns}
+        for i in range(len(target_delta)):
+            tkb = target_delta.keys[i].tobytes()
+            d = int(target_delta.diffs[i])
+            row = {c: target_delta.columns[c][i] for c in self.output_columns}
+            for skb in self.reverse.get(tkb, set()):
+                if skb in handled_sources:
+                    continue
+                prev = self.emitted.get(skb)
+                if d > 0:
+                    if prev is not None:
+                        out_keys.append(self.src_rows[skb])
+                        out_diffs.append(-1)
+                        out_rows.append(prev)
+                    out_keys.append(self.src_rows[skb])
+                    out_diffs.append(1)
+                    out_rows.append(row)
+                    self.emitted[skb] = row
+                else:
+                    out_keys.append(self.src_rows[skb])
+                    out_diffs.append(-1)
+                    out_rows.append(prev if prev is not None else row)
+                    if optional:
+                        out_keys.append(self.src_rows[skb])
+                        out_diffs.append(1)
+                        out_rows.append(none_row)
+                        self.emitted[skb] = none_row
+                    else:
+                        self.emitted.pop(skb, None)
+        return _delta_from_rows(
+            out_keys, out_diffs, out_rows, self.output_columns
+        ).consolidated()
+
+
+class SortEvaluator(Evaluator):
+    """prev/next pointers per instance (reference ``prev_next.rs:770``)."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.rows: Dict[bytes, tuple] = {}  # key -> (sort_val, instance, Pointer)
+        self.emitted: Dict[bytes, tuple] = {}  # key -> (prev, next)
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        table = self.node.inputs[0]
+        resolver = self._resolver_for(table, delta)
+        n = len(delta)
+        keys_vals = ee.evaluate(self.node.config["key"], n, resolver)
+        instance_e = self.node.config.get("instance")
+        instances = (
+            ee.evaluate(instance_e, n, resolver) if instance_e is not None else np.zeros(n, dtype=object)
+        )
+        ptrs = keys_to_pointers(delta.keys)
+        touched_instances = set()
+        for i in range(n):
+            kb = delta.keys[i].tobytes()
+            if delta.diffs[i] > 0:
+                self.rows[kb] = (keys_vals[i], instances[i], ptrs[i], delta.keys[i])
+            else:
+                self.rows.pop(kb, None)
+            touched_instances.add(_hashable_scalar(instances[i]))
+
+        # recompute orders for touched instances
+        out_keys, out_diffs, out_rows = [], [], []
+        by_instance: Dict[Any, list] = defaultdict(list)
+        for kb, (sv, inst, ptr, key) in self.rows.items():
+            hi = _hashable_scalar(inst)
+            if hi in touched_instances:
+                by_instance[hi].append((sv, ptr, kb, key))
+        new_links: Dict[bytes, tuple] = {}
+        for inst, rows in by_instance.items():
+            rows.sort(key=lambda r: (r[0], r[1]))
+            for idx, (sv, ptr, kb, key) in enumerate(rows):
+                prev_ptr = rows[idx - 1][1] if idx > 0 else None
+                next_ptr = rows[idx + 1][1] if idx < len(rows) - 1 else None
+                new_links[kb] = (prev_ptr, next_ptr, key)
+        # diff against emitted
+        for kb, (pv, nv) in list(self.emitted.items()):
+            if kb not in self.rows:
+                # row gone: retract
+                out_keys.append(self._key_of(kb))
+                out_diffs.append(-1)
+                out_rows.append({"prev": pv, "next": nv})
+                del self.emitted[kb]
+        for kb, (pv, nv, key) in new_links.items():
+            old = self.emitted.get(kb)
+            if old == (pv, nv):
+                continue
+            if old is not None:
+                out_keys.append(key)
+                out_diffs.append(-1)
+                out_rows.append({"prev": old[0], "next": old[1]})
+            out_keys.append(key)
+            out_diffs.append(1)
+            out_rows.append({"prev": pv, "next": nv})
+            self.emitted[kb] = (pv, nv)
+        return _delta_from_rows(out_keys, out_diffs, out_rows, self.output_columns)
+
+    def _key_of(self, kb: bytes) -> np.void:
+        arr = np.frombuffer(kb, dtype=KEY_DTYPE)
+        return arr[0]
+
+
+def _hashable_scalar(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return (v.tobytes(), v.shape)
+    return v
+
+
+class RemoveErrorsEvaluator(Evaluator):
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return delta
+        mask = np.ones(len(delta), dtype=bool)
+        for col in delta.columns.values():
+            if col.dtype == object:
+                mask &= ~np.frompyfunc(lambda v: isinstance(v, Error), 1, 1)(col).astype(bool)
+        return delta.select(mask)
+
+
+class AsofNowEvaluator(Evaluator):
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.pending_retractions: Optional[Delta] = None
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        mode = self.node.config["mode"]
+        if mode == "filter_forgotten":
+            return delta.select(delta.diffs > 0)
+        # forget mode: emit this commit's inserts plus scheduled retractions of previous commit
+        parts = [delta]
+        if self.pending_retractions is not None and len(self.pending_retractions):
+            parts.append(self.pending_retractions)
+        inserts = delta.select(delta.diffs > 0)
+        self.pending_retractions = inserts.negated()
+        out = Delta.concat(parts, self.output_columns)
+        return out
+
+    def has_pending(self) -> bool:
+        return self.pending_retractions is not None and len(self.pending_retractions) > 0
+
+
+class ExternalIndexEvaluator(Evaluator):
+    """As-of-now external index operator (reference ``external_index.rs:38``)."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.index = node.config["index_factory"].make_instance()
+        self.replies = StateTable(["_pw_index_reply"])
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        index_delta, query_delta = input_deltas
+        index_table, query_table = self.node.inputs
+
+        if len(index_delta):
+            resolver = self._resolver_for(index_table, index_delta)
+            vec_ref = self.node.config["index_column"]
+            vectors = ee.evaluate(vec_ref, len(index_delta), resolver)
+            filter_col = self.node.config.get("index_filter_data_column")
+            filters = (
+                ee.evaluate(filter_col, len(index_delta), resolver)
+                if filter_col is not None
+                else None
+            )
+            ptrs = keys_to_pointers(index_delta.keys)
+            add_mask = index_delta.diffs > 0
+            for i in range(len(index_delta)):
+                if add_mask[i]:
+                    self.index.add(
+                        ptrs[i], vectors[i], filters[i] if filters is not None else None
+                    )
+                else:
+                    self.index.remove(ptrs[i])
+
+        out_keys, out_diffs, out_rows = [], [], []
+        if len(query_delta):
+            resolver = self._resolver_for(query_table, query_delta)
+            qvecs = ee.evaluate(self.node.config["query_column"], len(query_delta), resolver)
+            limit_col = self.node.config.get("query_responses_limit_column")
+            limits = (
+                ee.evaluate(limit_col, len(query_delta), resolver)
+                if limit_col is not None
+                else None
+            )
+            qfilter_col = self.node.config.get("query_filter_column")
+            qfilters = (
+                ee.evaluate(qfilter_col, len(query_delta), resolver)
+                if qfilter_col is not None
+                else None
+            )
+            for i in range(len(query_delta)):
+                kb = query_delta.keys[i].tobytes()
+                if query_delta.diffs[i] > 0:
+                    limit = int(limits[i]) if limits is not None else 1
+                    flt = qfilters[i] if qfilters is not None else None
+                    matches = self.index.search(qvecs[i], limit, flt)
+                    reply = tuple(matches)
+                    out_keys.append(query_delta.keys[i])
+                    out_diffs.append(1)
+                    out_rows.append({"_pw_index_reply": reply})
+                else:
+                    stored = self.replies.get_row(kb)
+                    if stored is not None:
+                        out_keys.append(query_delta.keys[i])
+                        out_diffs.append(-1)
+                        out_rows.append(stored)
+        delta = _delta_from_rows(out_keys, out_diffs, out_rows, ["_pw_index_reply"])
+        self.replies.apply(delta)
+        return delta
+
+
+class OutputEvaluator(Evaluator):
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.callback = node.config.get("callback")
+        self.on_end = node.config.get("on_end")
+        self.input_columns = node.inputs[0].column_names()
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if self.callback is not None and len(delta):
+            ptrs = keys_to_pointers(delta.keys)
+            time = self.runner.current_time
+            for i in range(len(delta)):
+                row = {c: delta.columns[c][i] for c in self.input_columns}
+                self.callback(
+                    key=ptrs[i], row=row, time=time, is_addition=bool(delta.diffs[i] > 0)
+                )
+        return Delta.empty([])
+
+    def finish(self) -> None:
+        if self.on_end is not None:
+            self.on_end()
+
+
+def _delta_from_rows(
+    keys: Any, diffs: List[int], rows: List[dict], column_names: List[str]
+) -> Delta:
+    if len(rows) == 0:
+        return Delta.empty(column_names)
+    if isinstance(keys, list):
+        if keys and isinstance(keys[0], Pointer):
+            keys = pointers_to_keys(keys)
+        else:
+            arr = np.empty(len(keys), dtype=KEY_DTYPE)
+            for i, k in enumerate(keys):
+                arr[i] = k
+            keys = arr
+    columns = {
+        name: ee._tidy(objarray([r[name] for r in rows]))
+        for name in column_names
+    }
+    return Delta(keys, np.array(diffs, dtype=np.int64), columns)
+
+
+EVALUATORS: Dict[type, type] = {
+    pg.InputNode: InputEvaluator,
+    pg.RowwiseNode: RowwiseEvaluator,
+    pg.FilterNode: FilterEvaluator,
+    pg.ReindexNode: ReindexEvaluator,
+    pg.ConcatNode: ConcatEvaluator,
+    pg.GroupbyNode: GroupbyEvaluator,
+    pg.DeduplicateNode: DeduplicateEvaluator,
+    pg.JoinNode: JoinEvaluator,
+    pg.UpdateRowsNode: UpdateRowsEvaluator,
+    pg.UpdateCellsNode: UpdateCellsEvaluator,
+    pg.IntersectNode: IntersectEvaluator,
+    pg.DifferenceNode: DifferenceEvaluator,
+    pg.RestrictNode: RestrictEvaluator,
+    pg.HavingNode: HavingEvaluator,
+    pg.WithUniverseOfNode: WithUniverseOfEvaluator,
+    pg.FlattenNode: FlattenEvaluator,
+    pg.IxNode: IxEvaluator,
+    pg.SortNode: SortEvaluator,
+    pg.RemoveErrorsNode: RemoveErrorsEvaluator,
+    pg.AsofNowUpdateNode: AsofNowEvaluator,
+    pg.ExternalIndexNode: ExternalIndexEvaluator,
+    pg.OutputNode: OutputEvaluator,
+}
+
+
+def _register_iterate() -> None:
+    from pathway_tpu.internals.iterate import IterateEvaluator, IterateResultEvaluator
+
+    EVALUATORS[pg.IterateNode] = IterateEvaluator
+    EVALUATORS[pg.IterateResultNode] = IterateResultEvaluator
+
+
+_register_iterate()

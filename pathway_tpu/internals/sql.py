@@ -1,0 +1,279 @@
+"""``pw.sql`` — SQL queries over tables.
+
+Parity: reference ``internals/sql.py`` (sqlglot-based). sqlglot is not in this image, so a
+compact recursive-descent parser covers the supported subset: SELECT (exprs, aliases), FROM,
+WHERE, GROUP BY, HAVING, and the reducers COUNT/SUM/MIN/MAX/AVG. Unsupported syntax raises.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.reducers import reducers
+from pathway_tpu.internals.table import Table
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<num>\d+\.\d+|\d+)|(?P<id>[A-Za-z_][A-Za-z_0-9.]*)|(?P<str>'[^']*')"
+    r"|(?P<op><=|>=|<>|!=|=|<|>|\+|-|\*|/|%|\(|\)|,))"
+)
+
+_AGGS = {"count", "sum", "min", "max", "avg"}
+
+
+class _Parser:
+    def __init__(self, text: str, tables: Dict[str, Table]):
+        self.tokens = self._tokenize(text)
+        self.pos = 0
+        self.tables = tables
+        self.table: Table | None = None
+
+    @staticmethod
+    def _tokenize(text: str) -> List[str]:
+        out = []
+        pos = 0
+        while pos < len(text):
+            m = _TOKEN.match(text, pos)
+            if m is None:
+                if text[pos:].strip() == "":
+                    break
+                raise ValueError(f"cannot tokenize SQL near {text[pos:pos+20]!r}")
+            out.append(m.group().strip())
+            pos = m.end()
+        return out
+
+    def peek(self) -> str | None:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> str:
+        tok = self.tokens[self.pos]
+        self.pos += 1
+        return tok
+
+    def expect(self, word: str) -> None:
+        tok = self.next()
+        if tok.lower() != word.lower():
+            raise ValueError(f"expected {word!r}, got {tok!r}")
+
+    def at_keyword(self, *words: str) -> bool:
+        tok = self.peek()
+        return tok is not None and tok.lower() in words
+
+    # expression grammar: comparison > additive > multiplicative > atom
+    def parse_expr(self) -> Any:
+        left = self.parse_add()
+        if self.peek() in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            op = self.next()
+            right = self.parse_add()
+            import operator as _op
+
+            mapping = {
+                "=": _op.eq,
+                "<>": _op.ne,
+                "!=": _op.ne,
+                "<": _op.lt,
+                "<=": _op.le,
+                ">": _op.gt,
+                ">=": _op.ge,
+            }
+            return expr.ColumnBinaryOpExpression(mapping[op], left, right)
+        return left
+
+    def parse_condition(self) -> Any:
+        left = self.parse_expr()
+        while self.at_keyword("and", "or"):
+            kw = self.next().lower()
+            right = self.parse_expr()
+            import operator as _op
+
+            left = expr.ColumnBinaryOpExpression(
+                _op.and_ if kw == "and" else _op.or_, left, right
+            )
+        return left
+
+    def parse_add(self) -> Any:
+        left = self.parse_mul()
+        while self.peek() in ("+", "-"):
+            op = self.next()
+            right = self.parse_mul()
+            import operator as _op
+
+            left = expr.ColumnBinaryOpExpression(_op.add if op == "+" else _op.sub, left, right)
+        return left
+
+    def parse_mul(self) -> Any:
+        left = self.parse_atom()
+        while self.peek() in ("*", "/", "%"):
+            op = self.next()
+            right = self.parse_atom()
+            import operator as _op
+
+            mapping = {"*": _op.mul, "/": _op.truediv, "%": _op.mod}
+            left = expr.ColumnBinaryOpExpression(mapping[op], left, right)
+        return left
+
+    def parse_atom(self) -> Any:
+        tok = self.peek()
+        if tok is None:
+            raise ValueError("unexpected end of SQL")
+        if tok == "(":
+            self.next()
+            e = self.parse_condition()
+            self.expect(")")
+            return e
+        if re.fullmatch(r"\d+", tok):
+            self.next()
+            return expr.ColumnConstExpression(int(tok))
+        if re.fullmatch(r"\d+\.\d+", tok):
+            self.next()
+            return expr.ColumnConstExpression(float(tok))
+        if tok.startswith("'"):
+            self.next()
+            return expr.ColumnConstExpression(tok[1:-1])
+        # identifier / function call
+        self.next()
+        if self.peek() == "(":
+            fn = tok.lower()
+            self.next()
+            if fn == "count" and self.peek() == "*":
+                self.next()
+                self.expect(")")
+                return reducers.count()
+            args = []
+            if self.peek() != ")":
+                args.append(self.parse_condition())
+                while self.peek() == ",":
+                    self.next()
+                    args.append(self.parse_condition())
+            self.expect(")")
+            if fn in _AGGS:
+                return getattr(reducers, fn)(*args)
+            raise ValueError(f"unsupported SQL function {fn!r}")
+        name = tok.split(".")[-1]
+        assert self.table is not None
+        return self.table[name]
+
+
+def sql(query: str, **tables: Table) -> Table:
+    """Run a SQL SELECT over the given tables (supported: WHERE/GROUP BY/HAVING + aggs)."""
+    p = _Parser(query, tables)
+    p.expect("select")
+    select_items: List[tuple] = []  # (alias, token-slice start) — parse later once FROM known
+    start = p.pos
+    depth = 0
+    while not (p.at_keyword("from") and depth == 0):
+        tok = p.next()
+        if tok == "(":
+            depth += 1
+        elif tok == ")":
+            depth -= 1
+        if p.peek() is None:
+            raise ValueError("SELECT without FROM")
+    select_tokens = p.tokens[start : p.pos]
+    p.expect("from")
+    table_name = p.next()
+    if table_name not in tables:
+        raise ValueError(f"unknown table {table_name!r}")
+    table = tables[table_name]
+    p.table = table
+
+    # re-parse the select list with the table bound
+    sel = _Parser("", tables)
+    sel.tokens = select_tokens
+    sel.table = table
+    exprs: Dict[str, Any] = {}
+    idx = 0
+    while sel.peek() is not None:
+        if sel.peek() == "*":
+            sel.next()
+            for name in table.column_names():
+                exprs[name] = table[name]
+        else:
+            e = sel.parse_condition()
+            alias = None
+            if sel.at_keyword("as"):
+                sel.next()
+                alias = sel.next()
+            if alias is None:
+                if isinstance(e, expr.ColumnReference):
+                    alias = e.name
+                else:
+                    alias = f"col_{idx}"
+            exprs[alias] = e
+        idx += 1
+        if sel.peek() == ",":
+            sel.next()
+
+    where_e = None
+    if p.at_keyword("where"):
+        p.next()
+        where_e = p.parse_condition()
+    group_cols: List[Any] = []
+    if p.at_keyword("group"):
+        p.next()
+        p.expect("by")
+        group_cols.append(p.parse_expr())
+        while p.peek() == ",":
+            p.next()
+            group_cols.append(p.parse_expr())
+    having_e = None
+    if p.at_keyword("having"):
+        p.next()
+        having_e = p.parse_condition()
+
+    result = table
+    if where_e is not None:
+        result = result.filter(_rebind(where_e, table, result))
+        p.table = result
+        exprs = {k: _rebind(v, table, result) for k, v in exprs.items()}
+        group_cols = [_rebind(g, table, result) for g in group_cols]
+        if having_e is not None:
+            having_e = _rebind(having_e, table, result)
+
+    has_aggs = any(_contains_reducer(e) for e in exprs.values())
+    if group_cols or has_aggs:
+        grouped = result.groupby(*group_cols) if group_cols else result.groupby()
+        if having_e is not None:
+            exprs["_pw_having"] = having_e
+        out = grouped.reduce(**exprs)
+        if having_e is not None:
+            out = out.filter(out._pw_having).without("_pw_having")
+        return out
+    return result.select(**exprs)
+
+
+def _rebind(e: Any, old: Table, new: Table) -> Any:
+    if isinstance(e, expr.ColumnReference):
+        return new[e.name] if e.table is old else e
+    if isinstance(e, expr.ReducerExpression):
+        clone = expr.ReducerExpression(e._reducer)
+        clone._args = tuple(_rebind(a, old, new) for a in e._args)
+        clone._kwargs = e._kwargs
+        return clone
+    if isinstance(e, expr.ColumnExpression):
+        import copy
+
+        clone = copy.copy(e)
+        for attr, value in list(vars(e).items()):
+            if isinstance(value, expr.ColumnExpression):
+                setattr(clone, attr, _rebind(value, old, new))
+            elif isinstance(value, tuple) and any(isinstance(v, expr.ColumnExpression) for v in value):
+                setattr(
+                    clone,
+                    attr,
+                    tuple(
+                        _rebind(v, old, new) if isinstance(v, expr.ColumnExpression) else v
+                        for v in value
+                    ),
+                )
+        return clone
+    return e
+
+
+def _contains_reducer(e: Any) -> bool:
+    if isinstance(e, expr.ReducerExpression):
+        return True
+    if isinstance(e, expr.ColumnExpression):
+        return any(_contains_reducer(d) for d in e._deps())
+    return False

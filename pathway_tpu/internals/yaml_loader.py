@@ -1,0 +1,97 @@
+"""YAML app templates — ``pw.load_yaml``.
+
+Parity: reference ``internals/yaml_loader.py:214``: ``!pw.<dotted.path>`` instantiates objects,
+``$ref``-style anchors via ``$<name>`` variables; powers RAG app templates.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict
+
+import yaml
+
+
+def _resolve_path(path: str) -> Any:
+    if path.startswith("pw."):
+        import pathway_tpu as pw
+
+        obj: Any = pw
+        parts = path.split(".")[1:]
+    else:
+        module_path, _, attr = path.rpartition(".")
+        try:
+            return getattr(importlib.import_module(module_path), attr)
+        except (ImportError, AttributeError):
+            parts = path.split(".")
+            obj = importlib.import_module(parts[0])
+            parts = parts[1:]
+    for part in parts:
+        if hasattr(obj, part):
+            obj = getattr(obj, part)
+        else:
+            obj = importlib.import_module(f"{obj.__name__}.{part}")
+    return obj
+
+
+class _PwLoader(yaml.SafeLoader):
+    pass
+
+
+def _pw_constructor(loader: _PwLoader, tag_suffix: str, node: yaml.Node) -> Any:
+    target = _resolve_path("pw." + tag_suffix if not tag_suffix.startswith("pw.") else tag_suffix)
+    if isinstance(node, yaml.MappingNode):
+        kwargs = loader.construct_mapping(node, deep=True)
+        return _Instantiate(target, kwargs)
+    if isinstance(node, yaml.SequenceNode):
+        args = loader.construct_sequence(node, deep=True)
+        return _Instantiate(target, None, args)
+    value = loader.construct_scalar(node)
+    if value in (None, ""):
+        return _Instantiate(target, {})
+    return _Instantiate(target, None, [value])
+
+
+class _Instantiate:
+    def __init__(self, target: Any, kwargs: Dict | None, args: list | None = None):
+        self.target = target
+        self.kwargs = kwargs
+        self.args = args
+
+    def build(self, variables: Dict[str, Any]) -> Any:
+        args = [_materialize(a, variables) for a in (self.args or [])]
+        kwargs = {k: _materialize(v, variables) for k, v in (self.kwargs or {}).items()}
+        if callable(self.target):
+            return self.target(*args, **kwargs)
+        return self.target
+
+
+_PwLoader.add_multi_constructor("!pw.", _pw_constructor)
+_PwLoader.add_multi_constructor("!", lambda l, s, n: _pw_constructor(l, s, n))
+
+
+def _materialize(value: Any, variables: Dict[str, Any]) -> Any:
+    if isinstance(value, _Instantiate):
+        return value.build(variables)
+    if isinstance(value, str) and value.startswith("$") and value[1:] in variables:
+        return _materialize(variables[value[1:]], variables)
+    if isinstance(value, dict):
+        return {k: _materialize(v, variables) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_materialize(v, variables) for v in value]
+    return value
+
+
+def load_yaml(stream: Any) -> Any:
+    """Parse a YAML app template, instantiating ``!pw.*`` tags and ``$variables``."""
+    if hasattr(stream, "read"):
+        raw = yaml.load(stream, Loader=_PwLoader)
+    else:
+        raw = yaml.load(str(stream), Loader=_PwLoader)
+    if isinstance(raw, dict):
+        variables = {k.lstrip("$"): v for k, v in raw.items()}
+        return {
+            k.lstrip("$"): _materialize(v, variables)
+            for k, v in raw.items()
+        }
+    return _materialize(raw, {})

@@ -1,0 +1,24 @@
+"""I/O connector namespaces.
+
+Parity: reference ``python/pathway/io/`` — 27 connector namespaces. Connectors are host-side
+(IO never belongs on the TPU); each ``read`` returns a Table backed by a DataSource, each
+``write`` adds an output node. Namespaces needing absent client libraries degrade with a clear
+ImportError at call time, not import time.
+"""
+
+from pathway_tpu.io import csv, fs, http, jsonlines, kafka, null, plaintext, python, s3, sqlite
+from pathway_tpu.io._subscribe import subscribe
+
+__all__ = [
+    "csv",
+    "fs",
+    "http",
+    "jsonlines",
+    "kafka",
+    "null",
+    "plaintext",
+    "python",
+    "s3",
+    "sqlite",
+    "subscribe",
+]

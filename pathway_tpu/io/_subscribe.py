@@ -1,0 +1,23 @@
+"""``pw.io.subscribe`` — per-row change callbacks (parity: reference ``io/subscribe``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+
+
+def subscribe(
+    table: Any,
+    on_change: Callable[..., None],
+    on_end: Callable[[], None] | None = None,
+    on_time_end: Callable[[int], None] | None = None,
+    name: str | None = None,
+) -> None:
+    """Call ``on_change(key, row, time, is_addition)`` for every row update of ``table``."""
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        on_change(key=key, row=row, time=time, is_addition=is_addition)
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=on_end))

@@ -1,0 +1,243 @@
+"""Filesystem connector (parity: reference ``io/fs`` + ``src/connectors/scanner/filesystem.rs``).
+
+Supports static and streaming modes over csv / json(lines) / plaintext / binary formats, with
+the ``_metadata`` column like the reference's metadata support (``src/connectors/metadata.rs``).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+from pathway_tpu.engine.datasource import StreamingDataSource
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import pointer_from
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def _coerce(value: str, dtype: dt.DType) -> Any:
+    base = dtype.strip_optional()
+    if value is None:
+        return None
+    try:
+        if base == dt.INT:
+            return int(value)
+        if base == dt.FLOAT:
+            return float(value)
+        if base == dt.BOOL:
+            return value in ("true", "True", "1")
+        if base == dt.JSON:
+            return Json.parse(value)
+    except (ValueError, TypeError):
+        return None
+    return value
+
+
+def _iter_files(path: str, object_pattern: str = "*") -> List[str]:
+    p = Path(path)
+    if p.is_dir():
+        return sorted(str(f) for f in p.rglob(object_pattern) if f.is_file())
+    return sorted(glob.glob(path)) or ([str(p)] if p.exists() else [])
+
+
+def _metadata_for(filepath: str) -> Json:
+    st = os.stat(filepath)
+    return Json(
+        {
+            "path": str(Path(filepath).resolve()),
+            "size": st.st_size,
+            "seen_at": int(time.time()),
+            "modified_at": int(st.st_mtime),
+            "owner": str(st.st_uid),
+        }
+    )
+
+
+def _parse_file(
+    filepath: str,
+    format: str,
+    schema: sch.SchemaMetaclass | None,
+    with_metadata: bool,
+    csv_settings: Any = None,
+) -> List[dict]:
+    rows: List[dict] = []
+    if format in ("plaintext", "plaintext_by_file"):
+        with open(filepath, "r", errors="replace") as f:
+            if format == "plaintext_by_file":
+                rows.append({"data": f.read()})
+            else:
+                for line in f:
+                    rows.append({"data": line.rstrip("\n")})
+    elif format == "binary":
+        with open(filepath, "rb") as f:
+            rows.append({"data": f.read()})
+    elif format == "csv":
+        delimiter = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
+        with open(filepath, newline="") as f:
+            reader = _csv.DictReader(f, delimiter=delimiter)
+            dtypes = schema.dtypes() if schema else {}
+            for rec in reader:
+                rows.append({k: _coerce(v, dtypes.get(k, dt.STR)) for k, v in rec.items() if k in dtypes or not schema})
+    elif format in ("json", "jsonlines"):
+        dtypes = schema.dtypes() if schema else {}
+        with open(filepath) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                row = {}
+                for name, dtype in (dtypes or {k: dt.ANY for k in rec}).items():
+                    v = rec.get(name)
+                    if dtype.strip_optional() == dt.JSON and v is not None:
+                        v = Json(v)
+                    row[name] = v
+                rows.append(row)
+    else:
+        raise ValueError(f"unknown format {format!r}")
+    if with_metadata:
+        meta = _metadata_for(filepath)
+        for row in rows:
+            row["_metadata"] = meta
+    return rows
+
+
+class _FsSubject:
+    def __init__(
+        self,
+        path: str,
+        format: str,
+        schema: sch.SchemaMetaclass | None,
+        mode: str,
+        with_metadata: bool,
+        object_pattern: str,
+        refresh_interval: float = 0.5,
+        csv_settings: Any = None,
+    ):
+        self.path = path
+        self.format = format
+        self.schema = schema
+        self.mode = mode
+        self.with_metadata = with_metadata
+        self.object_pattern = object_pattern
+        self.refresh_interval = refresh_interval
+        self.csv_settings = csv_settings
+        self.seen: Dict[str, float] = {}
+        self.emitted: Dict[str, List[dict]] = {}
+
+    def run(self, source: StreamingDataSource) -> None:
+        stop = False
+        while not stop:
+            for filepath in _iter_files(self.path, self.object_pattern):
+                mtime = os.stat(filepath).st_mtime
+                if self.seen.get(filepath) == mtime:
+                    continue
+                if filepath in self.emitted:
+                    for row in self.emitted[filepath]:
+                        source.push(row, diff=-1)
+                rows = _parse_file(
+                    filepath, self.format, self.schema, self.with_metadata, self.csv_settings
+                )
+                for row in rows:
+                    source.push(row, diff=1)
+                self.seen[filepath] = mtime
+                self.emitted[filepath] = rows
+            if self.mode in ("static", "batch"):
+                stop = True
+            else:
+                time.sleep(self.refresh_interval)
+
+
+def read(
+    path: str | Path,
+    *,
+    format: str = "plaintext",
+    schema: sch.SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    csv_settings: Any = None,
+    json_field_paths: dict | None = None,
+    object_pattern: str = "*",
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 100,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    path = str(path)
+    if schema is None:
+        if format in ("plaintext", "plaintext_by_file"):
+            schema = sch.schema_from_types(data=str)
+        elif format == "binary":
+            schema = sch.schema_from_types(data=bytes)
+        else:
+            raise ValueError(f"schema is required for format {format!r}")
+    out_schema = schema
+    if with_metadata:
+        out_schema = sch.schema_from_columns(
+            {**schema.columns(), "_metadata": sch.ColumnSchema("_metadata", dt.JSON)},
+            name="fs",
+        )
+    subject = _FsSubject(
+        path, format, schema, mode, with_metadata, object_pattern, csv_settings=csv_settings
+    )
+
+    class _Runner:
+        def run(self, source: StreamingDataSource) -> None:
+            subject.run(source)
+
+    source = StreamingDataSource(subject=_Runner(), autocommit_ms=autocommit_duration_ms)
+    node = G.add_node(pg.InputNode(source=source, streaming=mode == "streaming", name=name or "fs"))
+    return Table(node, out_schema, name=name or "fs")
+
+
+class _FileWriter:
+    def __init__(self, filename: str, format: str):
+        self.filename = filename
+        self.format = format
+        self.file = open(filename, "w")
+        self.lock = threading.Lock()
+
+    def write_row(self, row: dict, time_: int, diff: int) -> None:
+        with self.lock:
+            if self.format == "json":
+                rec = {**_plain(row), "time": time_, "diff": diff}
+                self.file.write(json.dumps(rec) + "\n")
+            else:
+                values = [str(v) for v in _plain(row).values()] + [str(time_), str(diff)]
+                self.file.write(",".join(values) + "\n")
+            self.file.flush()
+
+    def close(self) -> None:
+        self.file.close()
+
+
+def _plain(row: dict) -> dict:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, Json):
+            out[k] = v.value
+        elif hasattr(v, "as_int") and type(v).__name__ == "Pointer":
+            out[k] = repr(v)
+        elif isinstance(v, bytes):
+            out[k] = v.decode(errors="replace")
+        else:
+            out[k] = v
+    return out
+
+
+def write(table: Table, filename: str | Path, *, format: str = "json", name: str | None = None, **kwargs: Any) -> None:
+    writer = _FileWriter(str(filename), format)
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        writer.write_row(row, time, 1 if is_addition else -1)
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=writer.close))

@@ -1,0 +1,9 @@
+"""HTTP connectors: REST ingestion + generic http source/sink.
+
+Parity: reference ``io/http/`` with ``_server.py`` (``PathwayWebserver``, ``rest_connector``).
+Implementation lives in ``_server`` (aiohttp-based).
+"""
+
+from pathway_tpu.io.http._server import PathwayWebserver, rest_connector
+
+__all__ = ["PathwayWebserver", "rest_connector"]

@@ -1,0 +1,220 @@
+"""REST ingestion server.
+
+Parity: reference ``io/http/_server.py`` (``PathwayWebserver:329``, ``rest_connector:624``):
+an aiohttp server turns each HTTP request into a row of a streaming table; a response writer
+subscribes to a result table and resolves the pending HTTP future for the query's key.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+from pathway_tpu.engine.datasource import StreamingDataSource
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import Pointer, pointer_from
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+class PathwayWebserver:
+    """One aiohttp server shared by any number of rest_connector endpoints."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._routes: Dict[tuple, Any] = {}
+        self._started = threading.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._runner = None
+
+    def _register(self, route: str, methods: Sequence[str], handler: Any) -> None:
+        for method in methods:
+            self._routes[(method.upper(), route)] = handler
+        self._ensure_running()
+
+    def _ensure_running(self) -> None:
+        if self._thread is not None:
+            return
+
+        def serve() -> None:
+            import aiohttp.web as web
+
+            async def main() -> None:
+                app = web.Application()
+
+                async def dispatch(request: web.Request) -> web.Response:
+                    handler = self._routes.get((request.method, request.path))
+                    if handler is None:
+                        return web.Response(status=404, text="no such endpoint")
+                    return await handler(request)
+
+                app.router.add_route("*", "/{tail:.*}", dispatch)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                self._runner = runner
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+                self._started.set()
+                while True:
+                    await asyncio.sleep(3600)
+
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(main())
+            except Exception:
+                self._started.set()
+                raise
+
+        self._thread = threading.Thread(target=serve, daemon=True, name="pathway:webserver")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+
+class RestServerSubject:
+    def __init__(
+        self,
+        webserver: PathwayWebserver,
+        route: str,
+        methods: Sequence[str],
+        schema: sch.SchemaMetaclass,
+        delete_completed_queries: bool,
+        request_validator: Any = None,
+    ):
+        self.webserver = webserver
+        self.route = route
+        self.methods = methods
+        self.schema = schema
+        self.delete_completed_queries = delete_completed_queries
+        self.request_validator = request_validator
+        self.futures: Dict[bytes, "asyncio.Future"] = {}
+        self._counter = 0
+        self._lock = threading.Lock()
+        self._source: StreamingDataSource | None = None
+
+    def run(self, source: StreamingDataSource) -> None:
+        self._source = source
+
+        async def handler(request: Any) -> Any:
+            import aiohttp.web as web
+
+            if request.method in ("POST", "PUT", "PATCH"):
+                try:
+                    payload = await request.json()
+                except json.JSONDecodeError:
+                    payload = {}
+            else:
+                payload = dict(request.query)
+            if self.request_validator is not None:
+                try:
+                    self.request_validator(payload)
+                except Exception as e:
+                    return web.Response(status=400, text=str(e))
+            with self._lock:
+                self._counter += 1
+                qid = self._counter
+            key = pointer_from(qid, self.route, "rest")
+            from pathway_tpu.internals.keys import pointers_to_keys
+
+            kb = pointers_to_keys([key]).tobytes()
+            loop = asyncio.get_running_loop()
+            future: asyncio.Future = loop.create_future()
+            self.futures[kb] = future
+            row = {}
+            for name, col in self.schema.columns().items():
+                v = payload.get(name, col.default_value if col.has_default else None)
+                if col.dtype.strip_optional() == dt.JSON and v is not None and not isinstance(v, Json):
+                    v = Json(v)
+                row[name] = v
+            source.push(row, key=key, diff=1)
+            result = await future
+            self.futures.pop(kb, None)
+            if self.delete_completed_queries:
+                source.push(row, key=key, diff=-1)
+            if isinstance(result, (dict, list)):
+                return web.json_response(result)
+            if isinstance(result, Json):
+                return web.json_response(result.value)
+            return web.json_response(result)
+
+        self.webserver._register(self.route, self.methods, handler)
+        # block forever: the server lives until the process exits
+        threading.Event().wait()
+
+    def resolve(self, key: Pointer, result: Any) -> None:
+        from pathway_tpu.internals.keys import pointers_to_keys
+
+        kb = pointers_to_keys([key]).tobytes()
+        future = self.futures.get(kb)
+        if future is not None and self.webserver._loop is not None:
+            self.webserver._loop.call_soon_threadsafe(
+                lambda: future.set_result(result) if not future.done() else None
+            )
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    schema: sch.SchemaMetaclass | None = None,
+    methods: Sequence[str] = ("POST",),
+    autocommit_duration_ms: int | None = 50,
+    keep_queries: bool | None = None,
+    delete_completed_queries: bool = False,
+    request_validator: Any = None,
+) -> tuple[Table, Any]:
+    """Expose an HTTP endpoint as a streaming table; returns (queries, response_writer)."""
+    if webserver is None:
+        webserver = PathwayWebserver(host=host or "0.0.0.0", port=port or 8080)
+    if schema is None:
+        schema = sch.schema_from_types(query=str)
+    subject = RestServerSubject(
+        webserver, route, methods, schema, delete_completed_queries, request_validator
+    )
+
+    class _Runner:
+        def run(self, source: StreamingDataSource) -> None:
+            subject.run(source)
+
+    source = StreamingDataSource(subject=_Runner(), autocommit_ms=autocommit_duration_ms)
+    node = G.add_node(pg.InputNode(source=source, streaming=True, name=f"rest:{route}"))
+    queries = Table(node, schema, name="rest_queries")
+
+    def response_writer(result_table: Table, result_column: str = "result") -> None:
+        def on_change(key: Pointer, row: dict, time: int, is_addition: bool) -> None:
+            if is_addition:
+                subject.resolve(key, _jsonable(row.get(result_column)))
+
+        from pathway_tpu.io._subscribe import subscribe
+
+        subscribe(result_table, on_change)
+
+    return queries, response_writer
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, Pointer):
+        return repr(v)
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    import numpy as np
+
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v

@@ -1,0 +1,70 @@
+"""Kafka connector (parity: reference ``io/kafka`` over ``data_storage.rs:692``).
+
+The execution image has no Kafka client library; the connector raises a clear error at call
+time. ``read_from_iterable`` offers the same Table surface fed from any message iterator, which
+is what the streaming benchmarks use.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+from pathway_tpu.internals import schema as sch
+
+
+def _no_client() -> None:
+    raise ImportError(
+        "no Kafka client library (confluent_kafka / kafka-python) is available in this "
+        "environment; use pw.io.kafka.read_from_iterable(...) or pw.io.python.read(...) "
+        "to feed messages from your own consumer"
+    )
+
+
+def read(
+    rdkafka_settings: dict,
+    topic: str | None = None,
+    *,
+    schema: Any = None,
+    format: str = "raw",
+    autocommit_duration_ms: int | None = 1500,
+    **kwargs: Any,
+) -> Any:
+    try:
+        import confluent_kafka  # noqa: F401
+    except ImportError:
+        _no_client()
+
+
+def write(table: Any, rdkafka_settings: dict, topic_name: str | None = None, **kwargs: Any) -> None:
+    try:
+        import confluent_kafka  # noqa: F401
+    except ImportError:
+        _no_client()
+
+
+def read_from_iterable(
+    messages: Iterable[bytes | str | dict],
+    *,
+    schema: Any = None,
+    format: str = "json",
+    autocommit_duration_ms: int | None = 100,
+) -> Any:
+    """Feed a Kafka-shaped message stream from any iterable (tests/benchmarks)."""
+    from pathway_tpu.io.python import ConnectorSubject, read as py_read
+
+    if schema is None:
+        schema = sch.schema_from_types(data=str)
+
+    class _IterSubject(ConnectorSubject):
+        def run(self) -> None:
+            for msg in messages:
+                if isinstance(msg, dict):
+                    self.next(**msg)
+                elif format == "json":
+                    rec = json.loads(msg)
+                    self.next(**{k: rec.get(k) for k in schema.column_names()})
+                else:
+                    self.next(data=msg if isinstance(msg, str) else msg.decode())
+
+    return py_read(_IterSubject(), schema=schema, autocommit_duration_ms=autocommit_duration_ms)

@@ -1,0 +1,15 @@
+"""Null sink (parity: reference ``io/null``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+
+
+def write(table: Any, name: str | None = None) -> None:
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        pass
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback))

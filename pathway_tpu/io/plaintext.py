@@ -1,0 +1,12 @@
+"""Plaintext connector (parity: reference ``io/plaintext``)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from pathway_tpu.io import fs
+
+
+def read(path: str | Path, *, mode: str = "streaming", **kwargs: Any):
+    return fs.read(path, format="plaintext", mode=mode, **kwargs)

@@ -1,0 +1,95 @@
+"""Python connector: user-scripted streaming sources.
+
+Parity: reference ``io/python/__init__.py:49`` (``ConnectorSubject``) feeding the engine's
+``PythonReader`` (``src/connectors/data_storage.rs:843``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+from pathway_tpu.engine.datasource import StreamingDataSource
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import Pointer, pointer_from
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+class ConnectorSubject:
+    """Subclass and implement ``run``; call ``self.next(**values)`` to emit rows."""
+
+    _source: StreamingDataSource | None = None
+    _schema: sch.SchemaMetaclass | None = None
+
+    def run(self, source: StreamingDataSource | None = None) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    # -- emit API -----------------------------------------------------------
+
+    def next(self, **kwargs: Any) -> None:
+        self._emit(kwargs)
+
+    def next_json(self, message: dict) -> None:
+        self._emit(dict(message))
+
+    def next_str(self, message: str) -> None:
+        self._emit({"data": message})
+
+    def next_bytes(self, message: bytes) -> None:
+        self._emit({"data": message})
+
+    def _emit(self, values: Dict[str, Any], diff: int = 1) -> None:
+        key = None
+        pk = self._schema.primary_key_columns() if self._schema else None
+        if pk:
+            key = pointer_from(*(values[c] for c in pk))
+        assert self._source is not None, "subject not attached to a running graph"
+        self._source.push(values, key=key, diff=diff)
+
+    def _remove(self, values: Dict[str, Any]) -> None:
+        self._emit(values, diff=-1)
+
+    def commit(self) -> None:
+        pass  # commits are driven by the engine's autocommit loop
+
+    def close(self) -> None:
+        assert self._source is not None
+        self._source.close()
+
+    def on_stop(self) -> None:
+        pass
+
+    @property
+    def _deletions_enabled(self) -> bool:
+        return True
+
+
+class _SubjectRunner:
+    def __init__(self, subject: ConnectorSubject):
+        self.subject = subject
+
+    def run(self, source: StreamingDataSource) -> None:
+        self.subject._source = source
+        try:
+            self.subject.run()
+        finally:
+            self.subject.on_stop()
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: sch.SchemaMetaclass,
+    autocommit_duration_ms: int | None = 100,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    source = StreamingDataSource(
+        subject=_SubjectRunner(subject), autocommit_ms=autocommit_duration_ms
+    )
+    subject._schema = schema
+    node = G.add_node(pg.InputNode(source=source, streaming=True, name=name or "python"))
+    return Table(node, schema, name=name or "python")

@@ -1,0 +1,51 @@
+"""S3 connector (parity: reference ``io/s3`` over ``scanner/s3.rs``).
+
+No S3 client library is baked into this image; reads over ``s3://`` URIs raise a clear error,
+while local paths (including mounted buckets) delegate to the fs connector so pipelines written
+against this API run anywhere the data is reachable as files.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io import fs
+
+
+class AwsS3Settings:
+    def __init__(
+        self,
+        *,
+        bucket_name: str | None = None,
+        access_key: str | None = None,
+        secret_access_key: str | None = None,
+        region: str | None = None,
+        endpoint: str | None = None,
+        with_path_style: bool = False,
+    ):
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.region = region
+        self.endpoint = endpoint
+        self.with_path_style = with_path_style
+
+
+def read(
+    path: str,
+    *,
+    aws_s3_settings: AwsS3Settings | None = None,
+    format: str = "plaintext",
+    schema: Any = None,
+    mode: str = "streaming",
+    **kwargs: Any,
+) -> Any:
+    if str(path).startswith("s3://"):
+        try:
+            import boto3  # noqa: F401
+        except ImportError:
+            raise ImportError(
+                "no S3 client library (boto3) in this environment; mount the bucket as a "
+                "filesystem or pass a local path"
+            )
+    return fs.read(path, format=format, schema=schema, mode=mode, **kwargs)

@@ -1,0 +1,71 @@
+"""SQLite connector (parity: reference ``data_storage.rs:1415`` SqliteReader)."""
+
+from __future__ import annotations
+
+import sqlite3
+import time
+from typing import Any
+
+from pathway_tpu.engine.datasource import StreamingDataSource
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+class _SqliteSubject:
+    def __init__(self, path: str, table_name: str, schema: sch.SchemaMetaclass, mode: str, poll_interval: float = 0.5):
+        self.path = path
+        self.table_name = table_name
+        self.schema = schema
+        self.mode = mode
+        self.poll_interval = poll_interval
+
+    def run(self, source: StreamingDataSource) -> None:
+        last_rows: dict = {}
+        names = self.schema.column_names()
+        while True:
+            conn = sqlite3.connect(self.path)
+            try:
+                cur = conn.execute(
+                    f"SELECT rowid, {', '.join(names)} FROM {self.table_name}"
+                )
+                current = {}
+                for rec in cur.fetchall():
+                    rowid, values = rec[0], dict(zip(names, rec[1:]))
+                    current[rowid] = values
+            finally:
+                conn.close()
+            for rowid, values in current.items():
+                if rowid not in last_rows:
+                    source.push(values, diff=1)
+                elif last_rows[rowid] != values:
+                    source.push(last_rows[rowid], diff=-1)
+                    source.push(values, diff=1)
+            for rowid, values in last_rows.items():
+                if rowid not in current:
+                    source.push(values, diff=-1)
+            last_rows = current
+            if self.mode != "streaming":
+                return
+            time.sleep(self.poll_interval)
+
+
+def read(
+    path: str,
+    table_name: str,
+    schema: sch.SchemaMetaclass,
+    *,
+    mode: str = "streaming",
+    autocommit_duration_ms: int | None = 100,
+    **kwargs: Any,
+) -> Table:
+    subject = _SqliteSubject(path, table_name, schema, mode)
+
+    class _Runner:
+        def run(self, source: StreamingDataSource) -> None:
+            subject.run(source)
+
+    source = StreamingDataSource(subject=_Runner(), autocommit_ms=autocommit_duration_ms)
+    node = G.add_node(pg.InputNode(source=source, streaming=mode == "streaming", name="sqlite"))
+    return Table(node, schema, name="sqlite")

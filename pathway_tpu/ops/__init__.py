@@ -1,0 +1,1 @@
+"""Dense TPU kernels: KNN search, segment reductions, hashing helpers."""

@@ -1,0 +1,65 @@
+"""Persistence configuration — checkpoint/resume.
+
+Parity: reference ``python/pathway/persistence/__init__.py`` (``Backend.filesystem/s3/mock``
+``:27-71``, ``Config`` ``:88``) over ``src/persistence/``. The engine journals input snapshots
+per connector and checkpoints stateful-operator state at commit boundaries; resume replays the
+journal then continues from stored offsets (see ``pathway_tpu/persistence/engine.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List
+
+
+class Backend:
+    kind = "none"
+
+    def __init__(self, root: str | None = None):
+        self.root = root
+
+    @classmethod
+    def filesystem(cls, path: str | os.PathLike) -> "Backend":
+        b = cls(str(path))
+        b.kind = "filesystem"
+        return b
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        b = cls(root_path)
+        b.kind = "s3"
+        return b
+
+    @classmethod
+    def azure(cls, root_path: str, account: Any = None, **kw: Any) -> "Backend":
+        b = cls(root_path)
+        b.kind = "azure"
+        return b
+
+    @classmethod
+    def mock(cls, events: Any = None) -> "Backend":
+        b = cls(None)
+        b.kind = "mock"
+        b.events = events
+        return b
+
+
+class Config:
+    def __init__(
+        self,
+        backend: Backend | None = None,
+        *,
+        snapshot_interval_ms: int = 0,
+        snapshot_access: Any = None,
+        persistence_mode: Any = None,
+        continue_after_replay: bool = True,
+    ):
+        self.backend = backend
+        self.snapshot_interval_ms = snapshot_interval_ms
+        self.snapshot_access = snapshot_access
+        self.persistence_mode = persistence_mode
+        self.continue_after_replay = continue_after_replay
+
+    @classmethod
+    def simple_config(cls, backend: Backend, **kwargs: Any) -> "Config":
+        return cls(backend, **kwargs)

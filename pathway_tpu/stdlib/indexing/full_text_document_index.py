@@ -1,0 +1,17 @@
+"""Full-text document index preset (parity: reference ``full_text_document_index.py``)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+
+def default_full_text_document_index(
+    data_column: expr.ColumnReference,
+    data_table: Table,
+    *,
+    metadata_column: expr.ColumnReference | None = None,
+) -> DataIndex:
+    return DataIndex(data_table, TantivyBM25(data_column, metadata_column))

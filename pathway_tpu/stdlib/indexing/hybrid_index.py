@@ -1,0 +1,87 @@
+"""Hybrid retrieval with reciprocal-rank fusion.
+
+Parity: reference ``stdlib/indexing/hybrid_index.py:14`` (``HybridIndex`` — RRF over any
+number of inner indexes, typically BM25 + KNN).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
+from pathway_tpu.stdlib.indexing.retrievers import AbstractRetrieverFactory
+
+
+class _HybridInstance:
+    def __init__(self, instances: List[Any], k: float):
+        self.instances = instances
+        self.k = k
+
+    def add(self, key: Any, value: Any, filter_data: Any = None) -> None:
+        # value is a tuple: one entry per inner index (e.g. (vector, text))
+        values = value if isinstance(value, tuple) and len(value) == len(self.instances) else (
+            (value,) * len(self.instances)
+        )
+        for inst, v in zip(self.instances, values):
+            inst.add(key, v, filter_data)
+
+    def remove(self, key: Any) -> None:
+        for inst in self.instances:
+            inst.remove(key)
+
+    def search(self, query: Any, limit: int, filter_expr: Any = None) -> List[tuple]:
+        queries = query if isinstance(query, tuple) and len(query) == len(self.instances) else (
+            (query,) * len(self.instances)
+        )
+        fused: Dict[Any, float] = {}
+        for inst, q in zip(self.instances, queries):
+            results = inst.search(q, max(limit * 2, 10), filter_expr)
+            for rank, (key, _score) in enumerate(results):
+                fused[key] = fused.get(key, 0.0) + 1.0 / (self.k + rank + 1)
+        ranked = sorted(fused.items(), key=lambda kv: -kv[1])[:limit]
+        return [(key, score) for key, score in ranked]
+
+
+class HybridIndex(InnerIndex):
+    def __init__(self, inner_indexes: List[InnerIndex], *, k: float = 60.0):
+        first = inner_indexes[0]
+        super().__init__(first.data_column, first.metadata_column)
+        self.inner_indexes = inner_indexes
+        self.k = k
+
+    def make_instance_factory(self) -> Any:
+        factories = [ix.make_instance_factory() for ix in self.inner_indexes]
+        k = self.k
+        return lambda: _HybridInstance([f() for f in factories], k)
+
+    def preprocess_query(self, query_column: expr.ColumnReference) -> expr.ColumnExpression:
+        processed = [ix.preprocess_query(query_column) for ix in self.inner_indexes]
+        return expr.make_tuple(*processed)
+
+
+@dataclass
+class HybridIndexFactory(AbstractRetrieverFactory):
+    retriever_factories: List[AbstractRetrieverFactory] = field(default_factory=list)
+    k: float = 60.0
+
+    def build_index(
+        self,
+        data_column: expr.ColumnReference,
+        data_table: Table,
+        metadata_column: expr.ColumnReference | None = None,
+        **kwargs: Any,
+    ) -> DataIndex:
+        inner = [
+            f.build_inner_index(data_column, metadata_column)
+            for f in self.retriever_factories
+        ]
+        hybrid = HybridIndex(inner, k=self.k)
+        # the hybrid instance receives one value per sub-index; data column stays shared
+        return _HybridDataIndex(data_table, hybrid)
+
+
+class _HybridDataIndex(DataIndex):
+    pass

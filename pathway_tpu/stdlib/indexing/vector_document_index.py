@@ -1,0 +1,37 @@
+"""Vector document index presets (parity: reference ``vector_document_index.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnMetricKind,
+)
+
+
+def default_vector_document_index(
+    data_column: expr.ColumnReference,
+    data_table: Table,
+    *,
+    embedder: Any = None,
+    dimensions: int | None = None,
+    metadata_column: expr.ColumnReference | None = None,
+) -> DataIndex:
+    if dimensions is None:
+        from pathway_tpu.stdlib.indexing.nearest_neighbors import _probe_embedder_dims
+
+        dimensions = _probe_embedder_dims(embedder)
+    return DataIndex(
+        data_table,
+        BruteForceKnn(
+            data_column,
+            metadata_column,
+            dimensions=dimensions,
+            metric=BruteForceKnnMetricKind.COS,
+            embedder=embedder,
+        ),
+    )

@@ -1,0 +1,6 @@
+"""ML stdlib (parity: reference ``stdlib/ml``)."""
+
+from pathway_tpu.stdlib.ml import index
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+__all__ = ["KNNIndex", "index"]

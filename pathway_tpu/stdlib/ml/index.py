@@ -1,0 +1,94 @@
+"""KNNIndex — the classic python-side index API.
+
+Parity: reference ``stdlib/ml/index.py:9`` (wraps the LSH flat classifier there; here it wraps
+the TPU brute-force / LSH kernels through DataIndex). This is BASELINE benchmark config #1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnMetricKind,
+    LshKnn,
+)
+
+
+class KNNIndex:
+    """K-nearest-neighbors over a vector column.
+
+    ``get_nearest_items(query_embeddings, k)`` returns, per query row, tuples of the data
+    table's columns for the k nearest vectors (reference semantics incl. ``query_id`` and
+    metadata filters).
+    """
+
+    def __init__(
+        self,
+        data_embedding: expr.ColumnReference,
+        data: Table,
+        n_dimensions: int,
+        n_or: int = 20,
+        n_and: int = 10,
+        bucket_length: float = 10.0,
+        distance_type: str = "euclidean",
+        metadata: expr.ColumnReference | None = None,
+        exact: bool = True,
+    ):
+        self.data = data
+        if exact:
+            metric = (
+                BruteForceKnnMetricKind.COS
+                if distance_type == "cosine"
+                else BruteForceKnnMetricKind.L2SQ
+            )
+            inner = BruteForceKnn(
+                data_embedding, metadata, dimensions=n_dimensions, metric=metric
+            )
+        else:
+            inner = LshKnn(
+                data_embedding,
+                metadata,
+                dimensions=n_dimensions,
+                n_or=n_or,
+                n_and=n_and,
+                bucket_length=bucket_length,
+                distance_type=distance_type,
+            )
+        self.index = DataIndex(data, inner)
+
+    def get_nearest_items(
+        self,
+        query_embedding: expr.ColumnReference,
+        k: Any = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter: expr.ColumnExpression | None = None,
+    ) -> Table:
+        result = self.index.query(
+            query_embedding,
+            number_of_matches=k,
+            collapse_rows=collapse_rows,
+            metadata_filter=metadata_filter,
+        )
+        if with_distances:
+            result = result.with_columns(dist=result._pw_index_reply_score)
+        return result
+
+    def get_nearest_items_asof_now(
+        self,
+        query_embedding: expr.ColumnReference,
+        k: Any = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter: expr.ColumnExpression | None = None,
+    ) -> Table:
+        return self.index.query_as_of_now(
+            query_embedding,
+            number_of_matches=k,
+            collapse_rows=collapse_rows,
+            metadata_filter=metadata_filter,
+        )

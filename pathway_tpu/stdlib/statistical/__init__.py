@@ -1,0 +1,52 @@
+"""Statistical helpers (parity: reference ``stdlib/statistical`` — interpolate)."""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.table import Table
+
+
+class InterpolateMode(enum.Enum):
+    LINEAR = "linear"
+
+
+def interpolate(
+    table: Table, timestamp: Any, *values: Any, mode: InterpolateMode | None = None
+) -> Table:
+    """Linearly interpolate missing (None) values along ``timestamp`` order."""
+    mode = mode or InterpolateMode.LINEAR
+    sorted_t = table.sort(timestamp)
+    prev_t = table.ix(sorted_t.prev, optional=True)
+    next_t = table.ix(sorted_t.next, optional=True)
+    ts_name = timestamp.name if hasattr(timestamp, "name") else str(timestamp)
+
+    out_exprs: dict[str, Any] = {}
+    for v in values:
+        name = v.name if hasattr(v, "name") else str(v)
+
+        def make_interp(name: str = name) -> Any:
+            def interp(t: Any, cur: Any, pt: Any, pv: Any, nt: Any, nv: Any) -> Any:
+                if cur is not None:
+                    return cur
+                if pv is not None and nv is not None and nt != pt:
+                    return pv + (nv - pv) * (t - pt) / (nt - pt)
+                if pv is not None:
+                    return pv
+                return nv
+
+            return expr.apply_with_type(
+                interp,
+                float,
+                table[ts_name],
+                table[name],
+                prev_t[ts_name],
+                prev_t[name],
+                next_t[ts_name],
+                next_t[name],
+            )
+
+        out_exprs[name] = make_interp()
+    return table.with_columns(**out_exprs)

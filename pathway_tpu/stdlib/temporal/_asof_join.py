@@ -1,0 +1,263 @@
+"""Asof joins (parity: reference ``stdlib/temporal/_asof_join.py:479-1000`` and
+``_asof_now_join.py:176-332``).
+
+Mechanism: the right side aggregates per join-key into a sorted (time, rowid) tuple; each left
+row binary-searches it for the latest-not-after (backward) / earliest-not-before (forward)
+match. Incremental via groupby+ix (right updates re-trigger affected left rows).
+"""
+
+from __future__ import annotations
+
+import bisect
+import enum
+from typing import Any, Dict
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.joins import JoinKind
+from pathway_tpu.internals.reducers import reducers
+from pathway_tpu.internals.table import Table, _name_of
+from pathway_tpu.internals import thisclass
+
+
+class AsofDirection(enum.Enum):
+    BACKWARD = "backward"
+    FORWARD = "forward"
+    NEAREST = "nearest"
+
+
+Direction = AsofDirection
+
+
+class AsofJoinResult:
+    def __init__(
+        self,
+        left: Table,
+        right: Table,
+        left_time: expr.ColumnExpression,
+        right_time: expr.ColumnExpression,
+        on: tuple,
+        kind: JoinKind,
+        direction: AsofDirection,
+        defaults: Dict[Any, Any] | None = None,
+    ):
+        self.left = left
+        self.right = right
+        self.left_time = left_time
+        self.right_time = right_time
+        self.on = on
+        self.kind = kind
+        self.direction = direction
+        self.defaults = defaults or {}
+
+    def select(self, *args: Any, **kwargs: Any) -> Table:
+        left, right = self.left, self.right
+        left_on: list[expr.ColumnExpression] = []
+        right_on: list[expr.ColumnExpression] = []
+        for cond in self.on:
+            cond = thisclass.substitute(cond, {thisclass.left: left, thisclass.right: right})
+            import operator
+
+            assert (
+                isinstance(cond, expr.ColumnBinaryOpExpression)
+                and cond._operator is operator.eq
+            ), "asof_join conditions must be equalities"
+            a, b = cond._left, cond._right
+            if any(r.table is left for r in a._column_refs):
+                left_on.append(a)
+                right_on.append(b)
+            else:
+                left_on.append(b)
+                right_on.append(a)
+
+        rt = right.with_columns(_pw_t=self.right_time)
+        # aggregate sorted (time, id) tuples per right key
+        rt2 = rt.with_columns(_pw_pair=expr.make_tuple(rt._pw_t, rt.id))
+        if right_on:
+            rkey = rt2.pointer_from(*[_rebind_to(e, right, rt2) for e in right_on])
+            keyed = rt2.with_columns(_pw_key=rkey)
+            agg = keyed.groupby(keyed._pw_key).reduce(
+                _pw_pairs=reducers.sorted_tuple(keyed._pw_pair)
+            )
+        else:
+            agg = rt2.groupby().reduce(_pw_pairs=reducers.sorted_tuple(rt2._pw_pair))
+
+        lt = left.with_columns(_pw_t=self.left_time)
+        if right_on:
+            lkey = lt.pointer_from(*[_rebind_to(e, left, lt) for e in left_on])
+        else:
+            lkey = lt.pointer_from()
+        pairs = agg.ix(lkey, optional=True)._pw_pairs
+
+        direction = self.direction
+
+        def pick(mytime: Any, pairs_tuple: Any) -> Any:
+            if not pairs_tuple:
+                return None
+            times = [p[0] for p in pairs_tuple]
+            if direction == AsofDirection.BACKWARD:
+                i = bisect.bisect_right(times, mytime) - 1
+                return pairs_tuple[i][1] if i >= 0 else None
+            if direction == AsofDirection.FORWARD:
+                i = bisect.bisect_left(times, mytime)
+                return pairs_tuple[i][1] if i < len(pairs_tuple) else None
+            # nearest
+            i = bisect.bisect_left(times, mytime)
+            best = None
+            for j in (i - 1, i):
+                if 0 <= j < len(pairs_tuple):
+                    d = abs(times[j] - mytime)
+                    if best is None or d < best[0]:
+                        best = (d, pairs_tuple[j][1])
+            return best[1] if best else None
+
+        match_ptr = expr.apply_with_type(pick, Any, lt._pw_t, pairs)
+        with_match = lt.with_columns(_pw_match=match_ptr)
+        if self.kind in (JoinKind.INNER,):
+            with_match = with_match.filter(with_match._pw_match.is_not_none())
+        rmatch = right.ix(with_match._pw_match, optional=True)
+
+        out_exprs: Dict[str, Any] = {}
+        for arg in args:
+            out_exprs[_name_of(arg)] = arg
+        out_exprs.update(kwargs)
+        resolved = {}
+        for name, e in out_exprs.items():
+            e = thisclass.substitute(
+                e, {thisclass.left: left, thisclass.right: right, thisclass.this: left}
+            )
+            resolved[name] = _rebind_pair(e, left, with_match, right, rmatch, self.defaults)
+        return with_match.select(**resolved)
+
+
+def _name_of_expr(e: Any, table: Table) -> str:
+    return e.name if isinstance(e, expr.ColumnReference) else str(e)
+
+
+def _rebind_to(e: Any, old: Table, new: Table) -> Any:
+    if isinstance(e, expr.ColumnReference):
+        return new[e.name] if e.table is old else e
+    if isinstance(e, expr.ColumnExpression):
+        import copy
+
+        clone = copy.copy(e)
+        for attr, value in list(vars(e).items()):
+            if isinstance(value, expr.ColumnExpression):
+                setattr(clone, attr, _rebind_to(value, old, new))
+            elif isinstance(value, tuple) and any(isinstance(v, expr.ColumnExpression) for v in value):
+                setattr(
+                    clone,
+                    attr,
+                    tuple(
+                        _rebind_to(v, old, new) if isinstance(v, expr.ColumnExpression) else v
+                        for v in value
+                    ),
+                )
+        return clone
+    return e
+
+
+def _rebind_pair(
+    e: Any, left: Table, new_left: Table, right: Table, rmatch: Table, defaults: Dict
+) -> Any:
+    if isinstance(e, expr.ColumnReference):
+        if e.table is left:
+            return new_left[e.name]
+        if e.table is right:
+            base = rmatch[e.name]
+            if e.name in defaults or e in defaults:
+                default = defaults.get(e.name, defaults.get(e))
+                return expr.coalesce(base, default)
+            return base
+        return e
+    if isinstance(e, expr.ColumnExpression):
+        import copy
+
+        clone = copy.copy(e)
+        for attr, value in list(vars(e).items()):
+            if isinstance(value, expr.ColumnExpression):
+                setattr(clone, attr, _rebind_pair(value, left, new_left, right, rmatch, defaults))
+            elif isinstance(value, tuple) and any(isinstance(v, expr.ColumnExpression) for v in value):
+                setattr(
+                    clone,
+                    attr,
+                    tuple(
+                        _rebind_pair(v, left, new_left, right, rmatch, defaults)
+                        if isinstance(v, expr.ColumnExpression)
+                        else v
+                        for v in value
+                    ),
+                )
+        return clone
+    return e
+
+
+def asof_join(
+    self: Table,
+    other: Table,
+    self_time: Any,
+    other_time: Any,
+    *on: Any,
+    how: JoinKind = JoinKind.LEFT,
+    defaults: Dict | None = None,
+    direction: AsofDirection = AsofDirection.BACKWARD,
+    behavior: Any = None,
+) -> AsofJoinResult:
+    defaults_by_name = {}
+    if defaults:
+        for k, v in defaults.items():
+            defaults_by_name[k.name if hasattr(k, "name") else k] = v
+    return AsofJoinResult(
+        self,
+        other,
+        self._resolve(self_time),
+        other._resolve(other_time),
+        on,
+        how,
+        direction,
+        defaults_by_name,
+    )
+
+
+def asof_join_inner(self: Table, other: Table, self_time: Any, other_time: Any, *on: Any, **kw: Any) -> AsofJoinResult:
+    kw.setdefault("how", JoinKind.INNER)
+    return asof_join(self, other, self_time, other_time, *on, **kw)
+
+
+def asof_join_left(self: Table, other: Table, self_time: Any, other_time: Any, *on: Any, **kw: Any) -> AsofJoinResult:
+    kw.setdefault("how", JoinKind.LEFT)
+    return asof_join(self, other, self_time, other_time, *on, **kw)
+
+
+def asof_join_right(self: Table, other: Table, self_time: Any, other_time: Any, *on: Any, **kw: Any) -> AsofJoinResult:
+    kw.setdefault("how", JoinKind.RIGHT)
+    return asof_join(self, other, self_time, other_time, *on, **kw)
+
+
+def asof_join_outer(self: Table, other: Table, self_time: Any, other_time: Any, *on: Any, **kw: Any) -> AsofJoinResult:
+    kw.setdefault("how", JoinKind.OUTER)
+    return asof_join(self, other, self_time, other_time, *on, **kw)
+
+
+# -- asof_now: query-stream semantics (no retraction of answers) -------------
+
+
+def asof_now_join(self: Table, other: Table, *on: Any, how: JoinKind = JoinKind.INNER, **kw: Any):
+    """Join where ``self`` is a query stream answered as of now (reference
+    ``_asof_now_join.py:176``)."""
+    forgotten = self._forget_immediately()
+    result = forgotten.join(other, *on, how=how, **kw)
+
+    class _AsofNowJoinResult:
+        def select(self, *args: Any, **kwargs: Any) -> Table:
+            selected = result.select(*args, **kwargs)
+            return selected._filter_out_results_of_forgetting()
+
+    return _AsofNowJoinResult()
+
+
+def asof_now_join_inner(self: Table, other: Table, *on: Any, **kw: Any):
+    return asof_now_join(self, other, *on, how=JoinKind.INNER, **kw)
+
+
+def asof_now_join_left(self: Table, other: Table, *on: Any, **kw: Any):
+    return asof_now_join(self, other, *on, how=JoinKind.LEFT, **kw)

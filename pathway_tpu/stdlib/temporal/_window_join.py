@@ -1,0 +1,131 @@
+"""Window joins (parity: reference ``stdlib/temporal/_window_join.py:156-996``).
+
+A window join is an interval/equality join on window membership: both sides assign windows,
+then join on (window, *on).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.joins import JoinKind
+from pathway_tpu.internals.table import Table, _name_of
+from pathway_tpu.internals import thisclass
+from pathway_tpu.stdlib.temporal._window import Window
+
+
+class WindowJoinResult:
+    def __init__(
+        self,
+        left: Table,
+        right: Table,
+        left_time: expr.ColumnExpression,
+        right_time: expr.ColumnExpression,
+        window: Window,
+        on: tuple,
+        kind: JoinKind,
+    ):
+        self.left = left
+        self.right = right
+        self.left_time = left_time
+        self.right_time = right_time
+        self.window = window
+        self.on = on
+        self.kind = kind
+
+    def select(self, *args: Any, **kwargs: Any) -> Table:
+        lt = self.window.assign(self.left, self.left_time)
+        rt = self.window.assign(self.right, self.right_time)
+
+        conditions = [
+            lt._pw_window_start == rt._pw_window_start,
+            lt._pw_window_end == rt._pw_window_end,
+        ]
+        for cond in self.on:
+            cond = thisclass.substitute(
+                cond, {thisclass.left: self.left, thisclass.right: self.right}
+            )
+            conditions.append(_rebind2(cond, self.left, lt, self.right, rt))
+
+        joined = self._join(lt, rt, conditions)
+
+        out_exprs: Dict[str, Any] = {}
+        for arg in args:
+            out_exprs[_name_of(arg)] = arg
+        out_exprs.update(kwargs)
+        resolved = {}
+        for name, e in out_exprs.items():
+            e = thisclass.substitute(
+                e, {thisclass.left: self.left, thisclass.right: self.right}
+            )
+            if isinstance(e, thisclass.ThisColumnReference) and e.name in (
+                "_pw_window",
+                "_pw_window_start",
+                "_pw_window_end",
+            ):
+                e = lt[e.name]
+            resolved[name] = _rebind2(e, self.left, lt, self.right, rt)
+        return joined.select(**resolved)
+
+    def _join(self, lt: Table, rt: Table, conditions: list) -> Any:
+        return lt.join(rt, *conditions, how=self.kind)
+
+
+def _rebind2(e: Any, old_left: Table, new_left: Table, old_right: Table, new_right: Table) -> Any:
+    if isinstance(e, expr.ColumnReference):
+        if e.table is old_left:
+            return new_left[e.name]
+        if e.table is old_right:
+            return new_right[e.name]
+        return e
+    if isinstance(e, expr.ColumnExpression):
+        import copy
+
+        clone = copy.copy(e)
+        for attr, value in list(vars(e).items()):
+            if isinstance(value, expr.ColumnExpression):
+                setattr(clone, attr, _rebind2(value, old_left, new_left, old_right, new_right))
+            elif isinstance(value, tuple) and any(isinstance(v, expr.ColumnExpression) for v in value):
+                setattr(
+                    clone,
+                    attr,
+                    tuple(
+                        _rebind2(v, old_left, new_left, old_right, new_right)
+                        if isinstance(v, expr.ColumnExpression)
+                        else v
+                        for v in value
+                    ),
+                )
+        return clone
+    return e
+
+
+def window_join(
+    self: Table,
+    other: Table,
+    self_time: Any,
+    other_time: Any,
+    window: Window,
+    *on: Any,
+    how: JoinKind = JoinKind.INNER,
+) -> WindowJoinResult:
+    return WindowJoinResult(
+        self, other, self._resolve(self_time), other._resolve(other_time), window, on, how
+    )
+
+
+def window_join_inner(self: Table, other: Table, self_time: Any, other_time: Any, window: Window, *on: Any) -> WindowJoinResult:
+    return window_join(self, other, self_time, other_time, window, *on, how=JoinKind.INNER)
+
+
+def window_join_left(self: Table, other: Table, self_time: Any, other_time: Any, window: Window, *on: Any) -> WindowJoinResult:
+    return window_join(self, other, self_time, other_time, window, *on, how=JoinKind.LEFT)
+
+
+def window_join_right(self: Table, other: Table, self_time: Any, other_time: Any, window: Window, *on: Any) -> WindowJoinResult:
+    return window_join(self, other, self_time, other_time, window, *on, how=JoinKind.RIGHT)
+
+
+def window_join_outer(self: Table, other: Table, self_time: Any, other_time: Any, window: Window, *on: Any) -> WindowJoinResult:
+    return window_join(self, other, self_time, other_time, window, *on, how=JoinKind.OUTER)

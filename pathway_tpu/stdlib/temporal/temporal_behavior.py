@@ -1,0 +1,36 @@
+"""Window behaviors (parity: reference ``stdlib/temporal/temporal_behavior.py:29,83``).
+
+``common_behavior(delay, cutoff, keep_results)`` controls when window results are emitted
+(delay = buffer until time advances past start+delay), when late rows are ignored (cutoff),
+and whether closed windows keep or forget their results. ``exactly_once_behavior`` is the
+delay=cutoff special case. Engine mechanics mirror ``time_column.rs`` (buffer/forget/freeze).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+class Behavior:
+    pass
+
+
+@dataclass
+class CommonBehavior(Behavior):
+    delay: Any = None
+    cutoff: Any = None
+    keep_results: bool = True
+
+
+@dataclass
+class ExactlyOnceBehavior(Behavior):
+    shift: Any = None
+
+
+def common_behavior(delay: Any = None, cutoff: Any = None, keep_results: bool = True) -> CommonBehavior:
+    return CommonBehavior(delay, cutoff, keep_results)
+
+
+def exactly_once_behavior(shift: Any = None) -> ExactlyOnceBehavior:
+    return ExactlyOnceBehavior(shift)

@@ -1,0 +1,44 @@
+"""Time utilities (parity: reference ``stdlib/temporal/time_utils.py``)."""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any
+
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+
+
+def utc_now(refresh_rate: datetime.timedelta = datetime.timedelta(seconds=60)) -> Table:
+    """A single-row table holding the current UTC timestamp, refreshed periodically."""
+    import time
+
+    from pathway_tpu.io.python import ConnectorSubject, read
+    from pathway_tpu.internals.keys import pointer_from
+
+    class _Clock(ConnectorSubject):
+        def run(self) -> None:
+            key_row = {"timestamp_utc": None}
+            prev = None
+            while True:
+                now = datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
+                if prev is not None:
+                    self._emit({"timestamp_utc": prev}, diff=-1)
+                self._emit({"timestamp_utc": now}, diff=1)
+                prev = now
+                time.sleep(refresh_rate.total_seconds())
+
+    schema = sch.schema_from_types(timestamp_utc=datetime.datetime)
+    return read(_Clock(), schema=schema)
+
+
+def inactivity_detection(
+    events: Any,
+    allowed_inactivity_period: datetime.timedelta,
+    refresh_rate: datetime.timedelta = datetime.timedelta(seconds=1),
+    instance: Any = None,
+) -> tuple:
+    """Detect (inactivity_start, resumed) event streams (reference ``time_utils.py``)."""
+    raise NotImplementedError(
+        "inactivity_detection lands with streaming wall-clock triggers (round 2)"
+    )

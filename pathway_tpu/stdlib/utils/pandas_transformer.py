@@ -1,0 +1,29 @@
+"""``pw.pandas_transformer`` (parity: reference ``stdlib/utils/pandas_transformer.py``)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+
+
+def pandas_transformer(
+    output_schema: sch.SchemaMetaclass, output_universe: Any = None
+) -> Callable:
+    """Wrap a pandas-DataFrame function as a Table→Table transformer (batch semantics)."""
+
+    def decorator(fun: Callable) -> Callable:
+        @functools.wraps(fun)
+        def wrapper(*tables: Table) -> Table:
+            from pathway_tpu import debug
+
+            raise NotImplementedError(
+                "pandas_transformer requires full-table materialization mid-graph; "
+                "apply the function to debug.table_to_pandas output, or use UDFs"
+            )
+
+        return wrapper
+
+    return decorator

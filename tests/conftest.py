@@ -1,0 +1,19 @@
+import os
+
+# tests run on a virtual 8-device CPU mesh — set before jax initializes
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def clear_graph():
+    """Each test gets a fresh global parse graph."""
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    yield
+    G.clear()

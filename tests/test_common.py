@@ -1,0 +1,472 @@
+"""Core Table-API tests (modeled on reference ``python/pathway/tests/test_common.py``)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import T, assert_table_equality, assert_table_equality_wo_index, capture_rows
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = t.select(s=t.a + t.b, d=t.a - t.b, m=t.a * t.b, q=t.b / t.a)
+    rows = sorted(capture_rows(res), key=lambda r: r["s"])
+    assert rows == [
+        {"s": 3, "d": -1, "m": 2, "q": 2.0},
+        {"s": 7, "d": -1, "m": 12, "q": 4.0 / 3.0},
+    ]
+
+
+def test_select_this():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(doubled=pw.this.a * 2)
+    assert sorted(r["doubled"] for r in capture_rows(res)) == [2, 4]
+
+
+def test_filter():
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        4
+        """
+    )
+    res = t.filter(pw.this.a % 2 == 0)
+    assert sorted(r["a"] for r in capture_rows(res)) == [2, 4]
+
+
+def test_if_else_and_coalesce():
+    t = T(
+        """
+        a | b
+        1 | 10
+        2 | None
+        """
+    )
+    res = t.select(
+        x=pw.if_else(t.a > 1, t.a * 100, t.a),
+        y=pw.coalesce(t.b, 0),
+    )
+    rows = sorted(capture_rows(res), key=lambda r: r["x"])
+    assert rows == [{"x": 1, "y": 10}, {"x": 200, "y": 0}]
+
+
+def test_division_by_zero_poisons():
+    t = T(
+        """
+        a | b
+        6 | 2
+        5 | 0
+        """
+    )
+    res = t.select(q=pw.fill_error(t.a // t.b, -1))
+    assert sorted(r["q"] for r in capture_rows(res)) == [-1, 3]
+
+
+def test_concat():
+    t1 = T(
+        """
+        a
+        1
+        """
+    )
+    t2 = T(
+        """
+        a
+        2
+        """
+    )
+    res = t1.concat_reindex(t2)
+    assert sorted(r["a"] for r in capture_rows(res)) == [1, 2]
+
+
+def test_update_rows():
+    t1 = T(
+        """
+          | a
+        1 | 10
+        2 | 20
+        """
+    )
+    t2 = T(
+        """
+          | a
+        2 | 99
+        3 | 30
+        """
+    )
+    res = t1.update_rows(t2)
+    assert sorted(r["a"] for r in capture_rows(res)) == [10, 30, 99]
+
+
+def test_update_cells():
+    t1 = T(
+        """
+          | a  | b
+        1 | 10 | x
+        2 | 20 | y
+        """
+    )
+    t2 = T(
+        """
+          | a
+        2 | 99
+        """
+    )
+    res = t1.update_cells(t2)
+    rows = sorted(capture_rows(res), key=lambda r: r["b"])
+    assert rows == [{"a": 10, "b": "x"}, {"a": 99, "b": "y"}]
+
+
+def test_intersect_difference():
+    t1 = T(
+        """
+          | a
+        1 | 1
+        2 | 2
+        3 | 3
+        """
+    )
+    t2 = T(
+        """
+          | b
+        2 | x
+        3 | y
+        """
+    )
+    assert sorted(r["a"] for r in capture_rows(t1.intersect(t2))) == [2, 3]
+    assert sorted(r["a"] for r in capture_rows(t1.difference(t2))) == [1]
+
+
+def test_rename_without():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    res = t.rename_columns(c=pw.this.a).without("b")
+    assert capture_rows(res) == [{"c": 1}]
+
+
+def test_with_id_from():
+    t = T(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    res = t.with_id_from(t.a)
+    rows = capture_rows(res)
+    assert sorted(r["b"] for r in rows) == ["x", "y"]
+    # keys derived deterministically from a
+    again = t.with_id_from(t.a)
+    assert_table_equality(res, again)
+
+
+def test_flatten():
+    t = T(
+        """
+        w
+        abc
+        de
+        """
+    )
+    res = t.flatten(t.w)
+    assert sorted(r["w"] for r in capture_rows(res)) == ["a", "b", "c", "d", "e"]
+
+
+def test_groupby_reduce():
+    t = T(
+        """
+        cost | owner
+        100  | A
+        200  | A
+        50   | B
+        """
+    )
+    res = t.groupby(t.owner).reduce(
+        t.owner,
+        total=pw.reducers.sum(t.cost),
+        cnt=pw.reducers.count(),
+        mx=pw.reducers.max(t.cost),
+        mn=pw.reducers.min(t.cost),
+        avg=pw.reducers.avg(t.cost),
+    )
+    rows = sorted(capture_rows(res), key=lambda r: r["owner"])
+    assert rows == [
+        {"owner": "A", "total": 300, "cnt": 2, "mx": 200, "mn": 100, "avg": 150.0},
+        {"owner": "B", "total": 50, "cnt": 1, "mx": 50, "mn": 50, "avg": 50.0},
+    ]
+
+
+def test_groupby_argmin_argmax_tuple():
+    t = T(
+        """
+        cost | owner
+        100  | A
+        200  | A
+        50   | B
+        """
+    )
+    res = t.groupby(t.owner).reduce(
+        t.owner,
+        all_costs=pw.reducers.sorted_tuple(t.cost),
+    )
+    rows = sorted(capture_rows(res), key=lambda r: r["owner"])
+    assert rows == [
+        {"owner": "A", "all_costs": (100, 200)},
+        {"owner": "B", "all_costs": (50,)},
+    ]
+
+
+def test_groupby_expression_over_reducers():
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    res = t.reduce(rng=pw.reducers.max(t.a) - pw.reducers.min(t.a))
+    assert capture_rows(res) == [{"rng": 2}]
+
+
+def test_join_inner():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        2 | y
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        9 | x
+        8 | z
+        """
+    )
+    res = t1.join(t2, t1.k == t2.k).select(t1.a, t2.b)
+    assert capture_rows(res) == [{"a": 1, "b": 9}]
+
+
+def test_join_left_outer():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        2 | y
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        9 | x
+        """
+    )
+    res = t1.join_left(t2, t1.k == t2.k).select(t1.a, t2.b)
+    rows = sorted(capture_rows(res), key=lambda r: r["a"])
+    assert rows == [{"a": 1, "b": 9}, {"a": 2, "b": None}]
+
+    res_o = t1.join_outer(t2, t1.k == t2.k).select(t1.a, t2.b)
+    rows = sorted(capture_rows(res_o), key=lambda r: (r["a"] is None, r["a"]))
+    assert rows == [{"a": 1, "b": 9}, {"a": 2, "b": None}]
+
+
+def test_join_right():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        9 | x
+        8 | z
+        """
+    )
+    res = t1.join_right(t2, t1.k == t2.k).select(t1.a, t2.b)
+    rows = sorted(capture_rows(res), key=lambda r: r["b"])
+    assert rows == [{"a": None, "b": 8}, {"a": 1, "b": 9}]
+
+
+def test_ix():
+    t = T(
+        """
+        a | k
+        1 | x
+        2 | y
+        """
+    )
+    keyed = t.with_id_from(t.k)
+    source = T(
+        """
+        k
+        x
+        x
+        y
+        """
+    )
+    res = source.select(a=keyed.ix(source.pointer_from(source.k)).a)
+    assert sorted(r["a"] for r in capture_rows(res)) == [1, 1, 2]
+
+
+def test_sort():
+    t = T(
+        """
+        a
+        3
+        1
+        2
+        """
+    )
+    s = t.sort(t.a)
+    rows = capture_rows(t.with_columns(prev=s.prev, next=s.next, a=t.a))
+    by_a = {r["a"]: r for r in rows}
+    assert by_a[1]["prev"] is None
+    assert by_a[3]["next"] is None
+    assert by_a[2]["prev"] is not None and by_a[2]["next"] is not None
+
+
+def test_apply():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(b=pw.apply(lambda x: x * 10, t.a))
+    assert sorted(r["b"] for r in capture_rows(res)) == [10, 20]
+
+
+def test_udf():
+    @pw.udf
+    def inc(x: int) -> int:
+        return x + 1
+
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(b=inc(t.a))
+    assert sorted(r["b"] for r in capture_rows(res)) == [2, 3]
+
+
+def test_async_udf():
+    @pw.udf
+    async def double(x: int) -> int:
+        return x * 2
+
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(b=double(t.a))
+    assert sorted(r["b"] for r in capture_rows(res)) == [2, 4]
+
+
+def test_str_namespace():
+    t = T(
+        """
+        s
+        Hello
+        World
+        """
+    )
+    res = t.select(u=t.s.str.upper(), n=t.s.str.len(), sw=t.s.str.startswith("He"))
+    rows = sorted(capture_rows(res), key=lambda r: r["u"])
+    assert rows == [
+        {"u": "HELLO", "n": 5, "sw": True},
+        {"u": "WORLD", "n": 5, "sw": False},
+    ]
+
+
+def test_deduplicate():
+    t = T(
+        """
+        a | __time__ | __diff__
+        1 | 0        | 1
+        3 | 2        | 1
+        2 | 4        | 1
+        5 | 6        | 1
+        """
+    )
+    res = t.deduplicate(value=pw.this.a, acceptor=lambda new, old: new > old)
+    assert [r["a"] for r in capture_rows(res)] == [5]
+
+
+def test_iterate():
+    t = T(
+        """
+        a
+        1
+        5
+        """
+    )
+
+    def logic(t):
+        return dict(t=t.select(a=pw.if_else(t.a < 100, t.a * 2, t.a)))
+
+    res = pw.iterate(logic, t=t)
+    assert sorted(r["a"] for r in capture_rows(res.t)) == [128, 160]
+
+
+def test_update_stream_incremental_sum():
+    t = T(
+        """
+        v | __time__ | __diff__
+        1 | 0        | 1
+        2 | 2        | 1
+        1 | 4        | -1
+        """
+    )
+    total = t.reduce(total=pw.reducers.sum(pw.this.v))
+    from .utils import capture_update_stream
+
+    stream = capture_update_stream(total)
+    values = [(r["total"], r["__diff__"]) for r in stream]
+    assert values == [(1, 1), (1, -1), (3, 1), (3, -1), (2, 1)]
+
+
+def test_sql():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        5 | 6
+        """
+    )
+    res = pw.sql("SELECT a, b, a + b AS s FROM tab WHERE a > 1", tab=t)
+    rows = sorted(capture_rows(res), key=lambda r: r["a"])
+    assert rows == [{"a": 3, "b": 4, "s": 7}, {"a": 5, "b": 6, "s": 11}]
+
+    agg = pw.sql("SELECT sum(a) AS total FROM tab", tab=t)
+    assert capture_rows(agg) == [{"total": 9}]

@@ -1,0 +1,62 @@
+"""Test fixtures mirroring the reference's ``python/pathway/tests/utils.py``:
+``T`` (markdown tables), ``assert_table_equality[_wo_index]``, update-stream checks."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.debug import _capture_table, _capture_update_stream, table_from_markdown
+
+T = table_from_markdown
+
+
+def _rows_of(table: pw.Table) -> dict:
+    captured = _capture_table(table)
+    return {
+        kb: tuple(_norm(row[c]) for c in table.column_names())
+        for kb, row in captured.items()
+    }
+
+
+def _norm(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return ("nd", v.dtype.kind, v.shape, v.tobytes())
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, tuple):
+        return tuple(_norm(x) for x in v)
+    return v
+
+
+def assert_table_equality(a: pw.Table, b: pw.Table) -> None:
+    """Same keys, same column values (column names may differ positionally)."""
+    rows_a = _rows_of(a)
+    rows_b = _rows_of(b)
+    assert rows_a == rows_b, f"tables differ:\n  A={rows_a}\n  B={rows_b}"
+
+
+def assert_table_equality_wo_index(a: pw.Table, b: pw.Table) -> None:
+    """Same multiset of rows, ignoring keys."""
+    rows_a = sorted(_rows_of(a).values(), key=repr)
+    rows_b = sorted(_rows_of(b).values(), key=repr)
+    assert rows_a == rows_b, f"tables differ (wo index):\n  A={rows_a}\n  B={rows_b}"
+
+
+assert_table_equality_wo_types = assert_table_equality
+assert_table_equality_wo_index_types = assert_table_equality_wo_index
+
+
+def capture_rows(table: pw.Table) -> list[dict]:
+    captured = _capture_table(table)
+    return [
+        {c: row[c] for c in table.column_names()} for row in captured.values()
+    ]
+
+
+def capture_update_stream(table: pw.Table) -> list[dict]:
+    return _capture_update_stream(table)
