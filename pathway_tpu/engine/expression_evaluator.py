@@ -314,6 +314,35 @@ class ExpressionEvaluator:
             out[i] = e._fun(*row_args, **row_kwargs)
         return _tidy(out) if e._return_type != dt.ANY else out
 
+    def _eval_BatchApplyExpression(self, e: expr.ApplyExpression) -> np.ndarray:
+        args = [self._eval(a) for a in e._args]
+        kwargs = {k: self._eval(v) for k, v in e._kwargs.items()}
+        max_bs = e._max_batch_size or self.ctx.n_rows or 1
+        out = np.empty(self.ctx.n_rows, dtype=object)
+        # poisoned rows never reach the UDF; their outputs stay ERROR
+        poisoned = np.zeros(self.ctx.n_rows, dtype=bool)
+        for col in args + list(kwargs.values()):
+            if col.dtype == object:
+                poisoned |= np.frompyfunc(lambda v: isinstance(v, Error), 1, 1)(col).astype(
+                    bool
+                )
+        clean_idx = np.nonzero(~poisoned)[0]
+        out[poisoned] = ERROR
+        for start in range(0, len(clean_idx), max_bs):
+            idx = clean_idx[start : start + max_bs]
+            results = e._fun(
+                *[list(a[idx]) for a in args],
+                **{k: list(v[idx]) for k, v in kwargs.items()},
+            )
+            results = list(results)
+            if len(results) != len(idx):
+                raise ValueError(
+                    f"batch UDF returned {len(results)} results for a batch of {len(idx)} rows"
+                )
+            for i, r in zip(idx, results):
+                out[i] = r
+        return out
+
     def _eval_AsyncApplyExpression(self, e: expr.AsyncApplyExpression) -> np.ndarray:
         import asyncio
 

@@ -290,6 +290,11 @@ class ApplyExpression(ColumnExpression):
         return self._args + tuple(self._kwargs.values())
 
 
+class BatchApplyExpression(ApplyExpression):
+    """fun receives whole columns (lists) and returns a list — the TPU-batched UDF path
+    (reference batches UDFs through the engine; here one call per commit batch)."""
+
+
 class AsyncApplyExpression(ApplyExpression):
     pass
 

@@ -30,7 +30,9 @@ class Json:
         return Json(_json.loads(text))
 
     def dumps(self) -> str:
-        return _json.dumps(self._value, sort_keys=True, separators=(",", ":"))
+        return _json.dumps(
+            self._value, sort_keys=True, separators=(",", ":"), default=_jsonify
+        )
 
     def __getitem__(self, item: Any) -> "Json":
         return Json(self._value[item])
@@ -80,6 +82,44 @@ class Json:
 
     def __str__(self) -> str:
         return self.dumps()
+
+
+def _jsonify(value: Any) -> Any:
+    import numpy as np
+
+    if isinstance(value, Json):
+        return value.value
+    if isinstance(value, np.integer):
+        return int(value)
+    if isinstance(value, np.floating):
+        return float(value)
+    if isinstance(value, np.bool_):
+        return bool(value)
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    raise TypeError(f"not JSON serializable: {type(value).__name__}")
+
+
+def jsonable_value(v: Any) -> Any:
+    """Recursively coerce engine values (Json, Pointer, numpy, tuples) to plain JSON.
+
+    Single source of truth for numpy→JSON coercion (also used by the REST layer).
+    """
+    import numpy as np
+
+    if isinstance(v, Json):
+        return jsonable_value(v.value)
+    if isinstance(v, (tuple, list)):
+        return [jsonable_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: jsonable_value(x) for k, x in v.items()}
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return _jsonify(v)
+    if type(v).__name__ == "Pointer":
+        return repr(v)
+    return v
 
 
 Json.NULL = Json(None)

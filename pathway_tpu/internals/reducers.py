@@ -178,12 +178,14 @@ class _NdarrayWrap:
 
 class _MinAcc(_MultisetAcc):
     def value(self) -> Any:
-        return _unhash(min(self.items))
+        present = [k for k in self.items if k is not None]
+        return _unhash(min(present)) if present else None
 
 
 class _MaxAcc(_MultisetAcc):
     def value(self) -> Any:
-        return _unhash(max(self.items))
+        present = [k for k in self.items if k is not None]
+        return _unhash(max(present)) if present else None
 
 
 class MinReducer(Reducer):

@@ -203,18 +203,6 @@ def rest_connector(
 
 
 def _jsonable(v: Any) -> Any:
-    if isinstance(v, Json):
-        return v.value
-    if isinstance(v, Pointer):
-        return repr(v)
-    if isinstance(v, tuple):
-        return [_jsonable(x) for x in v]
-    import numpy as np
+    from pathway_tpu.internals.json import jsonable_value
 
-    if isinstance(v, np.ndarray):
-        return v.tolist()
-    if isinstance(v, (np.integer,)):
-        return int(v)
-    if isinstance(v, (np.floating,)):
-        return float(v)
-    return v
+    return jsonable_value(v)

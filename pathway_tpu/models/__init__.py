@@ -1,0 +1,1 @@
+"""Flax models used by the xpack (sentence encoders re-hosted TPU-side)."""

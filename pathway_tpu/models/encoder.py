@@ -1,0 +1,255 @@
+"""TPU-native sentence encoder (MiniLM/BERT family) in Flax.
+
+This re-hosts the reference's torch-backed ``SentenceTransformerEmbedder``
+(``xpacks/llm/embedders.py:270-328``, ``model.encode`` at ``:315``) as a jit'd JAX module:
+token ids in, mean-pooled L2-normalized sentence embeddings out, bfloat16 matmuls on the MXU.
+Weights convert from a local HuggingFace checkpoint when available (zero-egress environments
+fall back to deterministic random init — fine for benchmarks measuring throughput and for
+tests using mock embedders).
+
+Architecture = all-MiniLM-L6-v2 defaults: 6 layers, hidden 384, 12 heads, FFN 1536,
+vocab 30522, max_len 512.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    num_layers: int = 6
+    num_heads: int = 12
+    intermediate_size: int = 1536
+    max_position: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16  # activations/matmuls on the MXU; params stay f32
+
+
+class TransformerLayer(nn.Module):
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, hidden: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.config
+        attention_out = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.num_heads,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name="attention",
+        )(hidden, hidden, mask=mask)
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="attention_norm")(
+            hidden + attention_out
+        )
+        ff = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="intermediate")(hidden)
+        ff = nn.gelu(ff, approximate=False)
+        ff = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(ff)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="output_norm")(hidden + ff)
+
+
+class SentenceEncoder(nn.Module):
+    """BERT-style encoder with mean pooling + L2 normalization."""
+
+    config: EncoderConfig = EncoderConfig()
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, attention_mask: jax.Array) -> jax.Array:
+        cfg = self.config
+        positions = jnp.arange(input_ids.shape[1])[None, :]
+        embeddings = (
+            nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings")(input_ids)
+            + nn.Embed(cfg.max_position, cfg.hidden_size, name="position_embeddings")(positions)
+            + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, name="token_type_embeddings")(
+                jnp.zeros_like(input_ids)
+            )
+        )
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="embeddings_norm")(embeddings)
+        hidden = hidden.astype(cfg.dtype)
+        attn_mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(cfg.num_layers):
+            hidden = TransformerLayer(cfg, name=f"layer_{i}")(hidden, attn_mask)
+        hidden = hidden.astype(jnp.float32)
+        # mean pooling over valid tokens, then L2 normalize (sentence-transformers recipe)
+        mask_f = attention_mask[:, :, None].astype(jnp.float32)
+        pooled = jnp.sum(hidden * mask_f, axis=1) / jnp.maximum(
+            jnp.sum(mask_f, axis=1), 1e-9
+        )
+        return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+
+
+class HashTokenizer:
+    """Deterministic fallback tokenizer for zero-egress environments: word-hash into the
+    vocab. NOT wordpiece — embeddings differ from the HF checkpoint, but throughput-identical
+    (same shapes/FLOPs), which is what the benchmark measures."""
+
+    def __init__(self, vocab_size: int = 30522, max_length: int = 128):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+
+    def __call__(self, texts: list[str]) -> Tuple[np.ndarray, np.ndarray]:
+        import xxhash
+
+        n = len(texts)
+        ids = np.zeros((n, self.max_length), dtype=np.int32)
+        mask = np.zeros((n, self.max_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            words = str(text).lower().split()[: self.max_length - 2]
+            toks = [101] + [
+                2000 + (xxhash.xxh32_intdigest(w) % (self.vocab_size - 3000)) for w in words
+            ] + [102]
+            ids[i, : len(toks)] = toks
+            mask[i, : len(toks)] = 1
+        return ids, mask
+
+
+def _hf_offline() -> None:
+    # zero-egress environment: never let transformers hit the network (it retries for ~80s)
+    import os
+
+    os.environ.setdefault("HF_HUB_OFFLINE", "1")
+    os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+
+
+def _load_hf_tokenizer(model_name: str) -> Any:
+    try:
+        _hf_offline()
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(model_name, local_files_only=True)
+    except Exception:
+        return None
+
+
+def convert_hf_weights(model_name: str, config: EncoderConfig) -> Optional[Dict]:
+    """Convert a locally cached HF BERT checkpoint to this module's param tree."""
+    try:
+        _hf_offline()
+        import torch
+        from transformers import AutoModel
+
+        hf = AutoModel.from_pretrained(model_name, local_files_only=True)
+    except Exception:
+        return None
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    p: Dict[str, Any] = {}
+    p["word_embeddings"] = {"embedding": sd["embeddings.word_embeddings.weight"]}
+    p["position_embeddings"] = {"embedding": sd["embeddings.position_embeddings.weight"]}
+    p["token_type_embeddings"] = {"embedding": sd["embeddings.token_type_embeddings.weight"]}
+    p["embeddings_norm"] = {
+        "scale": sd["embeddings.LayerNorm.weight"],
+        "bias": sd["embeddings.LayerNorm.bias"],
+    }
+    h, nh = config.hidden_size, config.num_heads
+    hd = h // nh
+    for i in range(config.num_layers):
+        pre = f"encoder.layer.{i}."
+        attn = {}
+        for name, hf_name in (("query", "query"), ("key", "key"), ("value", "value")):
+            w = sd[pre + f"attention.self.{hf_name}.weight"]  # (h, h) torch layout
+            b = sd[pre + f"attention.self.{hf_name}.bias"]
+            attn[name] = {
+                "kernel": w.T.reshape(h, nh, hd),
+                "bias": b.reshape(nh, hd),
+            }
+        wo = sd[pre + "attention.output.dense.weight"]
+        attn["out"] = {
+            "kernel": wo.T.reshape(nh, hd, h),
+            "bias": sd[pre + "attention.output.dense.bias"],
+        }
+        p[f"layer_{i}"] = {
+            "attention": attn,
+            "attention_norm": {
+                "scale": sd[pre + "attention.output.LayerNorm.weight"],
+                "bias": sd[pre + "attention.output.LayerNorm.bias"],
+            },
+            "intermediate": {
+                "kernel": sd[pre + "intermediate.dense.weight"].T,
+                "bias": sd[pre + "intermediate.dense.bias"],
+            },
+            "output": {
+                "kernel": sd[pre + "output.dense.weight"].T,
+                "bias": sd[pre + "output.dense.bias"],
+            },
+            "output_norm": {
+                "scale": sd[pre + "output.LayerNorm.weight"],
+                "bias": sd[pre + "output.LayerNorm.bias"],
+            },
+        }
+    return {"params": jax.tree.map(jnp.asarray, p)}
+
+
+class JaxSentenceEncoder:
+    """Batched text → embedding pipeline: tokenize on host, encode jit'd on TPU.
+
+    Pads batch length to power-of-two buckets so XLA compiles a handful of shapes.
+    """
+
+    def __init__(
+        self,
+        model_name: str = "sentence-transformers/all-MiniLM-L6-v2",
+        config: EncoderConfig | None = None,
+        max_length: int = 128,
+        seed: int = 0,
+    ):
+        self.config = config or EncoderConfig()
+        self.model = SentenceEncoder(self.config)
+        self.max_length = max_length
+        hf_tok = _load_hf_tokenizer(model_name)
+        if hf_tok is not None:
+            self._tokenize = lambda texts: self._hf_tokenize(hf_tok, texts)
+        else:
+            self._tokenize = HashTokenizer(self.config.vocab_size, max_length)
+        params = convert_hf_weights(model_name, self.config)
+        if params is None:
+            ids = jnp.zeros((1, 8), dtype=jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(seed), ids, jnp.ones_like(ids))
+        self.params = params
+        self._encode = jax.jit(
+            lambda params, ids, mask: self.model.apply(params, ids, mask)
+        )
+
+    def _hf_tokenize(self, tok: Any, texts: list[str]) -> Tuple[np.ndarray, np.ndarray]:
+        out = tok(
+            [str(t) for t in texts],
+            padding=True,
+            truncation=True,
+            max_length=self.max_length,
+            return_tensors="np",
+        )
+        return out["input_ids"].astype(np.int32), out["attention_mask"].astype(np.int32)
+
+    @property
+    def dim(self) -> int:
+        return self.config.hidden_size
+
+    def encode(self, texts: list[str]) -> np.ndarray:
+        if not texts:
+            return np.zeros((0, self.config.hidden_size), dtype=np.float32)
+        ids, mask = self._tokenize(texts)
+        # bucket sequence length and batch to limit recompiles
+        seq = _next_pow2(ids.shape[1])
+        batch = _next_pow2(ids.shape[0])
+        ids_p = np.zeros((batch, seq), dtype=np.int32)
+        mask_p = np.zeros((batch, seq), dtype=np.int32)
+        ids_p[: ids.shape[0], : ids.shape[1]] = ids
+        mask_p[: ids.shape[0], : ids.shape[1]] = mask
+        out = self._encode(self.params, jnp.asarray(ids_p), jnp.asarray(mask_p))
+        return np.asarray(out)[: ids.shape[0]].astype(np.float32)
+
+
+def _next_pow2(n: int) -> int:
+    p = 8
+    while p < n:
+        p *= 2
+    return p

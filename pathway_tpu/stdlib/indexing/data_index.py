@@ -34,6 +34,10 @@ class InnerIndex:
         """Hook: e.g. embed text queries before the index sees them."""
         return query_column
 
+    def preprocess_data(self, data_column: expr.ColumnReference) -> expr.ColumnExpression:
+        """Hook: e.g. embed indexed documents (text column → vector column)."""
+        return data_column
+
 
 class _InstanceFactory:
     def __init__(self, make: Callable[[], Any]):
@@ -57,6 +61,16 @@ class DataIndex:
     ):
         self.data_table = data_table
         self.inner_index = inner_index
+        # build the (possibly embedded) index-side table ONCE: every query surface shares
+        # it, so the corpus crosses the TPU embedder a single time per document update
+        self._index_table = data_table.select(
+            _pw_vec=inner_index.preprocess_data(inner_index.data_column),
+            **(
+                {"_pw_meta": inner_index.metadata_column}
+                if inner_index.metadata_column is not None
+                else {}
+            ),
+        )
 
     def query_as_of_now(
         self,
@@ -110,14 +124,7 @@ class DataIndex:
                 else {}
             ),
         )
-        index_table = self.data_table.select(
-            _pw_vec=self.inner_index.data_column,
-            **(
-                {"_pw_meta": self.inner_index.metadata_column}
-                if self.inner_index.metadata_column is not None
-                else {}
-            ),
-        )
+        index_table = self._index_table
         reply = query_table._external_index_as_of_now(
             index_table,
             index_column=index_table._pw_vec,

@@ -31,8 +31,8 @@ def _resolve(data: Any, path: str) -> Any:
 
 
 _TOKENIZER = re.compile(
-    r"\s*(?:(?P<lp>\()|(?P<rp>\))|(?P<and>&&)|(?P<or>\|\|)|(?P<not>!)"
-    r"|(?P<op>==|!=|>=|<=|>|<)|(?P<str>'[^']*'|`[^`]*`)|(?P<num>-?\d+(?:\.\d+)?)"
+    r"\s*(?:(?P<lp>\()|(?P<rp>\))|(?P<comma>,)|(?P<and>&&)|(?P<or>\|\|)|(?P<not>!)"
+    r"|(?P<op>==|!=|>=|<=|>|<)|(?P<str>'(?:\\'|[^'])*'|`[^`]*`)|(?P<num>-?\d+(?:\.\d+)?)"
     r"|(?P<fn>[a-zA-Z_][\w]*\s*\()|(?P<id>[a-zA-Z_][\w.]*))"
 )
 
@@ -100,7 +100,7 @@ class _FilterParser:
             self.next()  # rp
             return inner
         if kind == "str":
-            return ("lit", text[1:-1])
+            return ("lit", text[1:-1].replace("\\'", "'"))
         if kind == "num":
             return ("lit", float(text) if "." in text else int(text))
         if kind == "fn":
@@ -113,8 +113,7 @@ class _FilterParser:
                         self.next()
                     break
                 args.append(self.parse())
-                # consume commas (tokenizer drops them; identifiers separate naturally)
-                if self.peek() and self.peek()[1] == ",":
+                if self.peek() and self.peek()[0] == "comma":
                     self.next()
             return ("fn", name, args)
         if kind == "id":

@@ -63,6 +63,11 @@ class _KnnInnerIndex(InnerIndex):
             return _apply_embedder(self.embedder, query_column)
         return query_column
 
+    def preprocess_data(self, data_column: expr.ColumnReference) -> expr.ColumnExpression:
+        if self.embedder is not None:
+            return _apply_embedder(self.embedder, data_column)
+        return data_column
+
 
 def _apply_embedder(embedder: Any, column: Any) -> expr.ColumnExpression:
     from pathway_tpu.internals.udfs import UDF

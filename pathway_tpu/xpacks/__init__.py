@@ -1,0 +1,1 @@
+"""Extension packs (parity: reference ``python/pathway/xpacks``)."""

@@ -1,0 +1,21 @@
+"""LLM xpack: RAG pipeline components (parity: reference ``xpacks/llm``)."""
+
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    rerankers,
+    splitters,
+)
+
+__all__ = ["embedders", "llms", "parsers", "prompts", "rerankers", "splitters"]
+
+
+def __getattr__(name: str):
+    # heavier modules lazily (vector_store pulls the whole engine graph machinery)
+    if name in ("vector_store", "document_store", "question_answering", "servers"):
+        import importlib
+
+        return importlib.import_module(f"pathway_tpu.xpacks.llm.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,174 @@
+"""Embedders (parity: reference ``xpacks/llm/embedders.py:64-401``).
+
+``SentenceTransformerEmbedder`` is the TPU-native flagship: the HF encoder re-hosted as a
+jit'd Flax module (``pathway_tpu/models/encoder.py``) with column-batched dispatch — the whole
+commit batch crosses host→device once. API-backed embedders (OpenAI/LiteLLM/Gemini) are async
+UDFs with capacity/retry/cache, gated on their client libraries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.udfs import (
+    AsyncRetryStrategy,
+    CacheStrategy,
+    UDF,
+    async_executor,
+)
+
+
+class BaseEmbedder(UDF):
+    def get_embedding_dimension(self, **kwargs: Any) -> int:
+        result = self.func(".", **kwargs)  # type: ignore[misc]
+        import asyncio
+
+        if asyncio.iscoroutine(result):
+            result = asyncio.run(result)
+        return len(result)
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """Local encoder on the TPU (reference ``:270`` — torch ``model.encode`` at ``:315``)."""
+
+    def __init__(
+        self,
+        model: str = "sentence-transformers/all-MiniLM-L6-v2",
+        *,
+        call_kwargs: dict = {},
+        device: str = "tpu",
+        batch_size: int = 1024,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        from pathway_tpu.models.encoder import JaxSentenceEncoder
+
+        self.encoder = JaxSentenceEncoder(model)
+        self.batch_size = batch_size
+
+        def embed_one(text: str) -> np.ndarray:
+            return self.encoder.encode([str(text)])[0]
+
+        self.func = embed_one
+
+    def __call__(self, *args: Any, **kwargs: Any) -> expr.ColumnExpression:
+        encoder = self.encoder
+
+        def embed_batch(texts: List[str]) -> List[np.ndarray]:
+            vectors = encoder.encode([str(t) for t in texts])
+            return [vectors[i] for i in range(len(texts))]
+
+        return expr.BatchApplyExpression(
+            embed_batch,
+            np.ndarray,
+            False,
+            True,
+            args,
+            kwargs,
+            max_batch_size=self.batch_size,
+        )
+
+    def get_embedding_dimension(self, **kwargs: Any) -> int:
+        return self.encoder.dim
+
+
+class OpenAIEmbedder(BaseEmbedder):
+    """OpenAI embeddings API (reference ``:85``)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = "text-embedding-3-small",
+        retry_strategy: AsyncRetryStrategy | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        api_key: str | None = None,
+        **openai_kwargs: Any,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity),
+            retry_strategy=retry_strategy,
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(openai_kwargs)
+        self.api_key = api_key
+
+        async def embed(input: str, **kwargs: Any) -> list:
+            try:
+                import openai
+            except ImportError as e:
+                raise ImportError("openai client library is not installed") from e
+            client = openai.AsyncOpenAI(api_key=self.api_key)
+            response = await client.embeddings.create(
+                input=[input or "."], model=kwargs.get("model", self.model), **self.kwargs
+            )
+            return response.data[0].embedding
+
+        self.func = embed
+
+
+class LiteLLMEmbedder(BaseEmbedder):
+    """LiteLLM multi-provider embeddings (reference ``:180``)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        **litellm_kwargs: Any,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity),
+            retry_strategy=retry_strategy,
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(litellm_kwargs)
+
+        async def embed(input: str, **kwargs: Any) -> list:
+            try:
+                import litellm
+            except ImportError as e:
+                raise ImportError("litellm is not installed") from e
+            response = await litellm.aembedding(
+                input=[input or "."], model=kwargs.get("model", self.model), **self.kwargs
+            )
+            return response.data[0]["embedding"]
+
+        self.func = embed
+
+
+class GeminiEmbedder(BaseEmbedder):
+    """Google Gemini embeddings (reference ``:330``)."""
+
+    def __init__(
+        self,
+        model: str | None = "models/embedding-001",
+        capacity: int | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        **genai_kwargs: Any,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity),
+            retry_strategy=retry_strategy,
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(genai_kwargs)
+
+        async def embed(input: str, **kwargs: Any) -> list:
+            try:
+                import google.generativeai as genai
+            except ImportError as e:
+                raise ImportError("google-generativeai is not installed") from e
+            response = genai.embed_content(
+                content=input or ".", model=kwargs.get("model", self.model), **self.kwargs
+            )
+            return response["embedding"]
+
+        self.func = embed

@@ -1,0 +1,185 @@
+"""LLM chat wrappers (parity: reference ``xpacks/llm/llms.py:27-654``).
+
+``OpenAIChat`` (``:84``), ``LiteLLMChat`` (``:313``), ``HFPipelineChat`` (``:441``),
+``CohereChat`` (``:544``) — async UDFs with capacity/retry/cache; clients gated at call time.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Optional
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.udfs import (
+    AsyncRetryStrategy,
+    CacheStrategy,
+    UDF,
+    async_executor,
+)
+
+
+class BaseChat(UDF):
+    """Common surface: call on a messages column (list of {role, content} dicts)."""
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return True
+
+
+def _coerce_messages(messages: Any) -> List[dict]:
+    if isinstance(messages, Json):
+        messages = messages.value
+    if isinstance(messages, str):
+        return [{"role": "user", "content": messages}]
+    out = []
+    for m in messages:
+        if isinstance(m, Json):
+            m = m.value
+        out.append(dict(m))
+    return out
+
+
+class OpenAIChat(BaseChat):
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = "gpt-4o-mini",
+        retry_strategy: AsyncRetryStrategy | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        api_key: str | None = None,
+        **openai_kwargs: Any,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity),
+            retry_strategy=retry_strategy,
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(openai_kwargs)
+        self.api_key = api_key
+
+        async def chat(messages: Any, **kwargs: Any) -> str | None:
+            try:
+                import openai
+            except ImportError as e:
+                raise ImportError("openai client library is not installed") from e
+            client = openai.AsyncOpenAI(api_key=self.api_key)
+            merged = {**self.kwargs, **kwargs}
+            merged.setdefault("model", self.model)
+            response = await client.chat.completions.create(
+                messages=_coerce_messages(messages), **merged
+            )
+            return response.choices[0].message.content
+
+        self.func = chat
+
+
+class LiteLLMChat(BaseChat):
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        **litellm_kwargs: Any,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity),
+            retry_strategy=retry_strategy,
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(litellm_kwargs)
+
+        async def chat(messages: Any, **kwargs: Any) -> str | None:
+            try:
+                import litellm
+            except ImportError as e:
+                raise ImportError("litellm is not installed") from e
+            merged = {**self.kwargs, **kwargs}
+            merged.setdefault("model", self.model)
+            response = await litellm.acompletion(messages=_coerce_messages(messages), **merged)
+            return response.choices[0].message.content
+
+        self.func = chat
+
+
+class HFPipelineChat(BaseChat):
+    """Local HuggingFace text-generation pipeline (CPU; reference ``:441``)."""
+
+    def __init__(
+        self,
+        model: str | None = None,
+        call_kwargs: dict = {},
+        device: str = "cpu",
+        cache_strategy: CacheStrategy | None = None,
+        **pipeline_kwargs: Any,
+    ):
+        super().__init__(cache_strategy=cache_strategy)
+        import os
+
+        os.environ.setdefault("HF_HUB_OFFLINE", "1")
+        os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+        from transformers import pipeline
+
+        self.pipeline = pipeline("text-generation", model=model, device=device, **pipeline_kwargs)
+        self.call_kwargs = dict(call_kwargs)
+
+        def chat(messages: Any, **kwargs: Any) -> str | None:
+            coerced = _coerce_messages(messages)
+            merged = {**self.call_kwargs, **kwargs}
+            output = self.pipeline(coerced, **merged)
+            result = output[0]["generated_text"]
+            if isinstance(result, list):
+                return result[-1]["content"]
+            return result
+
+        self.func = chat
+
+    def crop_to_max_length(self, input_string: str, max_prompt_length: int = 500) -> str:
+        tokens = self.pipeline.tokenizer.tokenize(input_string)
+        if len(tokens) > max_prompt_length:
+            tokens = tokens[-max_prompt_length:]
+        return self.pipeline.tokenizer.convert_tokens_to_string(tokens)
+
+
+class CohereChat(BaseChat):
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = "command",
+        retry_strategy: AsyncRetryStrategy | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        **cohere_kwargs: Any,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity),
+            retry_strategy=retry_strategy,
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(cohere_kwargs)
+
+        async def chat(messages: Any, **kwargs: Any) -> tuple:
+            try:
+                import cohere
+            except ImportError as e:
+                raise ImportError("cohere client library is not installed") from e
+            merged = {**self.kwargs, **kwargs}
+            merged.setdefault("model", self.model)
+            coerced = _coerce_messages(messages)
+            client = cohere.AsyncClient()
+            response = await client.chat(
+                message=coerced[-1]["content"],
+                chat_history=coerced[:-1],
+                **merged,
+            )
+            cited_documents = [dict(d) for d in (response.documents or [])]
+            return response.text, cited_documents
+
+        self.func = chat
+
+
+def prompt_chat_single_qa(question: str) -> Json:
+    """Wrap a question into a single-message chat prompt (reference helper)."""
+    return Json([{"role": "user", "content": str(question)}])

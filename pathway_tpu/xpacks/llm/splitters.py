@@ -1,0 +1,84 @@
+"""Text splitters (parity: reference ``xpacks/llm/splitters.py:34`` TokenCountSplitter)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+from pathway_tpu.internals.udfs import UDF
+
+
+def _get_tokenizer(encoding_name: str) -> Tuple[Callable, Callable]:
+    """(encode, decode); tiktoken when its BPE files are cached, whitespace fallback else."""
+    try:
+        import tiktoken
+
+        tokenizer = tiktoken.get_encoding(encoding_name)
+        probe = tokenizer.encode_ordinary("probe")  # may hit network for BPE files
+        return tokenizer.encode_ordinary, tokenizer.decode
+    except Exception:
+        def encode(text: str) -> list:
+            return text.split()
+
+        def decode(tokens: list) -> str:
+            return " ".join(tokens)
+
+        return encode, decode
+
+
+class TokenCountSplitter(UDF):
+    """Split text into chunks of [min_tokens, max_tokens] tokens, preferring sentence
+    boundaries (reference semantics)."""
+
+    def __init__(
+        self,
+        min_tokens: int = 50,
+        max_tokens: int = 500,
+        encoding_name: str = "cl100k_base",
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.min_tokens = min_tokens
+        self.max_tokens = max_tokens
+        self.encoding_name = encoding_name
+        self._codec: Tuple[Callable, Callable] | None = None
+
+        def split(txt: str, metadata: Any = None) -> list:
+            if self._codec is None:
+                self._codec = _get_tokenizer(self.encoding_name)
+            encode, decode = self._codec
+            tokens = encode(str(txt))
+            meta = metadata if metadata is not None else {}
+            output: list = []
+            i = 0
+            while i < len(tokens):
+                window = tokens[i : i + self.max_tokens]
+                chunk = decode(window)
+                cut_chars = len(chunk)
+                n_consumed = len(window)
+                if i + self.max_tokens < len(tokens):
+                    min_chars = len(decode(window[: self.min_tokens]))
+                    for punct in (". ", "\n\n", "\n", "; ", ", ", " "):
+                        pos = chunk.rfind(punct)
+                        if pos > min_chars:
+                            cut_chars = pos + len(punct)
+                            n_consumed = max(1, len(encode(chunk[:cut_chars])))
+                            break
+                piece = chunk[:cut_chars].strip()
+                if piece:
+                    output.append((piece, meta))
+                i += n_consumed
+            return output or [("", meta)]
+
+        self.func = split
+
+
+class NullSplitter(UDF):
+    """Pass the document through as a single chunk."""
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+
+        def split(txt: str, metadata: Any = None) -> list:
+            return [(str(txt), metadata if metadata is not None else {})]
+
+        self.func = split

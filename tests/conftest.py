@@ -1,10 +1,23 @@
 import os
 
-# tests run on a virtual 8-device CPU mesh — set before jax initializes
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run on a virtual 8-device CPU mesh. The axon TPU plugin is registered by
+# sitecustomize at interpreter start (before this file runs) and its client grabs the
+# single-tenant TPU tunnel even for CPU work — deregister its backend factory so test
+# runs never touch (or block on) the TPU.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
 
 import pytest
 

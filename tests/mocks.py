@@ -1,0 +1,53 @@
+"""Deterministic fake embedders/LLMs (parity: reference ``xpacks/llm/tests/mocks.py``)."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.udfs import UDF
+
+
+def fake_embedding(text: str, dim: int = 16) -> np.ndarray:
+    """Deterministic unit vector per text; similar prefixes do NOT imply similarity — exact
+    text match gives identical vectors, which is what index tests need."""
+    digest = hashlib.sha256(str(text).encode()).digest()
+    rng = np.random.default_rng(int.from_bytes(digest[:8], "little"))
+    v = rng.normal(size=dim).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+class FakeEmbedder(UDF):
+    def __init__(self, dim: int = 16, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+        def embed(text: str) -> np.ndarray:
+            return fake_embedding(text, self.dim)
+
+        self.func = embed
+
+    def get_embedding_dimension(self, **kwargs: Any) -> int:
+        return self.dim
+
+
+class FakeChat(UDF):
+    """Echoes the last user message back, prefixed — deterministic."""
+
+    def __init__(self, prefix: str = "ANSWER:", **kwargs: Any):
+        super().__init__(**kwargs)
+        self.prefix = prefix
+
+        def chat(messages: Any, **kw: Any) -> str:
+            if isinstance(messages, Json):
+                messages = messages.value
+            if isinstance(messages, str):
+                content = messages
+            else:
+                content = messages[-1]["content"]
+            return f"{self.prefix}{content[-80:]}"
+
+        self.func = chat

@@ -1,0 +1,151 @@
+"""LLM xpack tests (modeled on reference ``xpacks/llm/tests``): hermetic via mocks."""
+
+import json
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.json import Json
+
+from .mocks import FakeChat, FakeEmbedder, fake_embedding
+from .utils import T, capture_rows
+
+
+def _docs_table():
+    rows = [
+        (b"the cat sits on the mat", Json({"path": "/data/cats.txt", "modified_at": 10, "seen_at": 11})),
+        (b"dogs chase the ball in the park", Json({"path": "/data/dogs.txt", "modified_at": 20, "seen_at": 21})),
+        (b"quantum computing uses qubits", Json({"path": "/data/qc.txt", "modified_at": 30, "seen_at": 31})),
+    ]
+    schema = pw.schema_builder({"data": bytes, "_metadata": pw.Json})
+    return pw.debug.table_from_rows(schema, rows)
+
+
+def _store(docs=None):
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory, BruteForceKnnMetricKind
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+    embedder = FakeEmbedder(dim=16)
+    factory = BruteForceKnnFactory(
+        dimensions=16, metric=BruteForceKnnMetricKind.COS, embedder=embedder
+    )
+    return DocumentStore(docs if docs is not None else _docs_table(), retriever_factory=factory)
+
+
+def test_document_store_retrieve():
+    store = _store()
+    queries = pw.debug.table_from_rows(
+        pw.schema_builder({"query": str, "k": int, "metadata_filter": str, "filepath_globpattern": str}),
+        [("the cat sits on the mat", 1, None, None)],
+    )
+    result = store.retrieve_query(queries)
+    rows = capture_rows(result)
+    assert len(rows) == 1
+    docs = rows[0]["result"].value
+    assert len(docs) == 1
+    assert docs[0]["text"] == "the cat sits on the mat"
+    assert docs[0]["metadata"]["path"] == "/data/cats.txt"
+    assert docs[0]["dist"] == pytest.approx(-1.0, abs=1e-4)  # exact cosine match
+
+
+def test_document_store_metadata_filter():
+    store = _store()
+    queries = pw.debug.table_from_rows(
+        pw.schema_builder({"query": str, "k": int, "metadata_filter": str, "filepath_globpattern": str}),
+        [("anything", 3, "contains(path, 'dogs')", None)],
+    )
+    rows = capture_rows(store.retrieve_query(queries))
+    docs = rows[0]["result"].value
+    assert len(docs) == 1
+    assert docs[0]["metadata"]["path"] == "/data/dogs.txt"
+
+
+def test_document_store_globpattern():
+    store = _store()
+    queries = pw.debug.table_from_rows(
+        pw.schema_builder({"query": str, "k": int, "metadata_filter": str, "filepath_globpattern": str}),
+        [("anything", 5, None, "**/qc*")],
+    )
+    rows = capture_rows(store.retrieve_query(queries))
+    docs = rows[0]["result"].value
+    assert [d["metadata"]["path"] for d in docs] == ["/data/qc.txt"]
+
+
+def test_document_store_statistics_and_inputs():
+    store = _store()
+    stats_q = pw.debug.table_from_rows(pw.schema_builder({"dummy": int}), [(1,)])
+    rows = capture_rows(store.statistics_query(stats_q))
+    stats = rows[0]["result"].value
+    assert stats["file_count"] == 3
+    assert stats["last_modified"] == 30
+
+    inputs_q = pw.debug.table_from_rows(pw.schema_builder({"dummy": int}), [(1,)])
+    rows = capture_rows(store.inputs_query(inputs_q))
+    files = rows[0]["result"].value
+    assert len(files) == 3
+
+
+def test_splitter():
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+    splitter = TokenCountSplitter(min_tokens=2, max_tokens=5)
+    chunks = splitter.func("one two three four five six seven eight nine ten", {})
+    assert len(chunks) >= 2
+    text = " ".join(c[0] for c in chunks)
+    assert "one" in text and "ten" in text
+
+
+def test_parser_utf8():
+    from pathway_tpu.xpacks.llm.parsers import ParseUtf8
+
+    parser = ParseUtf8()
+    assert parser.func(b"hello") == [("hello", {})]
+
+
+def test_rag_question_answerer():
+    from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+
+    store = _store()
+    qa = BaseRAGQuestionAnswerer(FakeChat(), store, search_topk=2)
+    queries = pw.debug.table_from_rows(
+        pw.schema_builder({"prompt": str, "filters": str, "return_context_docs": bool}),
+        [("what does the cat do?", None, True)],
+    )
+    rows = capture_rows(qa.answer_query(queries))
+    assert len(rows) == 1
+    payload = rows[0]["result"].value
+    assert payload["response"].startswith("ANSWER:")
+    assert len(payload["context_docs"]) == 2
+
+
+def test_vector_store_server_rest_e2e():
+    """Full REST round-trip: aiohttp server thread + engine thread + HTTP client."""
+    import threading
+    import time
+
+    import requests
+
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient, VectorStoreServer
+
+    docs = _docs_table()
+    server = VectorStoreServer(docs, embedder=FakeEmbedder(dim=16))
+    port = 28431
+    thread = server.run_server(host="127.0.0.1", port=port, threaded=True)
+    client = VectorStoreClient(url=f"http://127.0.0.1:{port}")
+
+    deadline = time.time() + 15
+    result = None
+    while time.time() < deadline:
+        try:
+            result = client.query("dogs chase the ball in the park", k=1)
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert result is not None, "server did not come up"
+    assert result[0]["text"] == "dogs chase the ball in the park"
+
+    stats = client.get_vectorstore_statistics()
+    assert stats["file_count"] == 3
+    files = client.get_input_files()
+    assert len(files) == 3
