@@ -1,0 +1,41 @@
+"""Distributed execution over a TPU device mesh.
+
+TPU-native replacement for the reference's distributed backend — timely-dataflow's
+``communication`` crate (``external/timely-dataflow/communication/src/initialize.rs:25``,
+worker threads + shared-memory/TCP exchange, ``src/engine/dataflow/config.rs:63-120``).
+Here, workers ↔ mesh devices under SPMD; the hash-partitioned ``Exchange`` pact becomes
+``jax.lax.all_to_all`` over ICI; broadcast/top-k merge becomes ``all_gather``; progress
+tracking stays on the host control-plane (XLA replicas are bulk-synchronous).
+
+Components:
+- :mod:`mesh` — device-mesh construction (``data``/``model`` axes, multi-host aware).
+- :mod:`sharding` — sharding rules (param trees, batches, keyed table state).
+- :mod:`exchange` — key-hash exchange (shard routing, the ``shard.rs:15-20`` analog).
+- :mod:`train` — TP+DP contrastive training step for the flagship sentence encoder.
+- :mod:`ring_attention` — sequence-parallel blockwise attention via ``ppermute``.
+- :mod:`knn_sharded` — mesh-sharded KNN store with all-gather top-k merge.
+"""
+
+from pathway_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from pathway_tpu.parallel.sharding import (
+    batch_sharding,
+    encoder_param_sharding,
+    replicated,
+)
+from pathway_tpu.parallel.exchange import shard_of_keys, exchange_by_key
+from pathway_tpu.parallel.knn_sharded import ShardedKNNStore
+from pathway_tpu.parallel.ring_attention import ring_attention
+from pathway_tpu.parallel.train import ContrastiveTrainer
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "batch_sharding",
+    "encoder_param_sharding",
+    "replicated",
+    "shard_of_keys",
+    "exchange_by_key",
+    "ShardedKNNStore",
+    "ring_attention",
+    "ContrastiveTrainer",
+]

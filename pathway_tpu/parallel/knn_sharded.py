@@ -1,0 +1,225 @@
+"""Mesh-sharded KNN: the multi-worker sharded index (BASELINE config #5).
+
+The reference shards index rows across workers by key and exchanges query/result streams
+over TCP (``src/engine/dataflow/operators/external_index.rs`` + ``shard.rs``). Here the
+vector store is ONE logical ``(capacity, dim)`` array row-sharded over the ``data`` mesh
+axis; a search is a ``shard_map``: each device computes a local MXU matmul + ``top_k``
+over its rows, then one ``all_gather`` of (n_shards × k) candidates and a final merge
+``top_k`` — the ICI all-gather top-k merge pattern.
+
+Rows shard contiguously (NamedSharding block layout); the host allocator hands out slots
+round-robin across shards so loads stay balanced the way the reference's key-hash routing
+does.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _local_search(
+    data: jax.Array,  # (cap_local, dim) this shard's rows
+    valid: jax.Array,  # (cap_local,)
+    norms: jax.Array,  # (cap_local,)
+    queries: jax.Array,  # (q, dim) replicated
+    k: int,
+    metric: str,
+    axis: str,
+) -> Tuple[jax.Array, jax.Array]:
+    scores = jnp.dot(queries, data.T, preferred_element_type=jnp.float32)
+    if metric == "l2sq":
+        qn = jnp.sum(queries * queries, axis=1, keepdims=True)
+        scores = -(qn + norms[None, :] - 2.0 * scores)
+    elif metric == "cos":
+        qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
+        scores = scores / jnp.maximum(qn * jnp.sqrt(norms)[None, :], 1e-30)
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    local_scores, local_idx = lax.top_k(scores, k)  # (q, k) per shard
+    shard = lax.axis_index(axis)
+    # contiguous row sharding: shard s owns global rows [s * cap_local, (s+1) * cap_local)
+    global_idx = shard * data.shape[0] + local_idx
+    all_scores = lax.all_gather(local_scores, axis, axis=1)  # (q, n_shards, k)
+    all_idx = lax.all_gather(global_idx, axis, axis=1)
+    q = queries.shape[0]
+    flat_scores = all_scores.reshape(q, -1)
+    flat_idx = all_idx.reshape(q, -1)
+    top_scores, pos = lax.top_k(flat_scores, k)
+    return top_scores, jnp.take_along_axis(flat_idx, pos, axis=1)
+
+
+class ShardedKNNStore:
+    """Keyed dense vector store row-sharded over a mesh axis.
+
+    Host API matches :class:`pathway_tpu.ops.knn.DenseKNNStore` (add/remove/search_batch)
+    so the engine's external-index operator can swap it in when a mesh is configured.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        dim: int,
+        metric: str = "l2sq",
+        axis: str = "data",
+        initial_capacity: int = 1024,
+    ):
+        assert metric in ("l2sq", "cos", "ip")
+        self.mesh = mesh
+        self.axis = axis
+        self.dim = dim
+        self.metric = metric
+        self.n_shards = mesh.shape[axis]
+        # capacity divisible by n_shards so every shard holds capacity // n rows
+        self.capacity = -(-initial_capacity // self.n_shards) * self.n_shards
+        self._row_sharding = NamedSharding(mesh, P(axis, None))
+        self._vec_sharding = NamedSharding(mesh, P(axis))
+        self._data = jax.device_put(
+            jnp.zeros((self.capacity, dim), dtype=jnp.float32), self._row_sharding
+        )
+        self._valid = jax.device_put(
+            jnp.zeros((self.capacity,), dtype=bool), self._vec_sharding
+        )
+        self._norms = jax.device_put(
+            jnp.zeros((self.capacity,), dtype=jnp.float32), self._vec_sharding
+        )
+        self.slot_of: Dict[Any, int] = {}
+        self.key_of: Dict[int, Any] = {}
+        self._free: List[int] = _interleaved_free_list(0, self.capacity, self.n_shards)
+        self._staged_vecs: List[np.ndarray] = []
+        self._staged_slots: List[int] = []
+        self._staged_invalid: List[int] = []
+        self._update = jax.jit(
+            _apply_updates,
+            donate_argnums=(0, 1, 2),
+            out_shardings=(self._row_sharding, self._vec_sharding, self._vec_sharding),
+        )
+        self._search = None  # built lazily (depends on k/metric statics)
+
+    def __len__(self) -> int:
+        return len(self.slot_of)
+
+    # -- ingest (host-staged, one scatter per commit — mirrors DenseKNNStore) --
+
+    def add(self, key: Any, vector: np.ndarray) -> None:
+        vector = np.asarray(vector, dtype=np.float32).reshape(-1)
+        assert vector.shape[0] == self.dim
+        if key in self.slot_of:
+            self.remove(key)
+        if not self._free:
+            self._grow()
+        slot = self._free.pop()
+        self.slot_of[key] = slot
+        self.key_of[slot] = key
+        self._staged_slots.append(slot)
+        self._staged_vecs.append(vector)
+
+    def remove(self, key: Any) -> None:
+        slot = self.slot_of.pop(key, None)
+        if slot is None:
+            return
+        self.key_of.pop(slot, None)
+        self._free.append(slot)
+        self._staged_invalid.append(slot)
+        if slot in self._staged_slots:
+            i = self._staged_slots.index(slot)
+            del self._staged_slots[i]
+            del self._staged_vecs[i]
+
+    def _grow(self) -> None:
+        self._flush()
+        old = self.capacity
+        self.capacity = old * 2
+        self._data = jax.device_put(
+            jnp.concatenate([self._data, jnp.zeros((old, self.dim), jnp.float32)]),
+            self._row_sharding,
+        )
+        self._valid = jax.device_put(
+            jnp.concatenate([self._valid, jnp.zeros((old,), bool)]), self._vec_sharding
+        )
+        self._norms = jax.device_put(
+            jnp.concatenate([self._norms, jnp.zeros((old,), jnp.float32)]),
+            self._vec_sharding,
+        )
+        self._free = _interleaved_free_list(old, self.capacity, self.n_shards) + self._free
+
+    def _flush(self) -> None:
+        if not (self._staged_slots or self._staged_invalid):
+            return
+        if self._staged_slots:
+            set_slots = np.array(self._staged_slots, dtype=np.int32)
+            set_vecs = np.stack(self._staged_vecs).astype(np.float32)
+        else:
+            set_slots = np.zeros((0,), dtype=np.int32)
+            set_vecs = np.zeros((0, self.dim), dtype=np.float32)
+        still_invalid = [s for s in set(self._staged_invalid) if s not in self.key_of]
+        inv_slots = np.array(sorted(still_invalid), dtype=np.int32)
+        self._data, self._valid, self._norms = self._update(
+            self._data,
+            self._valid,
+            self._norms,
+            jnp.asarray(set_slots),
+            jnp.asarray(set_vecs),
+            jnp.asarray(inv_slots),
+        )
+        self._staged_slots, self._staged_vecs, self._staged_invalid = [], [], []
+
+    # -- search --
+
+    def search_batch(
+        self, queries: np.ndarray, k: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        self._flush()
+        queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
+        cap_local = self.capacity // self.n_shards
+        k_eff = max(1, min(k, cap_local))
+        fn = shard_map(
+            functools.partial(
+                _local_search, k=k_eff, metric=self.metric, axis=self.axis
+            ),
+            mesh=self.mesh,
+            in_specs=(P(self.axis, None), P(self.axis), P(self.axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        top_scores, top_idx = jax.jit(fn)(
+            self._data, self._valid, self._norms, jnp.asarray(queries)
+        )
+        scores = np.asarray(top_scores)
+        idx = np.asarray(top_idx)
+        return scores, idx, np.isfinite(scores)
+
+
+def _interleaved_free_list(start: int, stop: int, n_shards: int) -> List[int]:
+    """Free slots ordered so successive pops cycle shards (pop takes from the end)."""
+    span = stop - start
+    per_shard = span // n_shards
+    order = [
+        start + shard * per_shard + i
+        for i in range(per_shard)
+        for shard in range(n_shards)
+    ]
+    order.extend(range(start + per_shard * n_shards, stop))
+    return order[::-1]
+
+
+def _apply_updates(
+    data: jax.Array,
+    valid: jax.Array,
+    norms: jax.Array,
+    set_slots: jax.Array,
+    set_vecs: jax.Array,
+    inv_slots: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    data = data.at[set_slots].set(set_vecs, mode="drop")
+    norms = norms.at[set_slots].set(jnp.sum(set_vecs * set_vecs, axis=1), mode="drop")
+    valid = valid.at[set_slots].set(True, mode="drop")
+    valid = valid.at[inv_slots].set(False, mode="drop")
+    return data, valid, norms

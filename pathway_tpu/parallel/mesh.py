@@ -1,0 +1,65 @@
+"""Device-mesh construction.
+
+Replaces the reference's worker/process topology config (``src/engine/dataflow/config.rs:88`` —
+``PATHWAY_THREADS``/``PATHWAY_PROCESSES`` → timely ``CommunicationConfig``) with a named
+``jax.sharding.Mesh``. Axis conventions:
+
+- ``data``  — batch/row parallelism (the reference's hash-sharded worker axis);
+- ``model`` — tensor parallelism inside kernels (no reference analog: the reference has no
+  DNN compute; this axis exists because our hot path IS a DNN + matmul-KNN).
+
+Multi-host: on a real pod, ``jax.devices()`` already spans hosts and ICI/DCN routing is
+XLA's job — the same mesh code covers single-chip, one host × N chips, and N hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def mesh_shape_for(n_devices: int, model_parallel: Optional[int] = None) -> tuple[int, int]:
+    """(data, model) factorization. Prefers the largest model axis ≤4 that divides n
+    (MiniLM has 12 heads → model axis must divide 12 for head-sharded TP)."""
+    if model_parallel is None:
+        for m in (4, 2, 1):
+            if n_devices % m == 0 and 12 % m == 0:
+                model_parallel = m
+                break
+        else:
+            model_parallel = 1
+    if n_devices % model_parallel:
+        raise ValueError(f"{n_devices} devices not divisible by model={model_parallel}")
+    return n_devices // model_parallel, model_parallel
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = ("data", "model"),
+    model_parallel: Optional[int] = None,
+) -> Mesh:
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+    data, model = mesh_shape_for(n_devices, model_parallel)
+    grid = np.asarray(devices[:n_devices]).reshape(data, model)
+    return Mesh(grid, axis_names=tuple(axis_names))
+
+
+def cpu_virtual_devices(n: int) -> None:
+    """Request an n-device virtual CPU platform. Must run before jax initializes; used by
+    test conftest / dryrun drivers (mirrors the driver's
+    ``xla_force_host_platform_device_count`` validation mode)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")

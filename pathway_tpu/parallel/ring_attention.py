@@ -1,0 +1,107 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context path: the sequence dimension is sharded across devices; K/V blocks rotate
+around the ring via ``lax.ppermute`` (ICI neighbor exchange) while each device keeps its
+query block resident, accumulating an online (flash-style) softmax — numerically exact, with
+peak memory O(seq/n_devices) per device and compute/communication overlapped by XLA.
+
+The reference has no sequence dimension (stream-length is handled incrementally,
+``SURVEY.md`` §5 "Long-context"); this module exists because our flagship compute path is a
+transformer. Design follows the public ring-attention recipe (blockwise softmax
+accumulation + ring permute), implemented with ``shard_map`` so XLA sees static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _ring_attention_local(
+    q: jax.Array,  # (B, Sq, H, D) — this device's query block
+    k: jax.Array,  # (B, Sk, H, D) — this device's key block (will rotate)
+    v: jax.Array,  # (B, Sk, H, D)
+    kv_mask: jax.Array,  # (B, Sk) bool — valid keys (rotates with k/v)
+    axis_name: str,
+) -> jax.Array:
+    n = lax.psum(1, axis_name)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    b, sq, h, d = q.shape
+    acc = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
+    m = jnp.full((b, h, sq), _NEG, dtype=jnp.float32)
+    l = jnp.zeros((b, h, sq), dtype=jnp.float32)
+
+    def step(carry, _):
+        k_blk, v_blk, mask_blk, acc, m, l = carry
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+        ) * scale
+        s = jnp.where(mask_blk[:, None, None, :], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        # keys masked out contribute exp(_NEG - m) ≈ 0 already; correction for old acc:
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+        )
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return (k_blk, v_blk, mask_blk, acc, m_new, l), None
+
+    (_, _, _, acc, m, l), _ = lax.scan(
+        step, (k, v, kv_mask, acc, m, l), None, length=n
+    )
+    out = acc / jnp.maximum(l.transpose(0, 2, 1)[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: Optional[jax.Array] = None,
+    *,
+    mesh: Mesh,
+    axis: str = "model",
+) -> jax.Array:
+    """Exact attention with the sequence axis sharded over ``axis``.
+
+    Args are (batch, seq, heads, head_dim); ``kv_mask`` is (batch, seq) bool. The sequence
+    axis of all inputs must be divisible by the mesh axis size. Batch stays sharded over
+    ``data`` if it already is.
+    """
+    if kv_mask is None:
+        kv_mask = jnp.ones(k.shape[:2], dtype=bool)
+    fn = functools.partial(_ring_attention_local, axis_name=axis)
+    qspec = P("data", axis, None, None)
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec, P("data", axis)),
+        out_specs=qspec,
+        check_vma=False,
+    )(q, k, v, kv_mask)
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, kv_mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """Single-device exact attention — the oracle ring_attention must match."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

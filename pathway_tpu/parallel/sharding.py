@@ -1,0 +1,80 @@
+"""Sharding rules: parameter trees, batches, keyed table state.
+
+The reference shards *rows* by the low bits of the 128-bit key (``src/engine/dataflow/
+shard.rs:15-20``) and never shards *compute* (no DNN exists there). We keep row sharding
+(see :mod:`exchange`) and add Megatron-style tensor parallelism for the encoder:
+
+- attention q/k/v kernels shard over the head axis, the out-projection over heads in;
+- MLP intermediate shards column-wise, output row-wise (one all-reduce per block, inserted
+  by XLA from the sharding constraints — we never hand-write the collective);
+- token embeddings shard over the vocab axis; norms/biases-on-the-reduced-axis replicate.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, PartitionSpec) — first match wins; fallback is replication.
+_ENCODER_RULES: tuple[tuple[str, P], ...] = (
+    (r"word_embeddings/embedding", P("model", None)),
+    (r"position_embeddings/embedding", P(None, None)),
+    (r"token_type_embeddings/embedding", P(None, None)),
+    (r"attention/(query|key|value)/kernel", P(None, "model", None)),
+    (r"attention/(query|key|value)/bias", P("model", None)),
+    (r"attention/out/kernel", P("model", None, None)),
+    (r"attention/out/bias", P(None)),
+    (r"intermediate/kernel", P(None, "model")),
+    (r"intermediate/bias", P("model")),
+    (r"output/kernel", P("model", None)),
+    (r"output/bias", P(None)),
+)
+
+
+def _spec_for_path(path: str) -> P:
+    for pattern, spec in _ENCODER_RULES:
+        if re.search(pattern, path):
+            return spec
+    return P()  # replicate (norms, anything unmatched)
+
+
+def _path_str(key_path: Any) -> str:
+    parts = []
+    for entry in key_path:
+        name = getattr(entry, "key", None)
+        if name is None:
+            name = getattr(entry, "name", str(entry))
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def encoder_param_specs(params: Mapping[str, Any]) -> Any:
+    """PartitionSpec tree matching the encoder param tree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, _: _spec_for_path(_path_str(kp)), params
+    )
+
+
+def encoder_param_sharding(params: Mapping[str, Any], mesh: Mesh) -> Any:
+    """NamedSharding tree for the encoder params on ``mesh``."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), encoder_param_specs(params)
+    )
+
+
+def batch_sharding(mesh: Mesh, *, sequence_parallel: bool = False) -> NamedSharding:
+    """(batch, seq) arrays: batch over ``data``; optionally seq over ``model`` (sp)."""
+    return NamedSharding(mesh, P("data", "model" if sequence_parallel else None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a (host or single-device) param tree onto the mesh per the TP rules."""
+    shardings = encoder_param_sharding(params, mesh)
+    return jax.tree.map(jax.device_put, params, shardings)
