@@ -117,7 +117,9 @@ def jsonable_value(v: Any) -> Any:
         return v.tolist()
     if isinstance(v, (np.integer, np.floating, np.bool_)):
         return _jsonify(v)
-    if type(v).__name__ == "Pointer":
+    from pathway_tpu.internals.keys import Pointer
+
+    if isinstance(v, Pointer):
         return repr(v)
     return v
 

@@ -44,6 +44,10 @@ class DocumentStore:
         from pathway_tpu.xpacks.llm.splitters import NullSplitter
 
         self.docs = [docs] if isinstance(docs, Table) else list(docs)
+        if not self.docs:
+            raise ValueError(
+                "DocumentStore requires at least one document source table"
+            )
         self.retriever_factory = retriever_factory
         self.parser = parser if parser is not None else ParseUtf8()
         self.splitter = splitter if splitter is not None else NullSplitter()

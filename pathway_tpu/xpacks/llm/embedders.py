@@ -46,6 +46,22 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         super().__init__(**kwargs)
         from pathway_tpu.models.encoder import JaxSentenceEncoder
 
+        if device not in ("tpu", None):
+            import warnings
+
+            warnings.warn(
+                f"device={device!r} ignored: the encoder runs on the default JAX backend "
+                "(TPU when available)",
+                stacklevel=2,
+            )
+        if call_kwargs:
+            import warnings
+
+            warnings.warn(
+                f"call_kwargs {sorted(call_kwargs)} are torch SentenceTransformer options "
+                "with no JAX equivalent; ignored",
+                stacklevel=2,
+            )
         self.encoder = JaxSentenceEncoder(model)
         self.batch_size = batch_size
 
@@ -95,14 +111,16 @@ class OpenAIEmbedder(BaseEmbedder):
         self.model = model
         self.kwargs = dict(openai_kwargs)
         self.api_key = api_key
+        self._client: Any = None
 
         async def embed(input: str, **kwargs: Any) -> list:
-            try:
-                import openai
-            except ImportError as e:
-                raise ImportError("openai client library is not installed") from e
-            client = openai.AsyncOpenAI(api_key=self.api_key)
-            response = await client.embeddings.create(
+            if self._client is None:
+                try:
+                    import openai
+                except ImportError as e:
+                    raise ImportError("openai client library is not installed") from e
+                self._client = openai.AsyncOpenAI(api_key=self.api_key)
+            response = await self._client.embeddings.create(
                 input=[input or "."], model=kwargs.get("model", self.model), **self.kwargs
             )
             return response.data[0].embedding

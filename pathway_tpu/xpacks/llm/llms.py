@@ -57,16 +57,18 @@ class OpenAIChat(BaseChat):
         self.model = model
         self.kwargs = dict(openai_kwargs)
         self.api_key = api_key
+        self._client: Any = None
 
         async def chat(messages: Any, **kwargs: Any) -> str | None:
-            try:
-                import openai
-            except ImportError as e:
-                raise ImportError("openai client library is not installed") from e
-            client = openai.AsyncOpenAI(api_key=self.api_key)
-            merged = {**self.kwargs, **kwargs}
+            if self._client is None:
+                try:
+                    import openai
+                except ImportError as e:
+                    raise ImportError("openai client library is not installed") from e
+                self._client = openai.AsyncOpenAI(api_key=self.api_key)
+            merged = {k: v for k, v in {**self.kwargs, **kwargs}.items() if v is not None}
             merged.setdefault("model", self.model)
-            response = await client.chat.completions.create(
+            response = await self._client.chat.completions.create(
                 messages=_coerce_messages(messages), **merged
             )
             return response.choices[0].message.content

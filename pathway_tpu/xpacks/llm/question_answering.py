@@ -79,8 +79,22 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         self.indexer = indexer.store if hasattr(indexer, "store") else indexer
         self.search_topk = search_topk
         self.prompt_template = prompt_template or long_prompt_template
+        self.short_prompt_template = short_prompt_template
         self.summarize_template = summarize_template
+        self.default_llm_name = default_llm_name
         self._server_thread = None
+
+    def _model_expr(self, queries: Table) -> Any:
+        """Per-query model override, falling back to ``default_llm_name`` (the chat UDF
+        drops a None model and uses its own default)."""
+        default = self.default_llm_name
+        if "model" in queries.column_names():
+            return expr.apply_with_type(
+                lambda m: m if m is not None else default,
+                dt.Optional_(dt.STR),
+                queries.model,
+            )
+        return default
 
     # -- query surfaces -----------------------------------------------------
 
@@ -104,7 +118,7 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
             queries.prompt,
             with_docs._pw_docs,
         )
-        raw_answer = self.llm(prompt_col)
+        raw_answer = self.llm(prompt_col, model=self._model_expr(queries))
         result = with_docs.select(
             response=expr.apply_with_type(
                 _format_answer,
@@ -166,12 +180,20 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
         factor: int = 2,
         max_iterations: int = 4,
         strict_prompt: bool = False,
+        not_found_response: str = "No information",
         **kwargs: Any,
     ):
         super().__init__(llm, indexer, **kwargs)
         self.n_starting_documents = n_starting_documents
         self.factor = factor
         self.max_iterations = max_iterations
+        # strict_prompt forces the terse template (fewer tokens per adaptive round,
+        # reference ``question_answering.py:620`` behavior switch)
+        if strict_prompt and "prompt_template" not in kwargs:
+            self.prompt_template = self.short_prompt_template
+        # the adaptive loop grows context while answers contain this marker; keep it in
+        # sync with the prompt's information_not_found_response
+        self.not_found_response = not_found_response
 
     def answer_query(self, queries: Table) -> Table:
         names = queries.column_names()
@@ -188,6 +210,7 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
         # wrapped fn keeps the UDF's capacity/retry/cache behavior
         llm_fun, _llm_is_async = self.llm._wrapped_fun()
         template = self.prompt_template
+        not_found = self.not_found_response
         n0, factor, max_iter = self.n_starting_documents, self.factor, self.max_iterations
 
         @pw.udf
@@ -204,7 +227,7 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
                 if asyncio.iscoroutine(result):
                     result = await result
                 answer = result
-                if answer and "No information" not in str(answer):
+                if answer and not_found not in str(answer):
                     return str(answer)
                 if n >= len(doc_list):
                     break

@@ -17,7 +17,7 @@ class BaseRestServer:
 
         self.host = host
         self.port = port
-        self.webserver = PathwayWebserver(host=host, port=port)
+        self.webserver = PathwayWebserver(host=host, port=port, **rest_kwargs)
 
     def serve(
         self,
@@ -30,14 +30,26 @@ class BaseRestServer:
         cache_strategy: Any = None,
         **additional_endpoint_kwargs: Any,
     ) -> None:
+        import warnings
+
         from pathway_tpu.io.http import rest_connector
 
+        if retry_strategy is not None or cache_strategy is not None:
+            # reference applies these to the endpoint's response path; engine-level UDF
+            # caching isn't wired yet (TODO.md) — configure the strategies on the LLM /
+            # embedder UDFs instead, which does work
+            warnings.warn(
+                "retry_strategy/cache_strategy on serve() are not applied yet; set them "
+                "on the UDFs (e.g. OpenAIChat(retry_strategy=...)) instead",
+                stacklevel=2,
+            )
         queries, writer = rest_connector(
             webserver=self.webserver,
             route=route,
             schema=schema,
             methods=methods,
             delete_completed_queries=True,
+            **additional_endpoint_kwargs,
         )
         writer(handler(queries))
 
