@@ -121,6 +121,24 @@ class ShardedKNNStore:
         self._staged_slots.append(slot)
         self._staged_vecs.append(vector)
 
+    def add_many(self, keys: List[Any], vectors: np.ndarray) -> None:
+        """Bulk insert (see DenseKNNStore.add_many)."""
+        vectors = np.asarray(vectors, dtype=np.float32).reshape(len(keys), self.dim)
+        last = {k: i for i, k in enumerate(keys)}  # intra-batch dedup: last write wins
+        if len(last) != len(keys):
+            keep = sorted(last.values())
+            keys = [keys[i] for i in keep]
+            vectors = vectors[keep]
+        for k in [k for k in keys if k in self.slot_of]:
+            self.remove(k)
+        while len(self._free) < len(keys):
+            self._grow()
+        slots = [self._free.pop() for _ in range(len(keys))]
+        self.slot_of.update(zip(keys, slots))
+        self.key_of.update(zip(slots, keys))
+        self._staged_slots.extend(slots)
+        self._staged_vecs.extend(vectors)
+
     def remove(self, key: Any) -> None:
         slot = self.slot_of.pop(key, None)
         if slot is None:

@@ -112,14 +112,20 @@ class OpenAIEmbedder(BaseEmbedder):
         self.kwargs = dict(openai_kwargs)
         self.api_key = api_key
         self._client: Any = None
+        self._client_loop: Any = None
 
         async def embed(input: str, **kwargs: Any) -> list:
-            if self._client is None:
+            import asyncio
+
+            # cache per event loop: each commit batch runs under its own asyncio.run()
+            loop = asyncio.get_running_loop()
+            if self._client is None or self._client_loop is not loop:
                 try:
                     import openai
                 except ImportError as e:
                     raise ImportError("openai client library is not installed") from e
                 self._client = openai.AsyncOpenAI(api_key=self.api_key)
+                self._client_loop = loop
             response = await self._client.embeddings.create(
                 input=[input or "."], model=kwargs.get("model", self.model), **self.kwargs
             )

@@ -58,14 +58,21 @@ class OpenAIChat(BaseChat):
         self.kwargs = dict(openai_kwargs)
         self.api_key = api_key
         self._client: Any = None
+        self._client_loop: Any = None
 
         async def chat(messages: Any, **kwargs: Any) -> str | None:
-            if self._client is None:
+            import asyncio
+
+            # the engine runs each commit batch under its own asyncio.run() loop — a
+            # client's connection pool is loop-bound, so cache per loop, reuse per batch
+            loop = asyncio.get_running_loop()
+            if self._client is None or self._client_loop is not loop:
                 try:
                     import openai
                 except ImportError as e:
                     raise ImportError("openai client library is not installed") from e
                 self._client = openai.AsyncOpenAI(api_key=self.api_key)
+                self._client_loop = loop
             merged = {k: v for k, v in {**self.kwargs, **kwargs}.items() if v is not None}
             merged.setdefault("model", self.model)
             response = await self._client.chat.completions.create(
@@ -98,7 +105,7 @@ class LiteLLMChat(BaseChat):
                 import litellm
             except ImportError as e:
                 raise ImportError("litellm is not installed") from e
-            merged = {**self.kwargs, **kwargs}
+            merged = {k: v for k, v in {**self.kwargs, **kwargs}.items() if v is not None}
             merged.setdefault("model", self.model)
             response = await litellm.acompletion(messages=_coerce_messages(messages), **merged)
             return response.choices[0].message.content
@@ -129,7 +136,7 @@ class HFPipelineChat(BaseChat):
 
         def chat(messages: Any, **kwargs: Any) -> str | None:
             coerced = _coerce_messages(messages)
-            merged = {**self.call_kwargs, **kwargs}
+            merged = {k: v for k, v in {**self.call_kwargs, **kwargs}.items() if v is not None}
             output = self.pipeline(coerced, **merged)
             result = output[0]["generated_text"]
             if isinstance(result, list):
@@ -167,7 +174,7 @@ class CohereChat(BaseChat):
                 import cohere
             except ImportError as e:
                 raise ImportError("cohere client library is not installed") from e
-            merged = {**self.kwargs, **kwargs}
+            merged = {k: v for k, v in {**self.kwargs, **kwargs}.items() if v is not None}
             merged.setdefault("model", self.model)
             coerced = _coerce_messages(messages)
             client = cohere.AsyncClient()

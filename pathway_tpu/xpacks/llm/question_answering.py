@@ -189,7 +189,7 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
         self.max_iterations = max_iterations
         # strict_prompt forces the terse template (fewer tokens per adaptive round,
         # reference ``question_answering.py:620`` behavior switch)
-        if strict_prompt and "prompt_template" not in kwargs:
+        if strict_prompt and kwargs.get("prompt_template") is None:
             self.prompt_template = self.short_prompt_template
         # the adaptive loop grows context while answers contain this marker; keep it in
         # sync with the prompt's information_not_found_response
