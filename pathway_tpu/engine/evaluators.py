@@ -934,39 +934,266 @@ class RemoveErrorsEvaluator(Evaluator):
 
 
 class AsofNowEvaluator(Evaluator):
+    """``_forget_immediately`` / ``_filter_out_results_of_forgetting``.
+
+    Forget mode passes each commit's rows through unchanged and schedules a retraction of
+    every insert; the runner drains those in the same commit's *neu* phase (the
+    reference's odd-time forgetting, ``dataflow.rs:3447``): downstream state shrinks, but
+    the forgetting filter drops neu deltas so delivered results stay. An upstream
+    retraction of a still-scheduled key cancels the schedule (no double retraction).
+    """
+
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
-        self.pending_retractions: Optional[Delta] = None
+        self.pending: Dict[bytes, tuple] = {}  # kb -> (key, row)
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
         mode = self.node.config["mode"]
         if mode == "filter_forgotten":
-            return delta.select(delta.diffs > 0)
-        # forget mode: emit this commit's inserts plus scheduled retractions of previous commit
-        parts = [delta]
-        if self.pending_retractions is not None and len(self.pending_retractions):
-            parts.append(self.pending_retractions)
-        inserts = delta.select(delta.diffs > 0)
-        self.pending_retractions = inserts.negated()
-        out = Delta.concat(parts, self.output_columns)
-        return out
+            if delta.neu:
+                return Delta.empty(self.output_columns)
+            return delta
+        # forget mode
+        for i in range(len(delta)):
+            kb = delta.keys[i].tobytes()
+            if delta.diffs[i] > 0:
+                self.pending[kb] = (
+                    delta.keys[i],
+                    {c: delta.columns[c][i] for c in delta.column_names},
+                )
+            else:
+                # genuine upstream retraction passes through; cancel the scheduled one
+                self.pending.pop(kb, None)
+        return delta
+
+    def neu_pending(self) -> bool:
+        return self.node.config["mode"] == "forget" and bool(self.pending)
+
+    def drain_neu(self, input_deltas: List[Delta]) -> Delta:
+        parts = []
+        if self.pending:
+            keys = [p[0] for p in self.pending.values()]
+            rows = [p[1] for p in self.pending.values()]
+            self.pending = {}
+            parts.append(
+                _delta_from_rows(keys, [-1] * len(keys), rows, self.output_columns)
+            )
+        if any(len(d) for d in input_deltas):
+            parts.append(self.process(input_deltas))
+        return Delta.concat(parts, self.output_columns)
 
     def has_pending(self) -> bool:
-        return self.pending_retractions is not None and len(self.pending_retractions) > 0
+        return bool(self.pending)
+
+
+class _TimeThresholdEvaluator(Evaluator):
+    """Shared machinery for buffer/forget/freeze (reference ``time_column.rs``).
+
+    Tracks ``now`` = the max value of the time column observed so far; a row is *ripe*
+    once its threshold column value is ≤ ``now`` (the commit-granularity stand-in for
+    the reference's frontier comparison). Ripeness scans use a min-heap on threshold so
+    each commit pops only the ripe prefix (no full rescan of buffered state).
+    """
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.now: Any = None
+        self._heap: List[tuple] = []  # (threshold, seq, kb)
+        self._heap_seq = 0
+
+    def _thresholds_times(self, delta: Delta) -> Tuple[np.ndarray, np.ndarray]:
+        table = self.node.inputs[0]
+        resolver = self._resolver_for(table, delta)
+        n = len(delta)
+        thr = ee.evaluate(self.node.config["threshold"], n, resolver)
+        tim = ee.evaluate(self.node.config["time"], n, resolver)
+        return thr, tim
+
+    def _advance_now(self, tim: np.ndarray, diffs: np.ndarray) -> None:
+        for i in range(len(tim)):
+            if diffs[i] > 0 and tim[i] is not None:
+                if self.now is None or tim[i] > self.now:
+                    self.now = tim[i]
+
+    def _ripe(self, threshold: Any) -> bool:
+        return self.now is not None and threshold <= self.now
+
+    def _heap_push(self, threshold: Any, kb: bytes) -> None:
+        import heapq
+
+        heapq.heappush(self._heap, (threshold, self._heap_seq, kb))
+        self._heap_seq += 1
+
+    def _heap_pop_ripe(self, *, all_: bool = False):
+        """Yield (threshold, kb) for entries whose threshold passed ``now`` (or all,
+        when draining). Entries are lazily validated by the caller."""
+        import heapq
+
+        while self._heap and (all_ or self._ripe(self._heap[0][0])):
+            threshold, _, kb = heapq.heappop(self._heap)
+            yield threshold, kb
+
+
+class BufferEvaluator(_TimeThresholdEvaluator):
+    """Postpone emission until the stream's time passes each row's threshold
+    (reference ``TimeColumnBuffer`` / ``postpone_core``, ``time_column.rs:255,380``).
+    At stream close every buffered row flushes, as when the frontier empties."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        # kb -> [key, row, threshold, accumulated diff]
+        self.pending: Dict[bytes, list] = {}
+        self.emitted: set[bytes] = set()
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        out_keys: List[Any] = []
+        out_diffs: List[int] = []
+        out_rows: List[dict] = []
+        if len(delta):
+            thr, tim = self._thresholds_times(delta)
+            self._advance_now(tim, delta.diffs)
+            for i in range(len(delta)):
+                kb = delta.keys[i].tobytes()
+                d = int(delta.diffs[i])
+                row = {c: delta.columns[c][i] for c in delta.column_names}
+                if d < 0 and kb in self.emitted:
+                    # retraction of an already-emitted row passes straight through
+                    out_keys.append(delta.keys[i])
+                    out_diffs.append(-1)
+                    out_rows.append(row)
+                    self.emitted.discard(kb)
+                    continue
+                cur = self.pending.get(kb)
+                if cur is None:
+                    self.pending[kb] = [delta.keys[i], row, thr[i], d]
+                    self._heap_push(thr[i], kb)
+                else:
+                    cur[3] += d
+                    if d > 0:
+                        cur[1] = row
+                        if cur[2] != thr[i]:
+                            cur[2] = thr[i]
+                            self._heap_push(thr[i], kb)
+                    if cur[3] == 0:
+                        del self.pending[kb]
+        draining = getattr(self.runner, "draining", False)
+        for threshold, kb in self._heap_pop_ripe(all_=draining):
+            cur = self.pending.get(kb)
+            if cur is None or cur[2] != threshold:
+                continue  # stale heap entry (row cancelled or rescheduled)
+            del self.pending[kb]
+            key, row, _, acc = cur
+            if acc == 0:
+                continue
+            out_keys.append(key)
+            out_diffs.append(acc)
+            out_rows.append(row)
+            if acc > 0:
+                self.emitted.add(kb)
+        return _delta_from_rows(
+            out_keys, out_diffs, out_rows, self.output_columns
+        ).consolidated()
+
+    def has_pending(self) -> bool:
+        return bool(self.pending)
+
+
+class FreezeEvaluator(_TimeThresholdEvaluator):
+    """Drop late rows — updates arriving after the stream's time passed their threshold
+    (reference ``TimeColumnFreeze`` / ``ignore_late``, ``time_column.rs:631,677``)."""
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        thr, tim = self._thresholds_times(delta)
+        mask = np.ones(len(delta), dtype=bool)
+        for i in range(len(delta)):
+            if self._ripe(thr[i]):
+                mask[i] = False
+        self._advance_now(tim, delta.diffs)
+        return delta.select(mask)
+
+
+class ForgetEvaluator(_TimeThresholdEvaluator):
+    """Retract rows once the stream's time passes their threshold (reference
+    ``TimeColumnForget``, ``time_column.rs:556``). The retractions drain in the same
+    commit's *neu* phase; with keep_results=True a downstream forgetting filter drops
+    them so state is bounded but delivered results stay, and with keep_results=False
+    there is no filter, so results are genuinely removed."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.live: Dict[bytes, tuple] = {}  # kb -> (key, row, threshold)
+        self.pending_forget: Dict[bytes, tuple] = {}  # kb -> (key, row)
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        thr, tim = self._thresholds_times(delta)
+        self._advance_now(tim, delta.diffs)
+        for i in range(len(delta)):
+            kb = delta.keys[i].tobytes()
+            if delta.diffs[i] > 0:
+                row = {c: delta.columns[c][i] for c in delta.column_names}
+                self.live[kb] = (delta.keys[i], row, thr[i])
+                self._heap_push(thr[i], kb)
+            else:
+                # genuine upstream retraction: cancel any scheduled forgetting
+                self.live.pop(kb, None)
+                self.pending_forget.pop(kb, None)
+        for threshold, kb in self._heap_pop_ripe():
+            cur = self.live.get(kb)
+            if cur is None or cur[2] != threshold:
+                continue  # stale heap entry
+            del self.live[kb]
+            self.pending_forget[kb] = (cur[0], cur[1])
+        return delta
+
+    def neu_pending(self) -> bool:
+        return bool(self.pending_forget)
+
+    def drain_neu(self, input_deltas: List[Delta]) -> Delta:
+        parts = []
+        if self.pending_forget:
+            keys = [p[0] for p in self.pending_forget.values()]
+            rows = [p[1] for p in self.pending_forget.values()]
+            self.pending_forget = {}
+            parts.append(
+                _delta_from_rows(keys, [-1] * len(keys), rows, self.output_columns)
+            )
+        if any(len(d) for d in input_deltas):
+            parts.append(self.process(input_deltas))
+        return Delta.concat(parts, self.output_columns)
+
+    def has_pending(self) -> bool:
+        return bool(self.pending_forget)
 
 
 class ExternalIndexEvaluator(Evaluator):
-    """As-of-now external index operator (reference ``external_index.rs:38``)."""
+    """External index operator (reference ``external_index.rs:38``).
+
+    In as-of-now mode (the default, reference ``use_external_index_as_of_now``) a query is
+    answered once against the index state at arrival and never revisited. With
+    ``asof_now=False`` live queries are *re-answered* whenever the index changes: the old
+    reply is retracted and the fresh one emitted (reference full differential semantics of
+    ``DataIndex.query``)."""
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.index = node.config["index_factory"].make_instance()
         self.replies = StateTable(["_pw_index_reply"])
+        self.asof_now: bool = bool(self.node.config.get("asof_now", True))
+        # kb -> (key, qvec, limit, filter) for re-answering mode
+        self.live_queries: Dict[bytes, tuple] = {}
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         index_delta, query_delta = input_deltas
         index_table, query_table = self.node.inputs
+        index_changed = len(index_delta) > 0
 
         if len(index_delta):
             resolver = self._resolver_for(index_table, index_delta)
@@ -1014,12 +1241,37 @@ class ExternalIndexEvaluator(Evaluator):
                     out_keys.append(query_delta.keys[i])
                     out_diffs.append(1)
                     out_rows.append({"_pw_index_reply": reply})
+                    if not self.asof_now:
+                        self.live_queries[kb] = (
+                            query_delta.keys[i],
+                            qvecs[i],
+                            limit,
+                            flt,
+                        )
                 else:
+                    self.live_queries.pop(kb, None)
                     stored = self.replies.get_row(kb)
                     if stored is not None:
                         out_keys.append(query_delta.keys[i])
                         out_diffs.append(-1)
                         out_rows.append(stored)
+
+        if not self.asof_now and index_changed and self.live_queries:
+            answered = {query_delta.keys[i].tobytes() for i in range(len(query_delta))}
+            for kb, (key, qvec, limit, flt) in self.live_queries.items():
+                if kb in answered:
+                    continue
+                reply = tuple(self.index.search(qvec, limit, flt))
+                stored = self.replies.get_row(kb)
+                if stored is not None and stored["_pw_index_reply"] == reply:
+                    continue
+                if stored is not None:
+                    out_keys.append(key)
+                    out_diffs.append(-1)
+                    out_rows.append(stored)
+                out_keys.append(key)
+                out_diffs.append(1)
+                out_rows.append({"_pw_index_reply": reply})
         delta = _delta_from_rows(out_keys, out_diffs, out_rows, ["_pw_index_reply"])
         self.replies.apply(delta)
         return delta
@@ -1090,6 +1342,9 @@ EVALUATORS: Dict[type, type] = {
     pg.SortNode: SortEvaluator,
     pg.RemoveErrorsNode: RemoveErrorsEvaluator,
     pg.AsofNowUpdateNode: AsofNowEvaluator,
+    pg.BufferNode: BufferEvaluator,
+    pg.ForgetNode: ForgetEvaluator,
+    pg.FreezeNode: FreezeEvaluator,
     pg.ExternalIndexNode: ExternalIndexEvaluator,
     pg.OutputNode: OutputEvaluator,
 }

@@ -27,6 +27,8 @@ class GraphRunner:
         self._nodes: List[pg.Node] = []
         self._monitor: Any = None
         self._ready = False
+        self.draining = False
+        self._step_counts: Dict[int, int] = {}
 
     def state_of(self, node: pg.Node) -> StateTable:
         return self.states[node.id]
@@ -55,36 +57,71 @@ class GraphRunner:
         self._ready = True
 
     def step(self) -> bool:
-        """Run one commit; returns True if any node produced output."""
+        """Run one commit; returns True if any node produced output.
+
+        Each commit runs in two phases mirroring the reference's alt/neu timestamps
+        (``dataflow.rs:3447``): the even ("alt") phase moves normal data; the odd ("neu")
+        phase moves *forgetting* retractions drained from Forget/AsofNow operators. Keeping
+        the phases separate guarantees a delta is never a mix of real updates and
+        forgetting updates, so ``_filter_out_results_of_forgetting`` can drop whole neu
+        deltas without losing genuine data.
+        """
         self.current_time = self._commit * 2  # even data times, as in the reference
+        self.draining = self._ready and self.sources_finished()
+        any_output = self._substep(neu=False)
+        if any(
+            getattr(self.evaluators[n.id], "neu_pending", _no_pending)()
+            for n in self._nodes
+        ):
+            self.current_time = self._commit * 2 + 1
+            any_output = self._substep(neu=True) or any_output
+        if self._monitor is not None:
+            self._monitor.update(self._commit, self._step_counts, self.states)
+        self._commit += 1
+        return any_output
+
+    def _substep(self, *, neu: bool) -> bool:
+        if not neu:
+            self._step_counts = {}
         deltas: Dict[int, Delta] = {}
         any_output = False
         for node in self._nodes:
             evaluator = self.evaluators[node.id]
             if isinstance(node, pg.InputNode):
-                delta = evaluator.process([])
+                delta = (
+                    Delta.empty(self.output_columns_of(node))
+                    if neu
+                    else evaluator.process([])
+                )
             else:
                 inputs = [
                     deltas.get(inp._node.id, Delta.empty(inp.column_names()))
                     for inp in node.inputs
                 ]
+                originates = neu and getattr(evaluator, "neu_pending", _no_pending)()
                 if (
                     all(len(d) == 0 for d in inputs)
-                    and not _has_pending(evaluator)
+                    and not originates
+                    and not (not neu and _has_pending(evaluator))
                     and node.kind != "iterate_result"
                 ):
-                    delta = Delta.empty(node.output.column_names() if node.output else [])
+                    delta = Delta.empty(self.output_columns_of(node))
+                elif originates:
+                    delta = evaluator.drain_neu(inputs)
                 else:
                     delta = evaluator.process(inputs)
+                if neu and len(delta):
+                    delta.neu = True
             deltas[node.id] = delta
             if len(delta):
                 any_output = True
+                self._step_counts[node.id] = self._step_counts.get(node.id, 0) + len(delta)
                 if node.output is not None:
                     self.states[node.id].apply(delta)
-        if self._monitor is not None:
-            self._monitor.update(self._commit, deltas, self.states)
-        self._commit += 1
         return any_output
+
+    def output_columns_of(self, node: pg.Node) -> List[str]:
+        return node.output.column_names() if node.output is not None else []
 
     def sources_finished(self) -> bool:
         return all(node.config["source"].is_finished() for node, _ in self._sources)
@@ -130,10 +167,11 @@ class GraphRunner:
 
 
 def _has_pending(evaluator: Any) -> bool:
-    from pathway_tpu.engine.evaluators import AsofNowEvaluator
+    has = getattr(evaluator, "has_pending", None)
+    return bool(has()) if has is not None else False
 
-    if isinstance(evaluator, AsofNowEvaluator):
-        return evaluator.has_pending()
+
+def _no_pending() -> bool:
     return False
 
 

@@ -25,9 +25,9 @@ class StatsMonitor:
         self.start = time.monotonic()
         self._last_print = 0.0
 
-    def update(self, commit: int, deltas: Dict[int, Any], states: Dict[int, Any]) -> None:
-        for node_id, delta in deltas.items():
-            self.counts[node_id] = self.counts.get(node_id, 0) + len(delta)
+    def update(self, commit: int, row_counts: Dict[int, int], states: Dict[int, Any] | None = None) -> None:
+        for node_id, n in row_counts.items():
+            self.counts[node_id] = self.counts.get(node_id, 0) + n
         now = time.monotonic()
         if now - self._last_print > 1.0 and sys.stderr.isatty():
             self._last_print = now

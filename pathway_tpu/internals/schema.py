@@ -57,6 +57,16 @@ class SchemaMetaclass(type):
         for base in bases:
             columns.update(getattr(base, "_columns", {}))
         annotations = namespace.get("__annotations__", {})
+        if any(isinstance(h, str) for h in annotations.values()):
+            # postponed evaluation (`from __future__ import annotations`) leaves string
+            # hints; resolve them with the stdlib resolver
+            import typing
+
+            try:
+                hints = typing.get_type_hints(cls)
+                annotations = {k: hints.get(k, v) for k, v in annotations.items()}
+            except Exception:
+                pass  # unresolvable forward refs fall through as raw strings
         for col_name, hint in annotations.items():
             if col_name.startswith("_"):
                 continue

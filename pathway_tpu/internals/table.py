@@ -288,9 +288,13 @@ class Table(Joinable):
         """Reindex this table by pointers coming from another table's column."""
         key_expr = expr.smart_coerce(expression)
         refs = key_expr._column_refs
-        if not refs:
+        if refs:
+            source = refs[0].table
+        elif isinstance(key_expr, expr.PointerExpression):
+            # zero-argument pointer_from still knows its origin table
+            source = key_expr._table
+        else:
             raise ValueError("ix requires an expression over some table's columns")
-        source = refs[0].table
         node = G.add_node(
             pg.IxNode(
                 inputs=[source, self],
@@ -466,6 +470,47 @@ class Table(Joinable):
         universe_solver.register_subset(result._universe, self._universe)
         return result
 
+    def _buffer(self, threshold: Any, time: Any) -> "Table":
+        """Postpone rows until the stream's time passes ``threshold`` (reference
+        ``Table._buffer`` → ``time_column.rs:255``)."""
+        node = G.add_node(
+            pg.BufferNode(
+                inputs=[self],
+                threshold=self._resolve(threshold),
+                time=self._resolve(time),
+            )
+        )
+        return Table(node, self._schema, name="buffer")
+
+    def _freeze(self, threshold: Any, time: Any) -> "Table":
+        """Ignore rows arriving after the stream's time passed ``threshold`` (reference
+        ``Table._freeze`` → ``time_column.rs:631``)."""
+        node = G.add_node(
+            pg.FreezeNode(
+                inputs=[self],
+                threshold=self._resolve(threshold),
+                time=self._resolve(time),
+            )
+        )
+        result = Table(node, self._schema, name="freeze")
+        universe_solver.register_subset(result._universe, self._universe)
+        return result
+
+    def _forget(
+        self, threshold: Any, time: Any, mark_forgetting_records: bool = True
+    ) -> "Table":
+        """Retract rows once the stream's time passes ``threshold`` (reference
+        ``Table._forget`` → ``time_column.rs:556``)."""
+        node = G.add_node(
+            pg.ForgetNode(
+                inputs=[self],
+                threshold=self._resolve(threshold),
+                time=self._resolve(time),
+                mark=mark_forgetting_records,
+            )
+        )
+        return Table(node, self._schema, name="forget")
+
     def _forget_immediately(self) -> "Table":
         node = G.add_node(pg.AsofNowUpdateNode(inputs=[self], mode="forget"))
         return Table(node, self._schema, name="forget_immediately")
@@ -485,9 +530,11 @@ class Table(Joinable):
         query_responses_limit_column: expr.ColumnReference | None = None,
         index_filter_data_column: expr.ColumnReference | None = None,
         query_filter_column: expr.ColumnReference | None = None,
+        asof_now: bool = True,
     ) -> "Table":
-        """Query a pluggable external index as-of-now (reference ``graph.rs:917``,
-        ``external_index.rs:38``). ``self`` is the query table."""
+        """Query a pluggable external index (reference ``graph.rs:917``,
+        ``external_index.rs:38``). ``self`` is the query table. With ``asof_now=False``
+        live queries are re-answered when the index changes."""
         node = G.add_node(
             pg.ExternalIndexNode(
                 inputs=[index_table, self],
@@ -497,6 +544,7 @@ class Table(Joinable):
                 query_responses_limit_column=query_responses_limit_column,
                 index_filter_data_column=index_filter_data_column,
                 query_filter_column=query_filter_column,
+                asof_now=asof_now,
             )
         )
         columns = {"_pw_index_reply": sch.ColumnSchema("_pw_index_reply", res_type)}

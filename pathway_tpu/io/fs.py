@@ -26,6 +26,11 @@ from pathway_tpu.internals.table import Table
 
 
 def _coerce(value: str, dtype: dt.DType) -> Any:
+    """Parse a raw CSV field per schema dtype; malformed fields poison the cell with
+    ``Error`` (reference ``Value::Error`` semantics, ``data_format.rs`` Dsv parser) so bad
+    input stays distinguishable from a genuine null."""
+    from pathway_tpu.engine.columnar import ERROR
+
     base = dtype.strip_optional()
     if value is None:
         return None
@@ -35,11 +40,15 @@ def _coerce(value: str, dtype: dt.DType) -> Any:
         if base == dt.FLOAT:
             return float(value)
         if base == dt.BOOL:
-            return value in ("true", "True", "1")
+            if value in ("true", "True", "1"):
+                return True
+            if value in ("false", "False", "0"):
+                return False
+            return ERROR
         if base == dt.JSON:
             return Json.parse(value)
     except (ValueError, TypeError):
-        return None
+        return ERROR
     return value
 
 

@@ -43,7 +43,60 @@ def _search_kernel(
     return top_scores, top_idx
 
 
-class DenseKNNStore:
+class SlotIngestMixin:
+    """Host-staged keyed slot assignment shared by the dense and sharded stores.
+
+    Requires the host class to provide ``dim``, ``slot_of``, ``key_of``, ``_free``,
+    ``_staged_slots``, ``_staged_vecs``, ``_staged_invalid`` and ``_grow()``.
+    """
+
+    def add(self, key: Any, vector: np.ndarray) -> None:
+        vector = np.asarray(vector, dtype=np.float32).reshape(-1)
+        assert vector.shape[0] == self.dim, f"dim mismatch: {vector.shape[0]} != {self.dim}"
+        if key in self.slot_of:
+            self.remove(key)
+        if not self._free:
+            self._grow()
+        slot = self._free.pop()
+        self.slot_of[key] = slot
+        self.key_of[slot] = key
+        self._staged_slots.append(slot)
+        self._staged_vecs.append(vector)
+
+    def add_many(self, keys: List[Any], vectors: np.ndarray) -> None:
+        """Bulk insert: one staging append for the whole batch (no per-row Python work
+        beyond the key dict updates)."""
+        vectors = np.asarray(vectors, dtype=np.float32).reshape(len(keys), self.dim)
+        last = {k: i for i, k in enumerate(keys)}  # intra-batch dedup: last write wins
+        if len(last) != len(keys):
+            keep = sorted(last.values())
+            keys = [keys[i] for i in keep]
+            vectors = vectors[keep]
+        for k in [k for k in keys if k in self.slot_of]:
+            self.remove(k)
+        while len(self._free) < len(keys):
+            self._grow()
+        slots = [self._free.pop() for _ in range(len(keys))]
+        self.slot_of.update(zip(keys, slots))
+        self.key_of.update(zip(slots, keys))
+        self._staged_slots.extend(slots)
+        self._staged_vecs.extend(vectors)
+
+    def remove(self, key: Any) -> None:
+        slot = self.slot_of.pop(key, None)
+        if slot is None:
+            return
+        self.key_of.pop(slot, None)
+        self._free.append(slot)
+        self._staged_invalid.append(slot)
+        # drop a staged add for the same slot if still pending
+        if slot in self._staged_slots:
+            i = self._staged_slots.index(slot)
+            del self._staged_slots[i]
+            del self._staged_vecs[i]
+
+
+class DenseKNNStore(SlotIngestMixin):
     """Keyed dense vector store with amortized-capacity device residency."""
 
     def __init__(
@@ -71,52 +124,6 @@ class DenseKNNStore:
 
     def __len__(self) -> int:
         return len(self.slot_of)
-
-    def add(self, key: Any, vector: np.ndarray) -> None:
-        vector = np.asarray(vector, dtype=np.float32).reshape(-1)
-        assert vector.shape[0] == self.dim, f"dim mismatch: {vector.shape[0]} != {self.dim}"
-        if key in self.slot_of:
-            self.remove(key)
-        if not self._free:
-            self._grow()
-        slot = self._free.pop()
-        self.slot_of[key] = slot
-        self.key_of[slot] = key
-        self._staged_slots.append(slot)
-        self._staged_vecs.append(vector)
-
-    def add_many(self, keys: List[Any], vectors: np.ndarray) -> None:
-        """Bulk insert: one staging append for the whole batch (no per-row Python work
-        beyond the key dict updates)."""
-        vectors = np.asarray(vectors, dtype=np.float32).reshape(len(keys), self.dim)
-        last = {k: i for i, k in enumerate(keys)}  # intra-batch dedup: last write wins
-        if len(last) != len(keys):
-            keep = sorted(last.values())
-            keys = [keys[i] for i in keep]
-            vectors = vectors[keep]
-        dup = [k for k in keys if k in self.slot_of]
-        for k in dup:
-            self.remove(k)
-        while len(self._free) < len(keys):
-            self._grow()
-        slots = [self._free.pop() for _ in range(len(keys))]
-        self.slot_of.update(zip(keys, slots))
-        self.key_of.update(zip(slots, keys))
-        self._staged_slots.extend(slots)
-        self._staged_vecs.extend(vectors)
-
-    def remove(self, key: Any) -> None:
-        slot = self.slot_of.pop(key, None)
-        if slot is None:
-            return
-        self.key_of.pop(slot, None)
-        self._free.append(slot)
-        self._staged_invalid.append(slot)
-        # drop a staged add for the same slot if still pending
-        if slot in self._staged_slots:
-            i = self._staged_slots.index(slot)
-            del self._staged_slots[i]
-            del self._staged_vecs[i]
 
     def _grow(self) -> None:
         new_capacity = self.capacity * 2

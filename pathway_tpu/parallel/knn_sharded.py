@@ -25,6 +25,8 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pathway_tpu.ops.knn import SlotIngestMixin
+
 
 def _local_search(
     data: jax.Array,  # (cap_local, dim) this shard's rows
@@ -56,11 +58,12 @@ def _local_search(
     return top_scores, jnp.take_along_axis(flat_idx, pos, axis=1)
 
 
-class ShardedKNNStore:
+class ShardedKNNStore(SlotIngestMixin):
     """Keyed dense vector store row-sharded over a mesh axis.
 
     Host API matches :class:`pathway_tpu.ops.knn.DenseKNNStore` (add/remove/search_batch)
-    so the engine's external-index operator can swap it in when a mesh is configured.
+    so the engine's external-index operator can swap it in when a mesh is configured;
+    the staged-slot ingest comes from the shared :class:`SlotIngestMixin`.
     """
 
     def __init__(
@@ -106,50 +109,6 @@ class ShardedKNNStore:
     def __len__(self) -> int:
         return len(self.slot_of)
 
-    # -- ingest (host-staged, one scatter per commit — mirrors DenseKNNStore) --
-
-    def add(self, key: Any, vector: np.ndarray) -> None:
-        vector = np.asarray(vector, dtype=np.float32).reshape(-1)
-        assert vector.shape[0] == self.dim
-        if key in self.slot_of:
-            self.remove(key)
-        if not self._free:
-            self._grow()
-        slot = self._free.pop()
-        self.slot_of[key] = slot
-        self.key_of[slot] = key
-        self._staged_slots.append(slot)
-        self._staged_vecs.append(vector)
-
-    def add_many(self, keys: List[Any], vectors: np.ndarray) -> None:
-        """Bulk insert (see DenseKNNStore.add_many)."""
-        vectors = np.asarray(vectors, dtype=np.float32).reshape(len(keys), self.dim)
-        last = {k: i for i, k in enumerate(keys)}  # intra-batch dedup: last write wins
-        if len(last) != len(keys):
-            keep = sorted(last.values())
-            keys = [keys[i] for i in keep]
-            vectors = vectors[keep]
-        for k in [k for k in keys if k in self.slot_of]:
-            self.remove(k)
-        while len(self._free) < len(keys):
-            self._grow()
-        slots = [self._free.pop() for _ in range(len(keys))]
-        self.slot_of.update(zip(keys, slots))
-        self.key_of.update(zip(slots, keys))
-        self._staged_slots.extend(slots)
-        self._staged_vecs.extend(vectors)
-
-    def remove(self, key: Any) -> None:
-        slot = self.slot_of.pop(key, None)
-        if slot is None:
-            return
-        self.key_of.pop(slot, None)
-        self._free.append(slot)
-        self._staged_invalid.append(slot)
-        if slot in self._staged_slots:
-            i = self._staged_slots.index(slot)
-            del self._staged_slots[i]
-            del self._staged_vecs[i]
 
     def _grow(self) -> None:
         self._flush()

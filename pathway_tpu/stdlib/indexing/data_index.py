@@ -138,6 +138,7 @@ class DataIndex:
             query_filter_column=(
                 query_table._pw_qfilter if metadata_filter is not None else None
             ),
+            asof_now=as_of_now,
         )
         # reply: per query key, tuple of (data_key, score)
         if not collapse_rows:

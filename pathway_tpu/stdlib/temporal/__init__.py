@@ -47,6 +47,7 @@ from pathway_tpu.stdlib.temporal.temporal_behavior import (
     Behavior,
     CommonBehavior,
     ExactlyOnceBehavior,
+    apply_temporal_behavior,
     common_behavior,
     exactly_once_behavior,
 )
@@ -66,6 +67,7 @@ __all__ = [
     "asof_now_join",
     "asof_now_join_inner",
     "asof_now_join_left",
+    "apply_temporal_behavior",
     "common_behavior",
     "exactly_once_behavior",
     "inactivity_detection",

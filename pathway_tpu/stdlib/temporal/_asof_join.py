@@ -244,11 +244,24 @@ def asof_join_outer(self: Table, other: Table, self_time: Any, other_time: Any, 
 def asof_now_join(self: Table, other: Table, *on: Any, how: JoinKind = JoinKind.INNER, **kw: Any):
     """Join where ``self`` is a query stream answered as of now (reference
     ``_asof_now_join.py:176``)."""
+    from pathway_tpu.stdlib.temporal._interval_join import _rebind
+
     forgotten = self._forget_immediately()
+    # user expressions reference the original left table; rebind them onto the
+    # forgetting copy (reference ``_asof_now_join.py:79-84``)
+    on = tuple(_rebind(cond, self, forgotten, other, other) for cond in on)
     result = forgotten.join(other, *on, how=how, **kw)
+    left_table = self
 
     class _AsofNowJoinResult:
         def select(self, *args: Any, **kwargs: Any) -> Table:
+            args = tuple(
+                _rebind(a, left_table, forgotten, other, other) for a in args
+            )
+            kwargs = {
+                k: _rebind(v, left_table, forgotten, other, other)
+                for k, v in kwargs.items()
+            }
             selected = result.select(*args, **kwargs)
             return selected._filter_out_results_of_forgetting()
 

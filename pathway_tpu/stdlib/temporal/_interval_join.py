@@ -37,6 +37,7 @@ class IntervalJoinResult:
         iv: Interval,
         on: tuple,
         kind: JoinKind,
+        behavior: Any = None,
     ):
         self.left = left
         self.right = right
@@ -45,6 +46,7 @@ class IntervalJoinResult:
         self.interval = iv
         self.on = on
         self.kind = kind
+        self.behavior = behavior
 
     def select(self, *args: Any, **kwargs: Any) -> Table:
         lo, hi = self.interval.lower_bound, self.interval.upper_bound
@@ -67,14 +69,20 @@ class IntervalJoinResult:
         def right_bucket(t: Any) -> int:
             return _bucket_of(t, width)
 
+        from pathway_tpu.stdlib.temporal.temporal_behavior import (
+            apply_temporal_behavior,
+        )
+
         lt = self.left.with_columns(
             _pw_t=self.left_time,
         )
+        lt = apply_temporal_behavior(lt, self.behavior, "_pw_t")
         lt = lt.with_columns(
             _pw_buckets=expr.apply_with_type(left_buckets, tuple, lt._pw_t)
         )
         lflat = lt.flatten(lt._pw_buckets, origin_id="_pw_left_id")
         rt = self.right.with_columns(_pw_t=self.right_time)
+        rt = apply_temporal_behavior(rt, self.behavior, "_pw_t")
         rt = rt.with_columns(
             _pw_bucket=expr.apply_with_type(right_bucket, int, rt._pw_t)
         )
@@ -115,7 +123,7 @@ class IntervalJoinResult:
         inner = matched.select(**resolved)
 
         if self.kind == JoinKind.INNER:
-            return inner
+            return self._post_behavior(inner)
         # outer variants: pad unmatched sides
         parts = [inner]
         if self.kind in (JoinKind.LEFT, JoinKind.OUTER):
@@ -138,7 +146,15 @@ class IntervalJoinResult:
                 for name, e in out_exprs.items()
             }
             parts.append(unmatched_right.select(**pad))
-        return parts[0].concat_reindex(*parts[1:])
+        return self._post_behavior(parts[0].concat_reindex(*parts[1:]))
+
+    def _post_behavior(self, result: Table) -> Table:
+        """keep_results=True forgetting must not remove already-delivered join results
+        (reference ``_interval_join.py:451``)."""
+        b = self.behavior
+        if b is not None and b.cutoff is not None and b.keep_results:
+            result = result._filter_out_results_of_forgetting()
+        return result
 
     @staticmethod
     def _unmatched(table: Table, matched_ids: Table) -> Table:
@@ -238,7 +254,14 @@ def interval_join(
     how: JoinKind = JoinKind.INNER,
 ) -> IntervalJoinResult:
     return IntervalJoinResult(
-        self, other, self._resolve(self_time), other._resolve(other_time), iv, on, how
+        self,
+        other,
+        self._resolve(self_time),
+        other._resolve(other_time),
+        iv,
+        on,
+        how,
+        behavior=behavior,
     )
 
 

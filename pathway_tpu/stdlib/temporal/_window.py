@@ -125,11 +125,19 @@ def intervals_over(*, at: Any, lower_bound: Any, upper_bound: Any, is_outer: boo
 class WindowedTable:
     """Result of ``windowby``; call ``.reduce(...)``."""
 
-    def __init__(self, assigned: Table, instance_name: str | None, window: Window, shard_cols: Dict[str, str]):
+    def __init__(
+        self,
+        assigned: Table,
+        instance_name: str | None,
+        window: Window,
+        shard_cols: Dict[str, str],
+        behavior: Any = None,
+    ):
         self.assigned = assigned
         self.instance_name = instance_name
         self.window = window
         self.shard_cols = shard_cols  # user column name -> assigned column name
+        self.behavior = behavior
 
     def reduce(self, *args: Any, **kwargs: Any) -> Table:
         t = self.assigned
@@ -146,7 +154,50 @@ class WindowedTable:
         resolved = {}
         for name, e in out_exprs.items():
             resolved[name] = _rebind_window_refs(e, t, self.instance_name)
-        return grouped.reduce(**resolved)
+        result = grouped.reduce(**resolved)
+        from pathway_tpu.stdlib.temporal.temporal_behavior import CommonBehavior
+
+        if (
+            isinstance(self.behavior, CommonBehavior)
+            and self.behavior.cutoff is not None
+            and self.behavior.keep_results
+        ):
+            # forgetting retractions (neu times) must not remove delivered window results
+            result = result._filter_out_results_of_forgetting()
+        if isinstance(self.window, IntervalsOverWindow) and self.window.is_outer:
+            result = self._add_empty_windows(result, resolved)
+        return result
+
+    def _add_empty_windows(self, result: Table, resolved: Dict[str, Any]) -> Table:
+        """Outer intervals_over: every ``at`` point yields a window even with no rows
+        (reference ``_window.py:831``); reducer columns are None for empty windows."""
+        if self.instance_name:
+            return result  # instance-grouped outer windows not yet supported
+        at_col = self.window.at  # type: ignore[attr-defined]
+        ats = at_col.table.groupby(at_col).reduce(_pw_at=at_col)
+        win = ats.select(_pw_window_start=ats._pw_at, _pw_window_end=ats._pw_at)
+        win = win.with_id(win.pointer_from(win._pw_window_start, win._pw_window_end))
+        null_exprs: Dict[str, Any] = {}
+        for name, e in resolved.items():
+            null_exprs[name] = _empty_window_value(e, win)
+        empty_rows = win.select(**null_exprs)
+        return empty_rows.update_rows(result)
+
+
+def _empty_window_value(e: Any, win: Table) -> Any:
+    """Value of a reduce output expression over an empty window: window-bound refs map to
+    the ``at`` point's window columns, anything involving data reducers becomes None."""
+    if isinstance(e, expr.ColumnReference):
+        if e.name in ("_pw_window_start", "_pw_window_end"):
+            return win[e.name]
+        return expr.ColumnConstExpression(None)
+    if isinstance(e, expr.MakeTupleExpression):
+        parts = [_empty_window_value(v, win) for v in e._args]
+        if all(
+            isinstance(p, (expr.ColumnReference, expr.ColumnConstExpression)) for p in parts
+        ):
+            return expr.make_tuple(*parts)
+    return expr.ColumnConstExpression(None)
 
 
 def _rebind_window_refs(e: Any, t: Table, instance_name: str | None) -> Any:
@@ -205,10 +256,12 @@ def windowby(
     elif isinstance(window, IntervalsOverWindow):
         assigned = _assign_intervals_over(table, time_e, window, instance_name)
     else:
-        assigned = window.assign(table, time_e)
+        with_time = table.with_columns(_pw_time=time_e)
+        assigned = window.assign(with_time, with_time._pw_time)
+    behavior = _canonical_behavior(behavior, window)
     if behavior is not None:
         assigned = _apply_behavior(assigned, behavior)
-    return WindowedTable(assigned, instance_name, window, {})
+    return WindowedTable(assigned, instance_name, window, {}, behavior=behavior)
 
 
 def _assign_sessions(
@@ -260,7 +313,7 @@ def _assign_sessions(
     return with_bounds.with_columns(
         _pw_window_start=with_bounds._pw_session[0],
         _pw_window_end=with_bounds._pw_session[1],
-    ).without("_pw_session", "_pw_time")
+    ).without("_pw_session")
 
 
 def _assign_intervals_over(
@@ -288,13 +341,46 @@ def _assign_intervals_over(
     flat = matched.flatten(matched._pw_window_start)
     return flat.with_columns(
         _pw_window_end=flat._pw_window_start,
-    ).without("_pw_time")
+    )
+
+
+def _canonical_behavior(behavior: Any, window: Window) -> Any:
+    """ExactlyOnceBehavior desugars to common_behavior(duration+shift, shift, True) as in
+    the reference (``_window.py:373-389``)."""
+    from pathway_tpu.stdlib.temporal.temporal_behavior import (
+        CommonBehavior,
+        ExactlyOnceBehavior,
+        common_behavior,
+    )
+
+    if not isinstance(behavior, ExactlyOnceBehavior):
+        return behavior
+    duration = getattr(window, "duration", None)
+    if duration is None:
+        raise ValueError("exactly_once_behavior requires a tumbling/sliding window")
+    shift = behavior.shift
+    if shift is None:
+        shift = (
+            datetime.timedelta(0) if isinstance(duration, datetime.timedelta) else 0
+        )
+    return common_behavior(duration + shift, shift, True)
 
 
 def _apply_behavior(assigned: Table, behavior: Any) -> Table:
-    from pathway_tpu.stdlib.temporal.temporal_behavior import CommonBehavior, ExactlyOnceBehavior
+    """Wire behavior onto the assigned rows via the engine's time-threshold operators,
+    in the reference's order (``_window.py:395-414``): freeze late rows past the cutoff,
+    buffer emission until window_start+delay, forget rows past the cutoff."""
+    from pathway_tpu.stdlib.temporal.temporal_behavior import CommonBehavior
 
-    # batch engine note: behaviors gate emission/retraction on event time; the buffer/forget
-    # mechanics live in the BufferNode/ForgetNode evaluators (round-2 wiring); in batch mode
-    # they are no-ops, matching the reference's batch semantics.
-    return assigned
+    if not isinstance(behavior, CommonBehavior):
+        raise ValueError(f"unsupported window behavior: {behavior!r}")
+    t = assigned
+    if behavior.cutoff is not None:
+        t = t._freeze(t._pw_window_end + behavior.cutoff, t._pw_time)
+    if behavior.delay is not None:
+        t = t._buffer(t._pw_window_start + behavior.delay, t._pw_time)
+    if behavior.cutoff is not None:
+        t = t._forget(
+            t._pw_window_end + behavior.cutoff, t._pw_time, behavior.keep_results
+        )
+    return t

@@ -34,3 +34,22 @@ def common_behavior(delay: Any = None, cutoff: Any = None, keep_results: bool = 
 
 def exactly_once_behavior(shift: Any = None) -> ExactlyOnceBehavior:
     return ExactlyOnceBehavior(shift)
+
+
+def apply_temporal_behavior(
+    table: Any, behavior: Optional[CommonBehavior], time_column: str = "_pw_time"
+) -> Any:
+    """Apply a behavior to a table carrying a time column (reference
+    ``temporal_behavior.py:102-113``): delay buffers rows, cutoff freezes late rows and
+    forgets old ones."""
+    if behavior is None:
+        return table
+    t = table[time_column]
+    if behavior.delay is not None:
+        table = table._buffer(t + behavior.delay, t)
+        t = table[time_column]
+    if behavior.cutoff is not None:
+        table = table._freeze(t + behavior.cutoff, t)
+        t = table[time_column]
+        table = table._forget(t + behavior.cutoff, t, behavior.keep_results)
+    return table

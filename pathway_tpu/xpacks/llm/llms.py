@@ -71,6 +71,9 @@ class OpenAIChat(BaseChat):
                     import openai
                 except ImportError as e:
                     raise ImportError("openai client library is not installed") from e
+                from pathway_tpu.xpacks.llm._utils import close_async_client
+
+                await close_async_client(self._client)
                 self._client = openai.AsyncOpenAI(api_key=self.api_key)
                 self._client_loop = loop
             merged = {k: v for k, v in {**self.kwargs, **kwargs}.items() if v is not None}

@@ -1,0 +1,99 @@
+"""Index query semantics: as-of-now (answers never revisited) vs full differential
+(``DataIndex.query`` re-answers when the index changes) — reference
+``ml/test_index.py`` ``update_old`` vs ``asof_now`` semantics — plus CSV error poisoning.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnnFactory,
+    BruteForceKnnMetricKind,
+)
+
+from .utils import T, capture_rows
+
+
+@pw.udf
+def _vec_embedder(text: str) -> np.ndarray:
+    # deterministic 4-dim embedding: one-hot-ish on first char
+    v = np.zeros(4, dtype=np.float32)
+    v[ord(text[0]) % 4] = 1.0
+    v[3] = len(text) / 100.0
+    return v
+
+
+def _make_index(docs):
+    factory = BruteForceKnnFactory(
+        dimensions=4, metric=BruteForceKnnMetricKind.L2SQ, embedder=_vec_embedder
+    )
+    return factory.build_index(docs.text, docs)
+
+
+def test_query_reanswers_on_index_growth():
+    # doc "dzz" (far) exists when the query arrives; doc "aaa" (exact) arrives later.
+    docs = T(
+        """
+        text | __time__
+        dzz  | 0
+        aaa  | 4
+        """
+    )
+    queries = T(
+        """
+        q   | __time__
+        abc | 2
+        """
+    )
+    index = _make_index(docs)
+    res = index.query(queries.q, number_of_matches=1, collapse_rows=True)
+    rows = capture_rows(res)
+    assert len(rows) == 1
+    # full differential semantics: the late-arriving closer doc replaces the answer
+    assert rows[0]["text"] == ("aaa",)
+
+
+def test_query_as_of_now_keeps_first_answer():
+    docs = T(
+        """
+        text | __time__
+        dzz  | 0
+        aaa  | 4
+        """
+    )
+    queries = T(
+        """
+        q   | __time__
+        abc | 2
+        """
+    )
+    index = _make_index(docs)
+    res = index.query_as_of_now(queries.q, number_of_matches=1, collapse_rows=True)
+    rows = capture_rows(res)
+    assert len(rows) == 1
+    # as-of-now: answered against the index state at query arrival, never revisited
+    assert rows[0]["text"] == ("dzz",)
+
+
+def test_csv_malformed_field_poisons_with_error(tmp_path):
+    from pathway_tpu.engine.columnar import Error
+
+    csv_file = tmp_path / "data.csv"
+    csv_file.write_text("a,b\n1,2\nbad,3\n")
+
+    class Sch(pw.Schema):
+        a: int
+        b: int
+
+    t = pw.io.csv.read(str(csv_file), schema=Sch, mode="static")
+    rows = sorted(capture_rows(t), key=lambda r: r["b"])
+    assert rows[0] == {"a": 1, "b": 2}
+    # malformed int field poisons the cell rather than silently becoming None
+    assert isinstance(rows[1]["a"], Error)
+    assert rows[1]["b"] == 3
+
+    # remove_errors drops the poisoned row (reference Value::Error propagation contract)
+    clean = pw.io.csv.read(str(csv_file), schema=Sch, mode="static").remove_errors()
+    assert capture_rows(clean) == [{"a": 1, "b": 2}]

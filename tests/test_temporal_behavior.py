@@ -1,0 +1,155 @@
+"""Temporal behavior mechanics: buffer (delay), freeze+forget (cutoff), exactly-once.
+
+Mirrors the reference's window-behavior test surface (``python/pathway/tests/temporal/``,
+engine semantics from ``src/engine/dataflow/operators/time_column.rs``).
+"""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+
+from .utils import T, capture_rows, capture_update_stream
+
+
+def _win_rows(res):
+    return sorted(
+        (r["_pw_window_start"], r["cnt"]) for r in capture_rows(res)
+    )
+
+
+def test_tumbling_delay_buffers_until_time_passes():
+    t = T(
+        """
+        t | __time__
+        1 | 0
+        3 | 2
+        9 | 4
+        """
+    )
+    w = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=2),
+        behavior=pw.temporal.common_behavior(delay=2),
+    )
+    res = w.reduce(pw.this._pw_window_start, cnt=pw.reducers.count())
+    stream = capture_update_stream(res)
+    # all three windows present at the end (close flushes the buffer)
+    finals = sorted(
+        (r["_pw_window_start"], r["cnt"]) for r in stream if r["__diff__"] > 0
+    )
+    assert finals == [(0, 1), (2, 1), (8, 1)]
+    # window [0,2) (threshold start+2=2) must not be emitted before the row with t=3
+    # arrived (engine commit time 2)
+    w0 = [r for r in stream if r["_pw_window_start"] == 0]
+    assert all(r["__time__"] >= 2 for r in w0)
+
+
+def test_exactly_once_single_emission_per_window():
+    t = T(
+        """
+        t | __time__
+        0 | 0
+        1 | 2
+        5 | 4
+        """
+    )
+    w = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=2),
+        behavior=pw.temporal.exactly_once_behavior(),
+    )
+    res = w.reduce(pw.this._pw_window_start, cnt=pw.reducers.count())
+    stream = capture_update_stream(res)
+    # window [0,2) holds two rows arriving in different commits; exactly-once means a
+    # single insertion with the final count and no retraction ever
+    w0 = [r for r in stream if r["_pw_window_start"] == 0]
+    assert [(r["cnt"], r["__diff__"]) for r in w0] == [(2, 1)]
+    assert all(r["__diff__"] > 0 for r in stream)
+
+
+def test_cutoff_ignores_late_rows_keep_results():
+    t = T(
+        """
+        t | __time__
+        1 | 0
+        5 | 2
+        1 | 4
+        """
+    )
+    # cutoff=0: window [0,2) stops accepting once time reaches its end; the late t=1 row
+    # at commit 4 is ignored, but delivered results stay (keep_results=True default)
+    w = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=2),
+        behavior=pw.temporal.common_behavior(cutoff=0),
+    )
+    res = w.reduce(pw.this._pw_window_start, cnt=pw.reducers.count())
+    assert _win_rows(res) == [(0, 1), (4, 1)]
+
+
+def test_cutoff_keep_results_false_removes_closed_windows():
+    t = T(
+        """
+        t | __time__
+        1 | 0
+        9 | 2
+        """
+    )
+    w = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=2),
+        behavior=pw.temporal.common_behavior(cutoff=0, keep_results=False),
+    )
+    res = w.reduce(pw.this._pw_window_start, cnt=pw.reducers.count())
+    # window [0,2) was forgotten (time passed 2+cutoff) and results removed;
+    # window [8,10) never hit its cutoff so it stays
+    assert _win_rows(res) == [(8, 1)]
+
+
+def test_table_buffer_operator_order():
+    t = T(
+        """
+        v | __time__
+        4 | 0
+        1 | 2
+        2 | 4
+        """
+    )
+    # buffer until the stream's time (v values) reaches v: v=4 arrives first but is only
+    # emitted once now >= 4 — which never happens from later rows, so it flushes at close
+    buffered = t._buffer(pw.this.v, pw.this.v)
+    stream = capture_update_stream(buffered)
+    emitted = [(r["v"], r["__time__"]) for r in stream if r["__diff__"] > 0]
+    assert sorted(v for v, _ in emitted) == [1, 2, 4]
+    t1 = dict(emitted)[1]
+    t2 = dict(emitted)[2]
+    assert t1 <= t2
+
+
+def test_intervals_over_outer_emits_empty_windows():
+    data = T(
+        """
+        t  | v
+        2  | 10
+        3  | 20
+        """
+    )
+    probes = T(
+        """
+        at
+        2
+        6
+        """
+    )
+    w = data.windowby(
+        data.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-1, upper_bound=0, is_outer=True
+        ),
+    )
+    res = w.reduce(pw.this._pw_window_start, cnt=pw.reducers.count())
+    rows = sorted(
+        (r["_pw_window_start"], r["cnt"]) for r in capture_rows(res)
+    )
+    # at=2 sees rows t in [1,2] -> just t=2; at=6 sees nothing but still yields a window
+    assert rows == [(2, 1), (6, None)]
