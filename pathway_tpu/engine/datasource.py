@@ -33,6 +33,22 @@ class DataSource:
     def on_stop(self) -> None:
         pass
 
+    # -- persistence hooks (reference ``OffsetValue``, ``offset.rs:37``) ----
+
+    def offset_state(self) -> dict:
+        """Light resumable position, journaled every commit."""
+        return {}
+
+    def subject_state(self) -> Any:
+        """Heavyweight scanner state (reference ``cached_object_storage.rs``); dumped
+        at snapshot intervals only."""
+        return None
+
+    def restore(self, offset: dict, subject_state: Any, subject_consumed: int = 0) -> None:
+        """Reposition so already-journaled events are not re-emitted after replay.
+        ``subject_state`` (if any) corresponds to ``subject_consumed`` events having been
+        delivered; the gap up to ``offset``'s count is skipped by re-push dedup."""
+
 
 class StaticDataSource(DataSource):
     """All rows present at time 0 (batch mode)."""
@@ -45,8 +61,22 @@ class StaticDataSource(DataSource):
         self._done = False
 
     def on_start(self) -> None:
-        # a fresh GraphRunner re-runs the whole graph (debug captures, repeated pw.run)
-        self._done = False
+        # a fresh GraphRunner re-runs the whole graph (debug captures, repeated pw.run),
+        # unless a persistence restore marked the rows as replayed — a one-shot flag so
+        # later runs of the same graph without persistence still re-emit
+        if getattr(self, "_restored_done", False):
+            self._restored_done = False
+        else:
+            self._done = False
+
+    def offset_state(self) -> dict:
+        return {"done": self._done}
+
+    def restore(self, offset: dict, subject_state: Any, subject_consumed: int = 0) -> None:
+        # replayed journal already carries the rows; don't emit them again
+        if offset.get("done"):
+            self._done = True
+            self._restored_done = True
 
     def next_batch(self, column_names: List[str]) -> Delta:
         if self._done:
@@ -86,11 +116,23 @@ class StreamingDataSource(DataSource):
         self._thread: threading.Thread | None = None
         self._autocommit_ms = autocommit_ms
         self._seq = 0
+        # persistence: events consumed so far; on resume, deterministically re-pushed
+        # events up to the journaled count are skipped (the "seek")
+        self._consumed = 0
+        self._skip = 0
+        # latest in-band subject state marker: (state, consumed count when it arrived).
+        # State rides the event queue, so it is ordered after exactly the events it
+        # accounts for — no cross-thread snapshot races, no count misalignment.
+        self._latest_state: tuple | None = None
 
     # producer API ----------------------------------------------------------
 
     def push(self, values: dict, key: Pointer | None = None, diff: int = 1) -> None:
         self.events.put(("data", key, values, diff))
+
+    def push_state(self, state: Any) -> None:
+        """Producer checkpoints its replay state in-band (after the events it covers)."""
+        self.events.put(("state", state))
 
     def close(self) -> None:
         self.events.put(("eof",))
@@ -122,7 +164,14 @@ class StreamingDataSource(DataSource):
             if event[0] == "eof":
                 self._finished.set()
                 break
+            if event[0] == "state":
+                self._latest_state = (event[1], self._consumed)
+                continue
             _, key, values, diff = event
+            if self._skip > 0:
+                self._skip -= 1
+                continue
+            self._consumed += 1
             rows.append((key, values, diff))
             if time_mod.monotonic() > deadline and rows:
                 break
@@ -148,6 +197,29 @@ class StreamingDataSource(DataSource):
 
     def is_finished(self) -> bool:
         return self._finished.is_set() and self.events.empty()
+
+    # -- persistence ---------------------------------------------------------
+
+    def offset_state(self) -> dict:
+        return {"consumed": self._consumed, "seq": self._seq}
+
+    def subject_state(self) -> tuple | None:
+        """Latest in-band (state, consumed-count) marker — already consistent, no copy."""
+        return self._latest_state
+
+    def restore(self, offset: dict, subject_state: Any, subject_consumed: int = 0) -> None:
+        self._seq = offset.get("seq", 0)
+        consumed = offset.get("consumed", 0)
+        restored_to = 0
+        sub_restore = getattr(self.subject, "restore", None)
+        if sub_restore is not None and subject_state is not None:
+            # the subject repositions to the dumped state, which accounts for exactly
+            # subject_consumed delivered events; the gap dedups by skip-count
+            sub_restore(subject_state)
+            restored_to = subject_consumed
+            self._latest_state = (subject_state, consumed)
+        self._consumed = consumed
+        self._skip = max(consumed - restored_to, 0)
 
 
 def _tidy_col(col: np.ndarray) -> np.ndarray:

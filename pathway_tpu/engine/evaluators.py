@@ -1286,6 +1286,11 @@ class OutputEvaluator(Evaluator):
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
+        if (
+            getattr(self.runner, "_inject", None) is not None
+            and not getattr(self.runner, "replay_outputs", True)
+        ):
+            return Delta.empty([])  # journal replay with silent sinks
         if self.callback is not None and len(delta):
             ptrs = keys_to_pointers(delta.keys)
             time = self.runner.current_time

@@ -29,11 +29,16 @@ class GraphRunner:
         self._ready = False
         self.draining = False
         self._step_counts: Dict[int, int] = {}
+        self._persistence: Any = None
+        self._inject: Optional[Dict[int, Delta]] = None  # journal replay injection
+        self._input_deltas: Dict[int, Delta] = {}
+        self._dumped_markers: Dict[int, int] = {}
+        self.replay_outputs = True
 
     def state_of(self, node: pg.Node) -> StateTable:
         return self.states[node.id]
 
-    def setup(self, monitoring_level: Any = None) -> None:
+    def setup(self, monitoring_level: Any = None, persistence_config: Any = None) -> None:
         from pathway_tpu.engine.evaluators import EVALUATORS
 
         self._nodes = list(self.graph.nodes)
@@ -51,10 +56,43 @@ class GraphRunner:
             for node in self._nodes
             if isinstance(node, pg.InputNode)
         ]
+        replay_frames = []
+        if persistence_config is not None and persistence_config.backend is not None:
+            from pathway_tpu.persistence.engine import PersistenceManager
+
+            self._persistence = PersistenceManager(persistence_config)
+            # "silent_replay" keeps external sinks from re-receiving already-delivered
+            # rows on resume (in-process subscribers then rebuild state themselves)
+            self.replay_outputs = persistence_config.persistence_mode != "silent_replay"
+            sig = self.graph.sig()
+            replay_frames = self._persistence.load_journal(sig)
+            self._persistence.open_for_append(sig)
+            if replay_frames:
+                self._restore_sources(replay_frames[-1][2])
         for node, evaluator in self._sources:
             node.config["source"].on_start()
         self._monitor = _make_monitor(monitoring_level, self._nodes)
         self._ready = True
+        # replay journaled input deltas through the (deterministic) graph to rebuild
+        # every operator's state, before any realtime stepping
+        for commit_id, input_deltas, _offsets in replay_frames:
+            self._inject = input_deltas
+            self.step()
+        self._inject = None
+
+    def _restore_sources(self, last_offsets: Dict[int, dict]) -> None:
+        blob = self._persistence.load_sources()
+        states: Dict[int, Any] = {}
+        dump_offsets: Dict[int, dict] = {}
+        if blob is not None:
+            states, dump_offsets = blob
+        for node, _ in self._sources:
+            source = node.config["source"]
+            source.restore(
+                last_offsets.get(node.id, {}),
+                states.get(node.id),
+                dump_offsets.get(node.id, {}).get("consumed", 0),
+            )
 
     def step(self) -> bool:
         """Run one commit; returns True if any node produced output.
@@ -75,6 +113,26 @@ class GraphRunner:
         ):
             self.current_time = self._commit * 2 + 1
             any_output = self._substep(neu=True) or any_output
+        if (
+            self._persistence is not None
+            and self._inject is None
+            and any(len(d) for d in self._input_deltas.values())
+        ):
+            offsets = {n.id: n.config["source"].offset_state() for n, _ in self._sources}
+            self._persistence.record_commit(self._commit, self._input_deltas, offsets)
+            # markers are O(1) handles to in-band subject checkpoints; dump only
+            # when one actually advanced
+            markers = {
+                n.id: m
+                for n, _ in self._sources
+                if (m := n.config["source"].subject_state()) is not None
+            }
+            if markers and {k: id(v) for k, v in markers.items()} != self._dumped_markers:
+                self._persistence.maybe_dump_sources(
+                    {nid: m[0] for nid, m in markers.items()},
+                    {nid: {"consumed": m[1]} for nid, m in markers.items()},
+                )
+                self._dumped_markers = {k: id(v) for k, v in markers.items()}
         if self._monitor is not None:
             self._monitor.update(self._commit, self._step_counts, self.states)
         self._commit += 1
@@ -88,11 +146,17 @@ class GraphRunner:
         for node in self._nodes:
             evaluator = self.evaluators[node.id]
             if isinstance(node, pg.InputNode):
-                delta = (
-                    Delta.empty(self.output_columns_of(node))
-                    if neu
-                    else evaluator.process([])
-                )
+                if neu:
+                    delta = Delta.empty(self.output_columns_of(node))
+                elif self._inject is not None:
+                    # journal replay: feed the persisted delta instead of the source
+                    delta = self._inject.get(
+                        node.id, Delta.empty(self.output_columns_of(node))
+                    )
+                else:
+                    delta = evaluator.process([])
+                if not neu:
+                    self._input_deltas[node.id] = delta
             else:
                 inputs = [
                     deltas.get(inp._node.id, Delta.empty(inp.column_names()))
@@ -136,6 +200,8 @@ class GraphRunner:
             evaluator = self.evaluators.get(node.id)
             if isinstance(evaluator, OutputEvaluator):
                 evaluator.finish()
+        if self._persistence is not None:
+            self._persistence.close()
         if self._monitor is not None:
             self._monitor.close()
 
@@ -146,10 +212,11 @@ class GraphRunner:
         with_http_server: bool = False,
         terminate_on_error: bool = True,
         max_commits: int | None = None,
+        persistence_config: Any = None,
         **kwargs: Any,
     ) -> None:
         if not self._ready:
-            self.setup(monitoring_level)
+            self.setup(monitoring_level, persistence_config=persistence_config)
         commits = 0
         try:
             while True:

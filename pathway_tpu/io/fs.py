@@ -144,6 +144,22 @@ class _FsSubject:
         self.seen: Dict[str, float] = {}
         self.emitted: Dict[str, List[dict]] = {}
 
+    # -- persistence: the scanner's seen/emitted maps are the analogue of the
+    # reference's cached_object_storage (replay without re-reading unchanged files).
+    # State is checkpointed *in-band* (push_state after each file's events), so each
+    # marker is ordered after exactly the events it accounts for — no snapshot races.
+
+    def _state_snapshot(self) -> dict:
+        return {
+            "seen": dict(self.seen),
+            "emitted": {k: list(v) for k, v in self.emitted.items()},
+        }
+
+    def restore(self, state: dict) -> None:
+        """Called before the scanner thread starts; repositions the scan."""
+        self.seen = dict(state.get("seen", {}))
+        self.emitted = {k: list(v) for k, v in state.get("emitted", {}).items()}
+
     def run(self, source: StreamingDataSource) -> None:
         stop = False
         while not stop:
@@ -161,6 +177,7 @@ class _FsSubject:
                     source.push(row, diff=1)
                 self.seen[filepath] = mtime
                 self.emitted[filepath] = rows
+                source.push_state(self._state_snapshot())
             if self.mode in ("static", "batch"):
                 stop = True
             else:

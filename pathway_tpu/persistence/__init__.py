@@ -45,6 +45,13 @@ class Backend:
 
 
 class Config:
+    """Persistence settings (reference ``persistence/__init__.py:88``).
+
+    ``persistence_mode="silent_replay"`` keeps output callbacks / external sinks from
+    re-receiving already-delivered rows during journal replay on resume (the default
+    re-delivers, matching the reference's speedrun replay where sinks dedup by key).
+    """
+
     def __init__(
         self,
         backend: Backend | None = None,

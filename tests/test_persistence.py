@@ -1,0 +1,204 @@
+"""Checkpoint/resume: input journal, replay, offset seek, crash recovery.
+
+Mirrors the reference's persistence test surface: ``test_persistence.py`` unit level plus
+the ``integration_tests/wordcount`` kill-and-restart rig (``base.py:320``) at small scale.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.engine.runner import GraphRunner
+from pathway_tpu.internals.parse_graph import G
+
+
+def _collect(table):
+    rows = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[key] = row
+        else:
+            rows.pop(key, None)
+
+    pw.io.subscribe(table, on_change)
+    return rows
+
+
+def _build_static_pipeline():
+    t = pw.debug.table_from_markdown(
+        """
+        word  | n
+        cat   | 1
+        dog   | 2
+        cat   | 3
+        """
+    )
+    counts = t.groupby(t.word).reduce(t.word, total=pw.reducers.sum(t.n))
+    return _collect(counts)
+
+
+def test_journal_replay_reproduces_state(tmp_path):
+    cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(tmp_path / "pstore"))
+
+    rows1 = _build_static_pipeline()
+    GraphRunner(G._current).run(persistence_config=cfg)
+    result1 = {tuple(sorted(r.items())) for r in rows1.values()}
+    assert {dict(r)["word"] for r in result1} == {"cat", "dog"}
+
+    # "restart": fresh graph + fresh runner over the same store — rows must come from
+    # the journal (the static source is marked consumed by the restored offsets)
+    G.clear()
+    rows2 = _build_static_pipeline()
+    GraphRunner(G._current).run(persistence_config=cfg)
+    result2 = {tuple(sorted(r.items())) for r in rows2.values()}
+    assert result2 == result1
+
+    # journal only holds ONE copy of the input (no duplicate journaling on resume)
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    frames = PersistenceManager(cfg).load_journal(G._current.sig())
+    total_rows = sum(len(d) for _, deltas, _ in frames for d in deltas.values())
+    assert total_rows == 3
+
+
+def test_streaming_resume_after_partial_run(tmp_path):
+    """Simulated crash: stop mid-stream without finish(), resume, verify exact result."""
+
+    class NumbersSubject:
+        """Deterministically pushes 0..19; re-pushed events dedup via skip-count."""
+
+        def run(self, source):
+            for i in range(20):
+                source.push({"v": i})
+
+    def build():
+        from pathway_tpu.engine.datasource import StreamingDataSource
+        from pathway_tpu.internals import parse_graph as pg
+        from pathway_tpu.internals.table import Table
+
+        schema = pw.schema_builder({"v": int})
+        source = StreamingDataSource(subject=NumbersSubject(), autocommit_ms=5)
+        node = G.add_node(pg.InputNode(source=source, streaming=True, name="numbers"))
+        t = Table(node, schema, name="numbers")
+        total = t.reduce(total=pw.reducers.sum(t.v))
+        return _collect(total)
+
+    cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(tmp_path / "ps"))
+
+    rows1 = build()
+    r1 = GraphRunner(G._current)
+    r1.run(persistence_config=cfg, max_commits=3)  # stop early; finish() not called
+
+    G.clear()
+    rows2 = build()
+    GraphRunner(G._current).run(persistence_config=cfg)
+    assert [r["total"] for r in rows2.values()] == [sum(range(20))]
+
+
+def test_silent_replay_suppresses_sink_redelivery(tmp_path):
+    cfg = pw.persistence.Config(
+        pw.persistence.Backend.filesystem(tmp_path / "ps"),
+        persistence_mode="silent_replay",
+    )
+    rows1 = _build_static_pipeline()
+    GraphRunner(G._current).run(persistence_config=cfg)
+    assert len(rows1) == 2
+
+    G.clear()
+    rows2 = _build_static_pipeline()
+    GraphRunner(G._current).run(persistence_config=cfg)
+    # replayed history was not re-delivered to the sink, and no new data arrived
+    assert rows2 == {}
+
+
+_CRASH_SCRIPT = r"""
+import os, sys
+import pathway_tpu as pw
+
+input_path, out_path, store = sys.argv[1], sys.argv[2], sys.argv[3]
+
+class Sch(pw.Schema):
+    word: str
+
+t = pw.io.csv.read(input_path, schema=Sch, mode="streaming", autocommit_duration_ms=20)
+counts = t.groupby(t.word).reduce(t.word, total=pw.reducers.count())
+
+import json
+rows = {}
+def on_change(key, row, time, is_addition):
+    if is_addition:
+        rows[repr(key)] = {k: int(v) if hasattr(v, "item") else v for k, v in row.items()}
+    else:
+        rows.pop(repr(key), None)
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(list(rows.values()), f)
+    os.replace(out_path + ".tmp", out_path)
+
+pw.io.subscribe(counts, on_change)
+cfg = pw.persistence.Config(
+    pw.persistence.Backend.filesystem(store), snapshot_interval_ms=10
+)
+pw.run(persistence_config=cfg)
+"""
+
+
+def test_crash_kill_and_restart_wordcount(tmp_path):
+    """The wordcount torture rig at small scale: kill -9 mid-run, restart, exact output."""
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    out_path = str(tmp_path / "out.json")
+    store = str(tmp_path / "store")
+    script = tmp_path / "prog.py"
+    script.write_text(_CRASH_SCRIPT)
+
+    (input_dir / "a.csv").write_text("word\n" + "\n".join(["cat"] * 5 + ["dog"] * 3) + "\n")
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(input_dir), out_path, store],
+        env=env,
+        cwd="/root/repo",
+    )
+    # wait for it to process the first file, then kill -9
+    deadline = time.time() + 60
+    while time.time() < deadline and not os.path.exists(out_path):
+        time.sleep(0.1)
+    assert os.path.exists(out_path), "pipeline never produced output"
+    time.sleep(0.5)
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+
+    # add more data while the pipeline is down
+    (input_dir / "b.csv").write_text("word\n" + "\n".join(["cat"] * 2 + ["owl"] * 4) + "\n")
+
+    # restart; it must resume (not double-count a.csv) and pick up b.csv
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(input_dir), out_path, store],
+        env=env,
+        cwd="/root/repo",
+    )
+    try:
+        deadline = time.time() + 90
+        expected = {"cat": 7, "dog": 3, "owl": 4}
+        import json
+
+        while time.time() < deadline:
+            try:
+                with open(out_path) as f:
+                    rows = {r["word"]: r["total"] for r in json.load(f)}
+            except Exception:
+                rows = {}
+            if rows == expected:
+                break
+            time.sleep(0.2)
+        assert rows == expected, f"got {rows}, want {expected}"
+    finally:
+        proc.kill()
+        proc.wait()
