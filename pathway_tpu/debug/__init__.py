@@ -182,17 +182,34 @@ class _TimedSource(StaticDataSource):
         self._schedule = sorted(set(times))
         self._pos = 0
         self._occurrences: dict = {}
+        # All timed sources of one graph share a global clock: each commit releases the
+        # rows of the earliest pending __time__ across the whole graph, so interleaved
+        # streams (e.g. events vs a wall-clock table) arrive in deterministic order.
+        from pathway_tpu.internals.parse_graph import G
+
+        self._clock = G.timed_source_clock
+        self._clock.register(self)
 
     def on_start(self) -> None:
         self._pos = 0
         self._done = False
         self._occurrences = {}
+        self._clock._polled = set()
+        self._clock._round_min = None
+
+    def _next_time(self) -> Any:
+        if self._done or self._pos >= len(self._schedule):
+            return None
+        return self._schedule[self._pos]
 
     def next_batch(self, column_names: List[str]) -> Delta:
         from pathway_tpu.internals.keys import pointers_to_keys
 
         if self._pos >= len(self._schedule):
             self._done = True
+            return Delta.empty(column_names)
+        if not self._clock.may_release(self):
+            # another source owns the globally-earliest timestamp; wait our turn
             return Delta.empty(column_names)
         t = self._schedule[self._pos]
         self._pos += 1

@@ -61,7 +61,10 @@ class Evaluator:
             out = np.empty(len(delta), dtype=object)
             for i in range(len(delta)):
                 row = state.get_row(delta.keys[i].tobytes())
-                out[i] = None if row is None else row[ref.name]
+                # a same-universe reference must hit: a miss means the tables' key sets
+                # genuinely differ (e.g. select over a reindexed table referencing the
+                # pre-reindex table) — poison instead of silently yielding None
+                out[i] = ERROR if row is None else row[ref.name]
             return ee._tidy(out)
 
         return resolver

@@ -148,6 +148,41 @@ class StatefulReduceNode(Node):
     kind = "stateful_reduce"
 
 
+class TimedSourceClock:
+    """Serializes debug ``_TimedSource`` streams onto one global clock.
+
+    Each poll round (one ``next_batch`` call per live source) releases the rows of
+    exactly one globally-minimal ``__time__`` value, so interleaved streams arrive in
+    deterministic commit order. The round's minimum is snapshotted when the round
+    starts; a source re-polled within the commit cannot shift it.
+    """
+
+    def __init__(self) -> None:
+        self.sources: List[Any] = []
+        self._polled: set[int] = set()
+        self._round_min: Any = None
+
+    def clear(self) -> None:
+        self.sources.clear()
+        self._polled.clear()
+        self._round_min = None
+
+    def register(self, source: Any) -> None:
+        self.sources.append(source)
+
+    def may_release(self, source: Any) -> bool:
+        pending = [t for t in (s._next_time() for s in self.sources) if t is not None]
+        if not pending:
+            return True
+        if id(source) in self._polled or self._round_min is None:
+            # a source polled twice means a new commit began: start a fresh round
+            self._polled = set()
+            self._round_min = min(pending)
+        self._polled.add(id(source))
+        nt = source._next_time()
+        return nt is not None and nt == self._round_min
+
+
 class ParseGraph:
     """Global mutable DAG; cleared by ``G.clear()`` between test runs."""
 
@@ -155,6 +190,8 @@ class ParseGraph:
         self.nodes: List[Node] = []
         self._universe_counter = itertools.count()
         self.error_logs: List["Table"] = []
+        # shared clock for debug _TimedSource streams (global __time__ order)
+        self.timed_source_clock = TimedSourceClock()
 
     def add_node(self, node: Node) -> Node:
         node.id = len(self.nodes)
@@ -167,6 +204,7 @@ class ParseGraph:
     def clear(self) -> None:
         self.nodes.clear()
         self.error_logs.clear()
+        self.timed_source_clock.clear()
         self._universe_counter = itertools.count()
 
     def sig(self) -> str:

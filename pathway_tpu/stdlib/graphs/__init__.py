@@ -1,102 +1,27 @@
 """Graph algorithms (parity: reference ``stdlib/graphs`` — pagerank, bellman_ford,
-louvain_communities; all iterate-based)."""
+louvain_communities; all built from incremental Table ops)."""
 
 from __future__ import annotations
 
-from typing import Any
+from pathway_tpu.stdlib.graphs.common import Edge, Vertex, Weight, Clustering, Graph, WeightedGraph
+from pathway_tpu.stdlib.graphs.pagerank import pagerank
+from pathway_tpu.stdlib.graphs.bellman_ford import bellman_ford
+from pathway_tpu.stdlib.graphs.louvain_communities import (
+    exact_modularity,
+    louvain_communities,
+    louvain_level,
+)
 
-import pathway_tpu.internals.expression as expr
-from pathway_tpu.internals import dtype as dt
-from pathway_tpu.internals.iterate import iterate
-from pathway_tpu.internals.reducers import reducers
-from pathway_tpu.internals.table import Table
-
-
-class Edge:
-    """Schema marker: edges have pointer columns u, v (reference ``graphs/common.py``)."""
-
-
-class Vertex:
-    pass
-
-
-class Graph:
-    def __init__(self, vertices: Table, edges: Table):
-        self.V = vertices
-        self.E = edges
-
-
-def pagerank(edges: Table, steps: int = 5, damping: float = 0.85) -> Table:
-    """Iterative pagerank over an edge table with ``u``/``v`` pointer columns.
-
-    Returns a table keyed by vertex with a ``rank`` column (ints scaled by 1000, like the
-    reference's fixed-point formulation).
-    """
-    degrees = edges.groupby(edges.u).reduce(degree=reducers.count())
-    vertices_u = edges.select(v=edges.u)
-    vertices_v = edges.select(v=edges.v)
-    both = vertices_u.concat_reindex(vertices_v)
-    vertices = both.groupby(both.v).reduce(v=both.v)
-
-    def one_step(ranks: Table, edges: Table = edges, degrees: Table = degrees, vertices: Table = vertices) -> dict:
-        deg = degrees
-        # flow along edges: rank[u]/degree[u] summed into v
-        edge_flow = edges.select(
-            v=edges.v,
-            flow=ranks.ix(edges.u).rank // deg.ix(edges.u, optional=False).degree,
-        )
-        inflow = edge_flow.groupby(edge_flow.v).reduce(
-            v=edge_flow.v, total=reducers.sum(edge_flow.flow)
-        )
-        joined = vertices.join_left(inflow, vertices.v == inflow.v).select(
-            v=vertices.v,
-            rank=expr.coalesce(inflow.total, 0) * 5 // 6 + 1000 // 6,
-        )
-        new_ranks = joined.with_id(joined.v).select(rank=joined.rank)
-        return dict(ranks=new_ranks)
-
-    initial = vertices.with_id(vertices.v).select(rank=1000)
-    result = iterate(one_step, iteration_limit=steps, ranks=initial)
-    return result.ranks
-
-
-def bellman_ford(vertices: Table, edges: Table) -> Table:
-    """Single-source shortest paths: ``vertices`` needs ``is_source``; ``edges`` needs
-    ``u``, ``v``, ``dist``."""
-    import math
-
-    initial = vertices.select(
-        dist_from_source=expr.if_else(vertices.is_source, 0.0, math.inf)
-    )
-
-    def one_step(state: Table, edges: Table = edges) -> dict:
-        relaxed = edges.select(
-            v=edges.v,
-            dist=state.ix(edges.u).dist_from_source + edges.dist,
-        )
-        best = relaxed.groupby(relaxed.v).reduce(
-            v=relaxed.v, best=reducers.min(relaxed.dist)
-        )
-        best_by_vertex = best.with_id(best.v)
-        new_state = state.select(
-            dist_from_source=expr.coalesce(
-                expr.apply_with_type(
-                    lambda cur, new: min(cur, new) if new is not None else cur,
-                    float,
-                    state.dist_from_source,
-                    best_by_vertex.ix(state.id, optional=True).best,
-                ),
-                state.dist_from_source,
-            )
-        )
-        return dict(state=new_state)
-
-    result = iterate(one_step, iteration_limit=50, state=initial)
-    return result.state
-
-
-def louvain_communities(graph: Any, **kwargs: Any) -> Table:
-    raise NotImplementedError(
-        "louvain_communities is planned for a later round (reference "
-        "stdlib/graphs/louvain_communities/impl.py:385)"
-    )
+__all__ = [
+    "Edge",
+    "Vertex",
+    "Weight",
+    "Clustering",
+    "Graph",
+    "WeightedGraph",
+    "pagerank",
+    "bellman_ford",
+    "louvain_communities",
+    "louvain_level",
+    "exact_modularity",
+]
