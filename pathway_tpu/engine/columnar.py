@@ -265,6 +265,22 @@ class StateTable:
             columns={name: self._columns[name][slots].copy() for name in self.column_names},
         )
 
+    def state_blob(self) -> bytes:
+        """Compact picklable snapshot (live rows only) for operator checkpoints."""
+        import pickle
+
+        snap = self.snapshot()
+        return pickle.dumps(
+            (snap.keys, snap.diffs, snap.columns), protocol=pickle.HIGHEST_PROTOCOL
+        )
+
+    def load_state_blob(self, blob: bytes) -> None:
+        import pickle
+
+        keys, diffs, columns = pickle.loads(blob)
+        self.__init__(self.column_names)
+        self.apply(Delta(keys, diffs, columns))
+
     def get_row(self, key_b: bytes) -> dict[str, Any] | None:
         slot = self._index.get(key_b)
         if slot is None:

@@ -36,18 +36,25 @@ class DataSource:
     # -- persistence hooks (reference ``OffsetValue``, ``offset.rs:37``) ----
 
     def offset_state(self) -> dict:
-        """Light resumable position, journaled every commit."""
+        """Light resumable position + this frame's segment-state deltas, journaled every
+        commit."""
         return {}
 
-    def subject_state(self) -> Any:
-        """Heavyweight scanner state (reference ``cached_object_storage.rs``); dumped
-        at snapshot intervals only."""
+    def checkpoint_state_deltas(self) -> list | None:
+        """Drained segment markers for operator checkpoints (compaction drops the
+        journal frames that carried them)."""
         return None
 
-    def restore(self, offset: dict, subject_state: Any, subject_consumed: int = 0) -> None:
+    def restore(self, offset: dict, state_deltas: list, tail: dict | None) -> None:
         """Reposition so already-journaled events are not re-emitted after replay.
-        ``subject_state`` (if any) corresponds to ``subject_consumed`` events having been
-        delivered; the gap up to ``offset``'s count is skipped by re-push dedup."""
+
+        ``state_deltas``: every segment-completion marker journaled so far, in order —
+        the subject folds them back into its scan state. ``tail`` describes the segment
+        whose processing straddled the crash: ``{"token", "fp", "count", "rows"}`` with
+        ``rows`` = the journaled ``(key, values, diff)`` events not yet covered by any
+        marker. On the matching segment's re-arrival the source either skips ``count``
+        re-pushed events (fingerprint unchanged — deterministic re-push) or retracts
+        ``rows`` first (segment changed while down)."""
 
 
 class StaticDataSource(DataSource):
@@ -72,7 +79,7 @@ class StaticDataSource(DataSource):
     def offset_state(self) -> dict:
         return {"done": self._done}
 
-    def restore(self, offset: dict, subject_state: Any, subject_consumed: int = 0) -> None:
+    def restore(self, offset: dict, state_deltas: list, tail: dict | None) -> None:
         # replayed journal already carries the rows; don't emit them again
         if offset.get("done"):
             self._done = True
@@ -116,23 +123,40 @@ class StreamingDataSource(DataSource):
         self._thread: threading.Thread | None = None
         self._autocommit_ms = autocommit_ms
         self._seq = 0
-        # persistence: events consumed so far; on resume, deterministically re-pushed
-        # events up to the journaled count are skipped (the "seek")
+        # persistence: events consumed so far (journaled events count as consumed on
+        # resume); deterministically re-pushed events dedup via segment-scoped skips
         self._consumed = 0
         self._skip = 0
-        # latest in-band subject state marker: (state, consumed count when it arrived).
-        # State rides the event queue, so it is ordered after exactly the events it
-        # accounts for — no cross-thread snapshot races, no count misalignment.
-        self._latest_state: tuple | None = None
+        # segment bookkeeping. Markers ride the event queue, so each is ordered after
+        # exactly the events it accounts for — no cross-thread snapshot races.
+        self._in_progress: dict | None = None  # {"token", "fp", "emitted"}
+        self._frame_state_deltas: List[Any] = []  # drained this frame, journaled with it
+        self._drained_state_deltas: List[Any] = []  # full drained marker history
+        # armed at restore when the crash straddled a segment
+        self._pending_resume: dict | None = None  # {"token", "fp", "count", "rows"}
 
     # producer API ----------------------------------------------------------
 
     def push(self, values: dict, key: Pointer | None = None, diff: int = 1) -> None:
         self.events.put(("data", key, values, diff))
 
-    def push_state(self, state: Any) -> None:
-        """Producer checkpoints its replay state in-band (after the events it covers)."""
-        self.events.put(("state", state))
+    def push_begin(self, token: Any, fingerprint: Any) -> None:
+        """Producer marks the start of a replayable segment (e.g. one file): ``token``
+        identifies it, ``fingerprint`` changes iff a re-push of the segment would produce
+        a different event sequence."""
+        self.events.put(("begin", token, fingerprint))
+
+    def push_state(self, state_delta: Any) -> None:
+        """Producer checkpoints the just-finished segment in-band (after its events).
+        The delta is journaled with the commit frame; on resume all deltas are folded
+        back through ``subject.restore``. Ends the current engine batch so journal
+        frames align with segment boundaries."""
+        self.events.put(("state", state_delta))
+
+    def push_barrier(self) -> None:
+        """Producer signals one full scan pass: any still-unmatched crash-straddled
+        segment is gone — its journaled tail events get retracted."""
+        self.events.put(("barrier",))
 
     def close(self) -> None:
         self.events.put(("eof",))
@@ -154,6 +178,7 @@ class StreamingDataSource(DataSource):
 
     def next_batch(self, column_names: List[str]) -> Delta:
         rows: List[tuple] = []
+        self._frame_state_deltas = []
         deadline = time_mod.monotonic() + (self._autocommit_ms or 10) / 1000.0
         while len(rows) < self._MAX_EVENTS_PER_COMMIT:
             timeout = deadline - time_mod.monotonic()
@@ -164,14 +189,57 @@ class StreamingDataSource(DataSource):
             if event[0] == "eof":
                 self._finished.set()
                 break
+            if event[0] == "begin":
+                _, token, fp = event
+                self._in_progress = {"token": token, "fp": fp, "emitted": 0}
+                pending = self._pending_resume
+                if pending is not None and token == pending["token"]:
+                    self._pending_resume = None
+                    if fp == pending["fp"]:
+                        # unchanged segment: the re-push repeats the journaled tail.
+                        # emitted continues from the journaled count so a second crash
+                        # before the marker journals the full skip width
+                        self._skip += pending["count"]
+                        self._in_progress["emitted"] = pending["count"]
+                    else:
+                        # segment changed while down: undo its journaled partial events
+                        rows.extend(
+                            (key, values, -diff)
+                            for key, values, diff in pending["rows"]
+                        )
+                        self._consumed += len(pending["rows"])
+                continue
             if event[0] == "state":
-                self._latest_state = (event[1], self._consumed)
+                self._in_progress = None
+                self._frame_state_deltas.append(event[1])
+                self._drained_state_deltas.append(event[1])
+                if len(self._drained_state_deltas) > 256:
+                    fold = getattr(self.subject, "fold_state_deltas", None)
+                    if fold is not None:
+                        # lossless compaction keeps memory bounded by live state even
+                        # when checkpointing is off
+                        self._drained_state_deltas = list(
+                            fold(self._drained_state_deltas)
+                        )
+                # end the batch: journal frames align with segment boundaries, so the
+                # resume tail never spans more than one segment
+                break
+            if event[0] == "barrier":
+                pending, self._pending_resume = self._pending_resume, None
+                if pending is not None:
+                    # straddled segment never re-appeared (deleted while down)
+                    rows.extend(
+                        (key, values, -diff) for key, values, diff in pending["rows"]
+                    )
+                    self._consumed += len(pending["rows"])
                 continue
             _, key, values, diff = event
             if self._skip > 0:
                 self._skip -= 1
                 continue
             self._consumed += 1
+            if self._in_progress is not None:
+                self._in_progress["emitted"] += 1
             rows.append((key, values, diff))
             if time_mod.monotonic() > deadline and rows:
                 break
@@ -200,26 +268,47 @@ class StreamingDataSource(DataSource):
 
     # -- persistence ---------------------------------------------------------
 
+    def checkpoint_state_deltas(self) -> list | None:
+        if not self._drained_state_deltas:
+            return None
+        fold = getattr(self.subject, "fold_state_deltas", None)
+        if fold is None:
+            return list(self._drained_state_deltas)
+        folded = fold(self._drained_state_deltas)
+        # folding is lossless: prune the history so memory stays bounded by live state
+        self._drained_state_deltas = list(folded)
+        return folded
+
     def offset_state(self) -> dict:
-        return {"consumed": self._consumed, "seq": self._seq}
+        out: dict = {"consumed": self._consumed, "seq": self._seq}
+        if self._frame_state_deltas:
+            out["state_deltas"] = list(self._frame_state_deltas)
+        if self._in_progress is not None:
+            out["in_progress"] = dict(self._in_progress)
+        return out
 
-    def subject_state(self) -> tuple | None:
-        """Latest in-band (state, consumed-count) marker — already consistent, no copy."""
-        return self._latest_state
-
-    def restore(self, offset: dict, subject_state: Any, subject_consumed: int = 0) -> None:
+    def restore(self, offset: dict, state_deltas: list, tail: dict | None) -> None:
         self._seq = offset.get("seq", 0)
         consumed = offset.get("consumed", 0)
-        restored_to = 0
-        sub_restore = getattr(self.subject, "restore", None)
-        if sub_restore is not None and subject_state is not None:
-            # the subject repositions to the dumped state, which accounts for exactly
-            # subject_consumed delivered events; the gap dedups by skip-count
-            sub_restore(subject_state)
-            restored_to = subject_consumed
-            self._latest_state = (subject_state, consumed)
         self._consumed = consumed
-        self._skip = max(consumed - restored_to, 0)
+        self._drained_state_deltas = list(state_deltas)
+        sub_restore = getattr(self.subject, "restore", None)
+        if sub_restore is not None and state_deltas:
+            sub_restore(state_deltas)
+        if tail is None:
+            return
+        if tail.get("token") is not None:
+            # segment-aware subject: dedup/undo decided when the segment re-arrives
+            # (or provably never does — see push_barrier)
+            self._pending_resume = tail
+        elif tail.get("has_markers"):
+            # segment-aware subject with no in-flight segment at crash time: completed
+            # segments won't be re-pushed (the folded state skips them); nothing to dedup
+            self._skip = max(consumed - tail.get("covered", 0), 0)
+        else:
+            # markerless subject: the whole journaled history is deterministically
+            # re-pushed from the start; skip all of it
+            self._skip = consumed
 
 
 def _tidy_col(col: np.ndarray) -> np.ndarray:

@@ -29,6 +29,10 @@ from pathway_tpu.internals.keys import (
 from pathway_tpu.internals.reducers import _IdMarker, _SeqMarker
 
 
+class UnpicklableStateError(Exception):
+    """Operator state can't be checkpointed; the journal must keep full history."""
+
+
 class Evaluator:
     def __init__(self, node: pg.Node, runner: Any):
         self.node = node
@@ -39,6 +43,38 @@ class Evaluator:
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         raise NotImplementedError
+
+    # -- operator snapshots (reference ``operator_snapshot.rs``) -------------
+
+    _NON_STATE_ATTRS = ("node", "runner", "output_columns")
+
+    def state_dict(self) -> Dict[str, bytes]:
+        """Picklable per-attribute snapshot of this operator's incremental state.
+        Graph-config attributes (expressions, callbacks) are excluded by name via
+        ``_NON_STATE_ATTRS`` — they are rebuilt identically from the (sig-checked) graph
+        on restore. A *state* attribute that fails to pickle aborts the checkpoint
+        (``UnpicklableStateError``): silently dropping it would compact away journal
+        history the restore then cannot reconstruct."""
+        import pickle
+
+        out: Dict[str, bytes] = {}
+        for name, value in self.__dict__.items():
+            if name in self._NON_STATE_ATTRS:
+                continue
+            try:
+                out[name] = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+            except Exception as exc:
+                raise UnpicklableStateError(
+                    f"{type(self).__name__}.{name} is not picklable ({exc}); "
+                    "operator checkpointing is unavailable for this pipeline"
+                ) from exc
+        return out
+
+    def load_state_dict(self, state: Dict[str, bytes]) -> None:
+        import pickle
+
+        for name, blob in state.items():
+            self.__dict__[name] = pickle.loads(blob)
 
     # -- helpers ------------------------------------------------------------
 
@@ -145,6 +181,10 @@ class ConcatEvaluator(Evaluator):
 
 class GroupbyEvaluator(Evaluator):
     """Incremental groupby-reduce (reference ``reduce.rs`` + DD reduce)."""
+
+    # reducer_leaves is graph config: checkpoints must not replace it — identity (id())
+    # keys the leaf-value mapping
+    _NON_STATE_ATTRS = Evaluator._NON_STATE_ATTRS + ("reducer_leaves",)
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
@@ -664,6 +704,8 @@ class RestrictEvaluator(_KeyPresenceMixin):
 
 class HavingEvaluator(Evaluator):
     """Keep base rows whose key appears among the indexer pointer column's values."""
+
+    _NON_STATE_ATTRS = Evaluator._NON_STATE_ATTRS + ("indexers",)
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)

@@ -32,7 +32,10 @@ class GraphRunner:
         self._persistence: Any = None
         self._inject: Optional[Dict[int, Delta]] = None  # journal replay injection
         self._input_deltas: Dict[int, Delta] = {}
-        self._dumped_markers: Dict[int, int] = {}
+        self._graph_sig = ""
+        self._snapshot_interval_s = 0.0
+        self._last_checkpoint = time_mod.monotonic()
+        self._warned_unpicklable = False
         self.replay_outputs = True
 
     def state_of(self, node: pg.Node) -> StateTable:
@@ -65,10 +68,39 @@ class GraphRunner:
             # rows on resume (in-process subscribers then rebuild state themselves)
             self.replay_outputs = persistence_config.persistence_mode != "silent_replay"
             sig = self.graph.sig()
+            self._graph_sig = sig
+            self._snapshot_interval_s = (
+                getattr(persistence_config, "snapshot_interval_ms", 0) or 0
+            ) / 1000.0
+            checkpoint = self._persistence.load_checkpoint(sig)
             replay_frames = self._persistence.load_journal(sig)
             self._persistence.open_for_append(sig)
-            if replay_frames:
-                self._restore_sources(replay_frames[-1][2])
+            restore_frames = list(replay_frames)
+            if checkpoint is not None:
+                base_commit, blob = checkpoint
+                self._load_checkpoint_state(blob)
+                self._commit = base_commit + 1
+                # frames ≤ the checkpointed commit are subsumed by it (compaction may
+                # have crashed before truncating the journal)
+                replay_frames = [f for f in replay_frames if f[0] > base_commit]
+                synthetic = (
+                    base_commit,
+                    {},
+                    {
+                        nid: {
+                            **blob["source_offsets"].get(nid, {}),
+                            **(
+                                {"state_deltas": blob["source_deltas"][nid]}
+                                if blob["source_deltas"].get(nid)
+                                else {}
+                            ),
+                        }
+                        for nid in set(blob["source_offsets"]) | set(blob["source_deltas"])
+                    },
+                )
+                restore_frames = [synthetic, *replay_frames]
+            if restore_frames:
+                self._restore_sources(restore_frames)
         for node, evaluator in self._sources:
             node.config["source"].on_start()
         self._monitor = _make_monitor(monitoring_level, self._nodes)
@@ -79,20 +111,119 @@ class GraphRunner:
             self._inject = input_deltas
             self.step()
         self._inject = None
+        if replay_frames:
+            # future frame ids must exceed every journaled id (checkpoint subsumption
+            # filters by id)
+            self._commit = max(self._commit, replay_frames[-1][0] + 1)
 
-    def _restore_sources(self, last_offsets: Dict[int, dict]) -> None:
-        blob = self._persistence.load_sources()
-        states: Dict[int, Any] = {}
-        dump_offsets: Dict[int, dict] = {}
-        if blob is not None:
-            states, dump_offsets = blob
+    def _load_checkpoint_state(self, blob: dict) -> None:
+        """Restore operator + state-table snapshots (reference operator persistence,
+        ``dataflow/persist.rs``); live sinks then receive the restored state as one
+        snapshot delivery (they cannot re-hear the compacted history)."""
+        from pathway_tpu.engine.evaluators import OutputEvaluator
+
+        for nid, sblob in blob["states"].items():
+            if nid in self.states:
+                self.states[nid].load_state_blob(sblob)
+        for nid, estate in blob["evaluators"].items():
+            evaluator = self.evaluators.get(nid)
+            if evaluator is not None:
+                evaluator.load_state_dict(estate)
+        if self.replay_outputs:
+            for node in self._nodes:
+                evaluator = self.evaluators[node.id]
+                if isinstance(evaluator, OutputEvaluator):
+                    snapshot = self.states[node.inputs[0]._node.id].snapshot()
+                    if len(snapshot):
+                        evaluator.process([snapshot])
+
+    def _take_checkpoint(self) -> bool:
+        """Snapshot every operator's state + source positions, then compact the journal.
+        Deferred while any source is mid-segment: a segment's pre-checkpoint events
+        would be baked into state while its tail stays in the journal, making a
+        changed-segment undo impossible."""
+        from pathway_tpu.engine.evaluators import InputEvaluator, OutputEvaluator
+
+        offsets = {
+            # per-frame marker payloads don't belong in the checkpoint snapshot
+            n.id: {k: v for k, v in n.config["source"].offset_state().items() if k != "state_deltas"}
+            for n, _ in self._sources
+        }
+        if any(o.get("in_progress") for o in offsets.values()):
+            return False
+        deltas = {
+            n.id: n.config["source"].checkpoint_state_deltas() for n, _ in self._sources
+        }
+        from pathway_tpu.engine.evaluators import UnpicklableStateError
+
+        try:
+            blob = {
+                "states": {nid: st.state_blob() for nid, st in self.states.items()},
+                "evaluators": {
+                    nid: ev.state_dict()
+                    for nid, ev in self.evaluators.items()
+                    if not isinstance(ev, (InputEvaluator, OutputEvaluator))
+                },
+                "source_offsets": offsets,
+                "source_deltas": deltas,
+            }
+        except UnpicklableStateError as exc:
+            if not self._warned_unpicklable:
+                self._warned_unpicklable = True
+                import logging
+
+                logging.getLogger("pathway_tpu").warning(
+                    "operator checkpointing disabled: %s — falling back to full "
+                    "journal replay on resume",
+                    exc,
+                )
+            self._snapshot_interval_s = 0.0  # stop retrying every commit
+            return False
+        self._persistence.dump_checkpoint(self._graph_sig, self._commit, blob)
+        return True
+
+    def _restore_sources(self, frames: List[tuple]) -> None:
+        """Fold journaled segment-state deltas and the unmarked tail back into each
+        source (reference ``Connector::read_snapshot`` + ``OffsetValue`` seek)."""
+        from pathway_tpu.internals.keys import keys_to_pointers
+
+        last_offsets = frames[-1][2]
         for node, _ in self._sources:
-            source = node.config["source"]
-            source.restore(
-                last_offsets.get(node.id, {}),
-                states.get(node.id),
-                dump_offsets.get(node.id, {}).get("consumed", 0),
-            )
+            nid = node.id
+            offsets = last_offsets.get(nid, {})
+            state_deltas: List[Any] = []
+            last_marker_idx = -1
+            for idx, (_cid, _deltas, offs) in enumerate(frames):
+                deltas = offs.get(nid, {}).get("state_deltas")
+                if deltas:
+                    state_deltas.extend(deltas)
+                    last_marker_idx = idx
+            tail: Optional[dict] = None
+            if offsets.get("consumed", 0) > 0 or offsets.get("done"):
+                tail_rows: List[tuple] = []
+                for _cid, input_deltas, _offs in frames[last_marker_idx + 1 :]:
+                    delta = input_deltas.get(nid)
+                    if delta is None or len(delta) == 0:
+                        continue
+                    pointers = keys_to_pointers(delta.keys)
+                    for i in range(len(delta)):
+                        values = {n: c[i] for n, c in delta.columns.items()}
+                        tail_rows.append((pointers[i], values, int(delta.diffs[i])))
+                in_progress = offsets.get("in_progress") or {}
+                covered = 0
+                if last_marker_idx >= 0:
+                    covered = frames[last_marker_idx][2].get(nid, {}).get("consumed", 0)
+                tail = {
+                    "token": in_progress.get("token"),
+                    "fp": in_progress.get("fp"),
+                    "count": in_progress.get("emitted", 0),
+                    "rows": tail_rows,
+                    # events up to `covered` are accounted for by segment markers; only
+                    # a marker-less subject re-pushes its whole history
+                    "covered": covered,
+                    "has_markers": last_marker_idx >= 0,
+                }
+            node.config["source"].restore(offsets, state_deltas, tail)
 
     def step(self) -> bool:
         """Run one commit; returns True if any node produced output.
@@ -113,26 +244,21 @@ class GraphRunner:
         ):
             self.current_time = self._commit * 2 + 1
             any_output = self._substep(neu=True) or any_output
-        if (
-            self._persistence is not None
-            and self._inject is None
-            and any(len(d) for d in self._input_deltas.values())
-        ):
+        if self._persistence is not None and self._inject is None:
             offsets = {n.id: n.config["source"].offset_state() for n, _ in self._sources}
-            self._persistence.record_commit(self._commit, self._input_deltas, offsets)
-            # markers are O(1) handles to in-band subject checkpoints; dump only
-            # when one actually advanced
-            markers = {
-                n.id: m
-                for n, _ in self._sources
-                if (m := n.config["source"].subject_state()) is not None
-            }
-            if markers and {k: id(v) for k, v in markers.items()} != self._dumped_markers:
-                self._persistence.maybe_dump_sources(
-                    {nid: m[0] for nid, m in markers.items()},
-                    {nid: {"consumed": m[1]} for nid, m in markers.items()},
-                )
-                self._dumped_markers = {k: id(v) for k, v in markers.items()}
+            # a frame is needed for data AND for data-less segment markers (a marker can
+            # close a segment whose rows all rode earlier frames)
+            if any(len(d) for d in self._input_deltas.values()) or any(
+                o.get("state_deltas") for o in offsets.values()
+            ):
+                self._persistence.record_commit(self._commit, self._input_deltas, offsets)
+                if (
+                    self._snapshot_interval_s > 0
+                    and time_mod.monotonic() - self._last_checkpoint
+                    >= self._snapshot_interval_s
+                ):
+                    if self._take_checkpoint():
+                        self._last_checkpoint = time_mod.monotonic()
         if self._monitor is not None:
             self._monitor.update(self._commit, self._step_counts, self.states)
         self._commit += 1

@@ -144,6 +144,11 @@ class IterateEvaluator:
         self.pending_outputs: Dict[str, Delta] = {}
         self.output_columns = node.output.column_names() if node.output else []
 
+    # operator-snapshot protocol (same contract as engine.evaluators.Evaluator)
+    _NON_STATE_ATTRS = ("node", "runner", "output_columns")
+    state_dict = None  # assigned below to share the engine implementation
+    load_state_dict = None
+
     def process(self, input_deltas: List[Delta]) -> Delta:
         from pathway_tpu.engine.runner import GraphRunner
 
@@ -256,6 +261,10 @@ def _rows_equal(a: dict, b: dict) -> bool:
 
 
 class IterateResultEvaluator:
+    _NON_STATE_ATTRS = ("node", "runner")
+    state_dict = None  # assigned below
+    load_state_dict = None
+
     def __init__(self, node: pg.Node, runner: Any):
         self.node = node
         self.runner = runner
@@ -264,3 +273,14 @@ class IterateResultEvaluator:
         parent = self.node.config["parent"]
         parent_eval = self.runner.evaluators[parent.id]
         return parent_eval.take_output(self.node.config["result_name"])
+
+
+def _wire_snapshot_protocol() -> None:
+    from pathway_tpu.engine.evaluators import Evaluator
+
+    for cls in (IterateEvaluator, IterateResultEvaluator):
+        cls.state_dict = Evaluator.state_dict
+        cls.load_state_dict = Evaluator.load_state_dict
+
+
+_wire_snapshot_protocol()

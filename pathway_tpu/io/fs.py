@@ -146,38 +146,79 @@ class _FsSubject:
 
     # -- persistence: the scanner's seen/emitted maps are the analogue of the
     # reference's cached_object_storage (replay without re-reading unchanged files).
-    # State is checkpointed *in-band* (push_state after each file's events), so each
-    # marker is ordered after exactly the events it accounts for — no snapshot races.
+    # Each file's completion is checkpointed *in-band* as a per-file state DELTA
+    # (push_state after that file's events), bracketed by push_begin markers carrying a
+    # (mtime, size) fingerprint — so the engine can dedup a crash-straddled file's
+    # re-push when the file is unchanged, and retract its journaled partial rows when
+    # it changed or vanished while the pipeline was down.
 
-    def _state_snapshot(self) -> dict:
-        return {
-            "seen": dict(self.seen),
-            "emitted": {k: list(v) for k, v in self.emitted.items()},
-        }
+    @staticmethod
+    def fold_state_deltas(state_deltas: list) -> list:
+        """Collapse a marker-delta history to one delta per live file (bounds the
+        checkpoint payload; called on the engine thread over drained markers only)."""
+        seen: Dict[str, dict] = {}
+        for delta in state_deltas:
+            if delta.get("deleted"):
+                seen.pop(delta["file"], None)
+            else:
+                seen[delta["file"]] = delta
+        return [seen[f] for f in sorted(seen)]
 
-    def restore(self, state: dict) -> None:
-        """Called before the scanner thread starts; repositions the scan."""
-        self.seen = dict(state.get("seen", {}))
-        self.emitted = {k: list(v) for k, v in state.get("emitted", {}).items()}
+    def restore(self, state_deltas: list) -> None:
+        """Fold journaled per-file deltas back into the scan state (called before the
+        scanner thread starts)."""
+        for delta in state_deltas:
+            filepath = delta["file"]
+            if delta.get("deleted"):
+                self.seen.pop(filepath, None)
+                self.emitted.pop(filepath, None)
+            else:
+                self.seen[filepath] = delta["mtime"]
+                self.emitted[filepath] = list(delta["rows"])
+
+    def _process_file(self, source: StreamingDataSource, filepath: str) -> None:
+        st = os.stat(filepath)
+        # read before pushing anything: a concurrent deletion then raises while the
+        # event stream is still untouched (no dangling begin/retractions)
+        rows = _parse_file(
+            filepath, self.format, self.schema, self.with_metadata, self.csv_settings
+        )
+        source.push_begin(filepath, (st.st_mtime, st.st_size))
+        # row keys are content-addressed (file, row-index) so a later retraction of
+        # this file's rows re-derives the exact same keys
+        if filepath in self.emitted:
+            for i, row in enumerate(self.emitted[filepath]):
+                source.push(row, key=pointer_from(filepath, i, "fs"), diff=-1)
+        for i, row in enumerate(rows):
+            source.push(row, key=pointer_from(filepath, i, "fs"), diff=1)
+        self.seen[filepath] = st.st_mtime
+        self.emitted[filepath] = rows
+        source.push_state({"file": filepath, "mtime": st.st_mtime, "rows": rows})
+
+    def _process_deletion(self, source: StreamingDataSource, filepath: str) -> None:
+        source.push_begin(filepath, ("deleted",))
+        for i, row in enumerate(self.emitted.get(filepath, [])):
+            source.push(row, key=pointer_from(filepath, i, "fs"), diff=-1)
+        self.seen.pop(filepath, None)
+        self.emitted.pop(filepath, None)
+        source.push_state({"file": filepath, "deleted": True})
 
     def run(self, source: StreamingDataSource) -> None:
         stop = False
         while not stop:
-            for filepath in _iter_files(self.path, self.object_pattern):
-                mtime = os.stat(filepath).st_mtime
-                if self.seen.get(filepath) == mtime:
+            present = _iter_files(self.path, self.object_pattern)
+            for filepath in present:
+                try:
+                    if self.seen.get(filepath) == os.stat(filepath).st_mtime:
+                        continue
+                    self._process_file(source, filepath)
+                except FileNotFoundError:
+                    # deleted between listing and read; the next pass retracts it
                     continue
-                if filepath in self.emitted:
-                    for row in self.emitted[filepath]:
-                        source.push(row, diff=-1)
-                rows = _parse_file(
-                    filepath, self.format, self.schema, self.with_metadata, self.csv_settings
-                )
-                for row in rows:
-                    source.push(row, diff=1)
-                self.seen[filepath] = mtime
-                self.emitted[filepath] = rows
-                source.push_state(self._state_snapshot())
+            for gone in sorted(set(self.seen) - set(present)):
+                self._process_deletion(source, gone)
+            # one full pass done: a crash-straddled file absent from this pass is gone
+            source.push_barrier()
             if self.mode in ("static", "batch"):
                 stop = True
             else:
@@ -216,11 +257,7 @@ def read(
         path, format, schema, mode, with_metadata, object_pattern, csv_settings=csv_settings
     )
 
-    class _Runner:
-        def run(self, source: StreamingDataSource) -> None:
-            subject.run(source)
-
-    source = StreamingDataSource(subject=_Runner(), autocommit_ms=autocommit_duration_ms)
+    source = StreamingDataSource(subject=subject, autocommit_ms=autocommit_duration_ms)
     node = G.add_node(pg.InputNode(source=source, streaming=mode == "streaming", name=name or "fs"))
     return Table(node, out_schema, name=name or "fs")
 

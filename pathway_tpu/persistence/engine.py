@@ -1,4 +1,4 @@
-"""Persistence engine: input journal + source-offset snapshots + replay resume.
+"""Persistence engine: input journal + segment-state deltas + replay resume.
 
 Parity: reference ``src/persistence/`` — input snapshots journal every connector's parsed
 events per worker (``input_snapshot.rs``), offsets let readers seek past replayed data
@@ -8,12 +8,12 @@ realtime reads resume.
 
 Design here (batch-incremental engine): every commit's *input* deltas are appended to a
 single journal file as length-prefixed pickle frames — everything downstream is
-deterministic, so replaying the journal reconstructs all operator state exactly. A crash
-mid-write leaves a truncated final frame, which the loader discards (the reference gets the
-same guarantee from chunked binary logs). Source offsets (event counts + optional
-subject state) ride in each frame; heavyweight subject state (e.g. the fs scanner's
-seen-files map — the analogue of ``cached_object_storage.rs``) is dumped separately at
-``snapshot_interval`` and paired with skip-counts on resume.
+deterministic, so replaying the journal reconstructs all operator state exactly. Frames are
+fsynced, so a crash can only lose the in-flight frame; its torn bytes are detected on load
+and truncated away before new appends (the reference gets the same guarantee from chunked
+binary logs). Each frame also carries light per-source offsets: consumed counts, sequence
+cursors, and the segment-state deltas sources pushed that commit (the analogue of
+``cached_object_storage.rs`` — replay repositions scanners without re-reading data).
 """
 
 from __future__ import annotations
@@ -22,8 +22,7 @@ import io
 import os
 import pickle
 import struct
-import time
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -31,7 +30,7 @@ from pathway_tpu.engine.columnar import Delta
 
 _FRAME_HEADER = struct.Struct(">Q")
 _JOURNAL = "journal.bin"
-_SOURCES = "sources.pkl"
+_CHECKPOINT = "checkpoint.pkl"
 _HEADER_MAGIC = b"PWTPUJ1\n"
 
 
@@ -53,7 +52,7 @@ def _payload_to_delta(payload: tuple) -> Delta:
 
 
 class PersistenceManager:
-    """Owns the journal + source-state files for one pipeline under one backend root."""
+    """Owns the journal file for one pipeline under one backend root."""
 
     def __init__(self, config: Any):
         backend = config.backend
@@ -66,32 +65,41 @@ class PersistenceManager:
         self.root = backend.root
         self._memory = backend.kind in ("memory", "mock") or self.root is None
         self._mem_journal: io.BytesIO = io.BytesIO()
-        self._mem_sources: bytes | None = None
         self._journal_file: Any = None
-        self._last_sources_dump = 0.0
-        self.snapshot_interval_s = (config.snapshot_interval_ms or 0) / 1000.0
+        # byte offset of the last complete frame, set by load_journal; open_for_append
+        # truncates torn tail bytes past it so new frames never land after garbage
+        self._valid_end: Optional[int] = None
         if not self._memory:
             os.makedirs(self.root, exist_ok=True)
-
-    # -- paths ---------------------------------------------------------------
 
     def _journal_path(self) -> str:
         return os.path.join(self.root, _JOURNAL)
 
-    def _sources_path(self) -> str:
-        return os.path.join(self.root, _SOURCES)
-
     # -- journal write path --------------------------------------------------
 
     def open_for_append(self, graph_sig: str) -> None:
+        header = _HEADER_MAGIC + graph_sig.encode() + b"\n"
         if self._memory:
+            if self._valid_end is not None:
+                self._mem_journal.truncate(self._valid_end)
+                self._mem_journal.seek(self._valid_end)
             if self._mem_journal.getbuffer().nbytes == 0:
-                self._mem_journal.write(_HEADER_MAGIC + graph_sig.encode() + b"\n")
+                self._mem_journal.write(header)
             return
-        fresh = not os.path.exists(self._journal_path())
-        self._journal_file = open(self._journal_path(), "ab")
-        if fresh:
-            self._journal_file.write(_HEADER_MAGIC + graph_sig.encode() + b"\n")
+        path = self._journal_path()
+        if not os.path.exists(path):
+            self._journal_file = open(path, "ab")
+            self._journal_file.write(header)
+            self._journal_file.flush()
+            os.fsync(self._journal_file.fileno())
+            return
+        self._journal_file = open(path, "r+b")
+        if self._valid_end is not None:
+            self._journal_file.truncate(self._valid_end)
+        self._journal_file.seek(0, os.SEEK_END)
+        if self._journal_file.tell() == 0:
+            # corrupt header was discarded: start a fresh journal
+            self._journal_file.write(header)
             self._journal_file.flush()
             os.fsync(self._journal_file.fileno())
 
@@ -101,7 +109,9 @@ class PersistenceManager:
         input_deltas: Dict[int, Delta],
         offsets: Dict[int, dict],
     ) -> None:
-        """Append one frame: the commit's input deltas + light per-source offsets."""
+        """Append one frame: the commit's input deltas + light per-source offsets
+        (consumed counts, sequence cursors, segment-state deltas). fsynced — the
+        crash-consistency story depends on frames surviving power loss."""
         frame = pickle.dumps(
             (
                 commit_id,
@@ -116,44 +126,93 @@ class PersistenceManager:
         else:
             self._journal_file.write(buf)
             self._journal_file.flush()
-
-    def maybe_dump_sources(self, states: Dict[int, Any], offsets: Dict[int, dict]) -> None:
-        """Periodically persist heavyweight subject state (atomic rename for crash
-        consistency), tagged with the offsets it corresponds to."""
-        now = time.monotonic()
-        if now - self._last_sources_dump < max(self.snapshot_interval_s, 1e-9):
-            return
-        self._last_sources_dump = now
-        blob = pickle.dumps((states, offsets), protocol=pickle.HIGHEST_PROTOCOL)
-        if self._memory:
-            self._mem_sources = blob
-            return
-        tmp = self._sources_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._sources_path())
+            os.fsync(self._journal_file.fileno())
 
     def close(self) -> None:
         if self._journal_file is not None:
             self._journal_file.close()
             self._journal_file = None
 
+    # -- operator snapshots (reference ``operator_snapshot.rs`` + compaction) --
+
+    def dump_checkpoint(self, graph_sig: str, commit_id: int, blob: dict) -> None:
+        """Atomically persist a full engine checkpoint (operator + source state), then
+        compact the journal: frames ≤ ``commit_id`` are subsumed by the checkpoint.
+        Crash between the two steps is safe — load skips subsumed frames by id."""
+        payload = pickle.dumps(
+            {"sig": graph_sig, "commit_id": commit_id, "state": blob},
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        if self._memory:
+            self._mem_checkpoint = payload
+            self._mem_journal = io.BytesIO()
+            self._mem_journal.write(_HEADER_MAGIC + graph_sig.encode() + b"\n")
+            return
+        tmp = os.path.join(self.root, _CHECKPOINT + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, _CHECKPOINT))
+        # compact: restart the journal after the checkpointed commit
+        header = _HEADER_MAGIC + graph_sig.encode() + b"\n"
+        self._journal_file.truncate(len(header))
+        self._journal_file.seek(0, os.SEEK_END)
+        self._journal_file.flush()
+        os.fsync(self._journal_file.fileno())
+
+    def load_checkpoint(self, graph_sig: str) -> Optional[Tuple[int, dict]]:
+        if self._memory:
+            payload = getattr(self, "_mem_checkpoint", None)
+            if payload is None:
+                return None
+        else:
+            path = os.path.join(self.root, _CHECKPOINT)
+            if not os.path.exists(path):
+                return None
+            try:
+                with open(path, "rb") as f:
+                    payload = f.read()
+            except OSError:
+                return None
+        try:
+            data = pickle.loads(payload)
+        except Exception as exc:
+            # the journal was compacted when this checkpoint was written — treating a
+            # corrupt checkpoint as absent would silently lose all compacted history
+            raise ValueError(
+                "persisted checkpoint is unreadable; the journal alone cannot restore "
+                "state (it was compacted) — restore checkpoint.pkl from a copy or clear "
+                "the persistence directory to start fresh"
+            ) from exc
+        if data.get("sig") != graph_sig:
+            raise ValueError(
+                "persisted checkpoint was written by a different dataflow graph; "
+                "clear the persistence directory or keep the program unchanged"
+            )
+        return data["commit_id"], data["state"]
+
     # -- journal read path ---------------------------------------------------
 
     def load_journal(self, graph_sig: str) -> List[Tuple[int, Dict[int, Delta], Dict[int, dict]]]:
-        """All complete frames; a truncated tail frame (crash mid-write) is dropped."""
+        """All complete frames; a truncated tail frame (crash mid-write) is dropped and
+        marked for truncation by ``open_for_append``."""
         if self._memory:
             data = self._mem_journal.getvalue()
         else:
             if not os.path.exists(self._journal_path()):
+                self._valid_end = None
                 return []
             with open(self._journal_path(), "rb") as f:
                 data = f.read()
         if not data.startswith(_HEADER_MAGIC):
+            self._valid_end = 0  # corrupt/foreign header: truncate and start fresh
             return []
-        nl = data.index(b"\n", len(_HEADER_MAGIC))
+        try:
+            nl = data.index(b"\n", len(_HEADER_MAGIC))
+        except ValueError:
+            self._valid_end = 0
+            return []
         stored_sig = data[len(_HEADER_MAGIC) : nl].decode()
         if stored_sig != graph_sig:
             raise ValueError(
@@ -167,20 +226,13 @@ class PersistenceManager:
             start = pos + _FRAME_HEADER.size
             if start + length > len(data):
                 break  # truncated tail frame — crash during write; discard
-            commit_id, payloads, offsets = pickle.loads(data[start : start + length])
+            try:
+                commit_id, payloads, offsets = pickle.loads(data[start : start + length])
+            except Exception:
+                break  # torn frame body despite intact length prefix
             frames.append(
                 (commit_id, {nid: _payload_to_delta(p) for nid, p in payloads.items()}, offsets)
             )
             pos = start + length
+        self._valid_end = pos
         return frames
-
-    def load_sources(self) -> Optional[Tuple[Dict[int, Any], Dict[int, dict]]]:
-        if self._memory:
-            return pickle.loads(self._mem_sources) if self._mem_sources else None
-        if not os.path.exists(self._sources_path()):
-            return None
-        try:
-            with open(self._sources_path(), "rb") as f:
-                return pickle.loads(f.read())
-        except Exception:
-            return None  # torn write of the tmp file never renamed; ignore

@@ -43,7 +43,10 @@ def _propose_clusters(edges: Table, clustering: Table, total: Table) -> Table:
     )
     cluster_penalties = placeholder_penalties.update_rows(real_penalties)
 
-    vertex_degrees = edges.groupby(id=edges.v).reduce(degree=reducers.sum(edges.weight))
+    # placeholder 0-degree rows keep isolated vertices representable (they still get
+    # proposal rows via the placeholder vertex→own-cluster edges below)
+    real_degrees = edges.groupby(id=edges.v).reduce(degree=reducers.sum(edges.weight))
+    vertex_degrees = clustering.select(degree=0.0).update_rows(real_degrees)
 
     # self loops contribute to every candidate cluster equally; handled separately
     self_loops = edges.filter(edges.u == edges.v)

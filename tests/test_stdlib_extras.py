@@ -235,3 +235,22 @@ def test_timed_sources_share_global_clock():
     joined = t2.asof_now_join(latest).select(b=t2.b, m=latest.m)
     rows = dbg.table_to_pandas(joined).to_dict("records")
     assert rows == [{"b": 10, "m": 1}]
+
+
+def test_louvain_isolated_vertex():
+    vs = pw.schema_from_types(v=int)
+    es = pw.schema_from_types(u_raw=int, v_raw=int, weight=float)
+    vraw = dbg.table_from_rows(vs, [(i,) for i in range(3)])
+    eraw = dbg.table_from_rows(es, [(0, 1, 5.0), (1, 0, 5.0)])
+    keyed = vraw.with_id_from(vraw.v)
+    V = keyed.select(v=keyed.v)
+    E = eraw.select(
+        u=V.pointer_from(eraw.u_raw), v=V.pointer_from(eraw.v_raw), weight=eraw.weight
+    )
+    flat = louvain_communities(
+        WeightedGraph.from_vertices_and_weighted_edges(V, E), levels=1, iterations_per_level=4
+    )
+    res = flat.select(v=V.v, c=flat.c)
+    df = dbg.table_to_pandas(res, include_id=False)
+    groups = sorted(df.groupby("c")["v"].apply(lambda s: tuple(sorted(s))).tolist())
+    assert groups == [(0, 1), (2,)]
