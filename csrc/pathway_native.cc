@@ -1,0 +1,485 @@
+// Native runtime kernels for pathway_tpu.
+//
+// TPU-native counterpart of the reference engine's Rust host-side hot paths:
+//   - 128-bit row-key fingerprinting (reference src/engine/value.rs:41 `Key`,
+//     xxh3-based) over typed column batches,
+//   - DSV field splitting + typed coercion (reference src/connectors/data_format.rs
+//     Dsv parser).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image). The
+// serialization format byte-matches pathway_tpu/internals/keys.py::_serialize_value so
+// native and Python key derivation are interchangeable.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define XXH_INLINE_ALL
+#include "xxhash.h"
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// Mirror of keys.py serialization tags.
+constexpr uint8_t TAG_NONE = 0x00;
+constexpr uint8_t TAG_BOOL = 0x02;
+constexpr uint8_t TAG_INT = 0x03;
+constexpr uint8_t TAG_FLOAT = 0x04;
+constexpr uint8_t TAG_STR = 0x05;
+
+inline void put_u64_le(std::string& buf, uint64_t v) {
+  for (int i = 0; i < 8; ++i) buf.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+
+// 16-byte little-endian signed integer (Python int.to_bytes(16, "little", signed=True))
+inline void put_i128_le(std::string& buf, int64_t v) {
+  uint64_t lo = static_cast<uint64_t>(v);
+  uint64_t hi = v < 0 ? ~0ULL : 0ULL;
+  put_u64_le(buf, lo);
+  put_u64_le(buf, hi);
+}
+
+inline uint64_t bswap64(uint64_t v) { return __builtin_bswap64(v); }
+
+// Python reads the canonical digest little-endian: digest[:8] is the big-endian
+// encoding of XXH3's high64, so hi = bswap(high64); likewise lo = bswap(low64).
+inline void write_hash(const std::string& buf, uint64_t* hi, uint64_t* lo) {
+  XXH128_hash_t h = XXH3_128bits(buf.data(), buf.size());
+  *hi = bswap64(h.high64);
+  *lo = bswap64(h.low64);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Column value kinds for pwtpu_hash_typed.
+//   1 = int64    (data: int64_t*)
+//   2 = float64  (data: double*)
+//   3 = bool     (data: uint8_t*)
+//   4 = utf8     (data: char buffer, offsets: uint64_t[n+1])
+//   5 = pyobject (data: PyObject** — a numpy object column's backing array;
+//                 caller must hold the GIL, i.e. load via ctypes.PyDLL)
+// A column's mask (optional, uint8_t*) marks rows as present (1) or None (0).
+struct PwCol {
+  int32_t kind;
+  const void* data;
+  const uint64_t* offsets;
+  const uint8_t* mask;
+};
+
+namespace {
+
+// Serialize one Python value exactly like keys.py::_serialize_value for the scalar
+// types the engine's hot columns carry. np_bool / np_integer are numpy's np.bool_ and
+// np.integer for scalar detection. Returns false for unsupported values (tuples,
+// ndarrays, Json, huge ints …) — caller falls back to the Python serializer.
+bool serialize_pyvalue(PyObject* v, PyObject* np_bool, PyObject* np_integer,
+                       std::string& buf) {
+  if (v == Py_None) {
+    buf.push_back(static_cast<char>(TAG_NONE));
+    return true;
+  }
+  if (PyBool_Check(v) || PyObject_TypeCheck(v, reinterpret_cast<PyTypeObject*>(np_bool))) {
+    buf.push_back(static_cast<char>(TAG_BOOL));
+    buf.push_back(PyObject_IsTrue(v) ? '\x01' : '\x00');
+    return true;
+  }
+  if (PyFloat_Check(v)) {  // also covers np.float64 (a float subclass)
+    buf.push_back(static_cast<char>(TAG_FLOAT));
+    double d = PyFloat_AS_DOUBLE(v);
+    char raw[8];
+    std::memcpy(raw, &d, 8);
+    buf.append(raw, 8);
+    return true;
+  }
+  if (PyLong_Check(v) ||
+      PyObject_TypeCheck(v, reinterpret_cast<PyTypeObject*>(np_integer))) {
+    int overflow = 0;
+    long long val = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (overflow != 0) return false;  // >64-bit int: python path handles 128-bit
+    if (val == -1 && PyErr_Occurred()) {
+      // np.integer scalars are not PyLong; go through __index__
+      PyErr_Clear();
+      PyObject* as_int = PyNumber_Index(v);
+      if (as_int == nullptr) {
+        PyErr_Clear();
+        return false;
+      }
+      val = PyLong_AsLongLongAndOverflow(as_int, &overflow);
+      Py_DECREF(as_int);
+      if (overflow != 0 || (val == -1 && PyErr_Occurred())) {
+        PyErr_Clear();
+        return false;
+      }
+    }
+    buf.push_back(static_cast<char>(TAG_INT));
+    put_i128_le(buf, static_cast<int64_t>(val));
+    return true;
+  }
+  if (PyUnicode_Check(v)) {
+    Py_ssize_t size = 0;
+    const char* utf8 = PyUnicode_AsUTF8AndSize(v, &size);
+    if (utf8 == nullptr) {
+      PyErr_Clear();
+      return false;
+    }
+    buf.push_back(static_cast<char>(TAG_STR));
+    put_u64_le(buf, static_cast<uint64_t>(size));
+    buf.append(utf8, static_cast<size_t>(size));
+    return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+// Fingerprint n rows over ncols typed columns. salt is prefixed to every row.
+// Returns -1 on success, else the index of the first row holding a value the native
+// serializer doesn't support (caller falls back to Python for the whole batch).
+int64_t pwtpu_hash_typed(const PwCol* cols, int32_t ncols, uint64_t n,
+                         const uint8_t* salt, uint64_t salt_len, PyObject* np_bool,
+                         PyObject* np_integer, uint64_t* out_hi, uint64_t* out_lo) {
+  std::string buf;
+  for (uint64_t i = 0; i < n; ++i) {
+    buf.assign(reinterpret_cast<const char*>(salt), salt_len);
+    for (int32_t c = 0; c < ncols; ++c) {
+      const PwCol& col = cols[c];
+      if (col.mask != nullptr && col.mask[i] == 0) {
+        buf.push_back(static_cast<char>(TAG_NONE));
+        continue;
+      }
+      switch (col.kind) {
+        case 1:
+          buf.push_back(static_cast<char>(TAG_INT));
+          put_i128_le(buf, static_cast<const int64_t*>(col.data)[i]);
+          break;
+        case 2: {
+          buf.push_back(static_cast<char>(TAG_FLOAT));
+          double v = static_cast<const double*>(col.data)[i];
+          char raw[8];
+          std::memcpy(raw, &v, 8);
+          buf.append(raw, 8);
+          break;
+        }
+        case 3:
+          buf.push_back(static_cast<char>(TAG_BOOL));
+          buf.push_back(static_cast<const uint8_t*>(col.data)[i] ? '\x01' : '\x00');
+          break;
+        case 4: {
+          buf.push_back(static_cast<char>(TAG_STR));
+          uint64_t start = col.offsets[i];
+          uint64_t end = col.offsets[i + 1];
+          put_u64_le(buf, end - start);
+          buf.append(static_cast<const char*>(col.data) + start, end - start);
+          break;
+        }
+        case 5: {
+          PyObject* v = static_cast<PyObject* const*>(col.data)[i];
+          if (!serialize_pyvalue(v, np_bool, np_integer, buf)) {
+            return static_cast<int64_t>(i);
+          }
+          break;
+        }
+        default:
+          return static_cast<int64_t>(i);
+      }
+    }
+    write_hash(buf, &out_hi[i], &out_lo[i]);
+  }
+  return -1;
+}
+
+// Fingerprint pre-serialized rows (payloads concatenated in buf, offsets[n+1]).
+void pwtpu_hash_serialized(const uint8_t* buf, const uint64_t* offsets, uint64_t n,
+                           uint64_t* out_hi, uint64_t* out_lo) {
+  for (uint64_t i = 0; i < n; ++i) {
+    XXH128_hash_t h =
+        XXH3_128bits(buf + offsets[i], offsets[i + 1] - offsets[i]);
+    out_hi[i] = bswap64(h.high64);
+    out_lo[i] = bswap64(h.low64);
+  }
+}
+
+// Autogenerated sequential row ids (reference: dense ints hashed for uniform
+// sharding; mirrors keys.py sequential_keys).
+void pwtpu_sequential_keys(const uint8_t* salt, uint64_t salt_len, int64_t start,
+                           uint64_t count, uint64_t* out_hi, uint64_t* out_lo) {
+  std::string buf;
+  for (uint64_t i = 0; i < count; ++i) {
+    buf.assign(reinterpret_cast<const char*>(salt), salt_len);
+    buf.append("seq", 3);
+    put_i128_le(buf, start + static_cast<int64_t>(i));
+    write_hash(buf, &out_hi[i], &out_lo[i]);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// DSV splitting (reference data_format.rs Dsv parser): split `data` into rows
+// by '\n' and fields by `delimiter`, honoring double-quote quoting with ""
+// escapes — csv-module semantics: a quote is only special at field start;
+// elsewhere it is literal. Emits a flat field buffer + per-field offsets +
+// per-row field counts (+ optional per-row had-quotes flags, to distinguish a
+// quoted empty string from a blank line). Returns the number of rows;
+// *needed_* outputs let the caller size buffers (call once with null outputs
+// to measure, then with buffers).
+uint64_t pwtpu_split_dsv(const char* data, uint64_t len, char delimiter,
+                         char* field_buf, uint64_t* field_offsets,
+                         uint64_t* row_field_counts, uint8_t* row_had_quotes,
+                         uint64_t* needed_bytes, uint64_t* needed_fields) {
+  uint64_t rows = 0, fields = 0, bytes = 0;
+  bool measuring = field_buf == nullptr;
+  uint64_t field_start_bytes = 0;
+  bool in_quotes = false;
+  bool row_open = false;
+  bool field_started = false;
+  bool had_quotes = false;
+  uint64_t row_fields = 0;
+
+  auto end_field = [&]() {
+    if (!measuring) field_offsets[fields] = field_start_bytes;
+    ++fields;
+    ++row_fields;
+    field_start_bytes = bytes;
+    field_started = false;
+  };
+  auto end_row = [&]() {
+    end_field();
+    if (!measuring) {
+      row_field_counts[rows] = row_fields;
+      if (row_had_quotes != nullptr) row_had_quotes[rows] = had_quotes ? 1 : 0;
+    }
+    ++rows;
+    row_fields = 0;
+    row_open = false;
+    had_quotes = false;
+  };
+
+  for (uint64_t i = 0; i < len; ++i) {
+    char ch = data[i];
+    row_open = true;
+    if (in_quotes) {
+      if (ch == '"') {
+        if (i + 1 < len && data[i + 1] == '"') {
+          if (!measuring) field_buf[bytes] = '"';
+          ++bytes;
+          ++i;
+        } else {
+          in_quotes = false;
+        }
+      } else {
+        if (!measuring) field_buf[bytes] = ch;
+        ++bytes;
+      }
+      continue;
+    }
+    if (ch == '"' && !field_started) {
+      // csv-module rule: quoting starts only at the beginning of a field
+      in_quotes = true;
+      field_started = true;
+      had_quotes = true;
+    } else if (ch == delimiter) {
+      end_field();
+    } else if (ch == '\r' && i + 1 < len && data[i + 1] == '\n') {
+      // CRLF line ending: drop the \r, the \n closes the row next iteration
+    } else if (ch == '\n') {
+      end_row();
+    } else {
+      if (!measuring) field_buf[bytes] = ch;
+      ++bytes;
+      field_started = true;
+    }
+  }
+  if (row_open) end_row();
+  if (!measuring && fields > 0) field_offsets[fields] = bytes;
+  if (needed_bytes != nullptr) *needed_bytes = bytes;
+  if (needed_fields != nullptr) *needed_fields = fields;
+  return rows;
+}
+
+namespace {
+
+// Python-int coercion: strtoll fast path, CPython PyLong_FromString fallback so
+// big ints / underscore literals behave exactly like the Python int() in _coerce.
+PyObject* coerce_int(const char* s, size_t slen, PyObject* error_obj,
+                     std::string& scratch) {
+  while (slen > 0 && (s[0] == ' ' || s[0] == '\t')) { ++s; --slen; }
+  while (slen > 0 && (s[slen - 1] == ' ' || s[slen - 1] == '\t')) --slen;
+  scratch.assign(s, slen);
+  char* end = nullptr;
+  errno = 0;
+  long long v = strtoll(scratch.c_str(), &end, 10);
+  if (errno == 0 && slen != 0 && end == scratch.c_str() + slen) {
+    return PyLong_FromLongLong(v);
+  }
+  PyObject* big = PyLong_FromString(scratch.c_str(), nullptr, 10);
+  if (big != nullptr) return big;
+  PyErr_Clear();
+  Py_INCREF(error_obj);
+  return error_obj;
+}
+
+// Python-float coercion: strtod fast path for plain decimal forms, otherwise
+// PyFloat_FromString (handles 1e-320 subnormals, '_' grouping, inf/nan words,
+// and rejects C hex floats — exactly float()'s rules).
+PyObject* coerce_float(const char* s, size_t slen, PyObject* error_obj,
+                       std::string& scratch) {
+  while (slen > 0 && (s[0] == ' ' || s[0] == '\t')) { ++s; --slen; }
+  while (slen > 0 && (s[slen - 1] == ' ' || s[slen - 1] == '\t')) --slen;
+  bool plain = slen > 0;
+  for (size_t i = 0; i < slen; ++i) {
+    char c = s[i];
+    if (!((c >= '0' && c <= '9') || c == '.' || c == '+' || c == '-' || c == 'e' ||
+          c == 'E')) {
+      plain = false;
+      break;
+    }
+  }
+  scratch.assign(s, slen);
+  if (plain) {
+    char* end = nullptr;
+    double v = strtod(scratch.c_str(), &end);  // ERANGE over/underflow matches float()
+    if (end == scratch.c_str() + slen) return PyFloat_FromDouble(v);
+  }
+  PyObject* str = PyUnicode_DecodeUTF8(s, static_cast<Py_ssize_t>(slen), "replace");
+  if (str == nullptr) {
+    PyErr_Clear();
+    Py_INCREF(error_obj);
+    return error_obj;
+  }
+  PyObject* val = PyFloat_FromString(str);
+  Py_DECREF(str);
+  if (val != nullptr) return val;
+  PyErr_Clear();
+  Py_INCREF(error_obj);
+  return error_obj;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Fused DSV parse: split + typed coercion + row-dict construction, entirely
+// native (the counterpart of data_format.rs DsvParser::parse). Called with the
+// GIL held (ctypes.PyDLL).
+//
+//   data/len/delim : raw file bytes (header row included; quoted headers fine —
+//                    name→column resolution happens here, against the split header)
+//   names          : Python tuple of wanted column-name strings
+//   tags           : per wanted column: 0=str 1=int 2=float 3=bool (others: raw str)
+//   ncols          : number of wanted columns
+//   error_obj      : sentinel stored for malformed typed fields (Value::Error)
+//
+// Wanted columns absent from the header are omitted from the row dicts (same as
+// the DictReader fallback). Returns a new reference to a list of per-row dicts,
+// or NULL on internal error.
+PyObject* pwtpu_parse_dsv_rows(const char* data, uint64_t len, char delim,
+                               PyObject* names, const int32_t* tags, int32_t ncols,
+                               PyObject* error_obj) {
+  uint64_t needed_bytes = 0, needed_fields = 0;
+  uint64_t nrows = pwtpu_split_dsv(data, len, delim, nullptr, nullptr, nullptr,
+                                   nullptr, &needed_bytes, &needed_fields);
+  PyObject* out = PyList_New(0);
+  if (out == nullptr) return nullptr;
+  if (nrows == 0) return out;
+  std::vector<char> field_buf(needed_bytes > 0 ? needed_bytes : 1);
+  std::vector<uint64_t> offsets(needed_fields + 1);
+  std::vector<uint64_t> counts(nrows);
+  std::vector<uint8_t> quoted(nrows);
+  pwtpu_split_dsv(data, len, delim, field_buf.data(), offsets.data(),
+                  counts.data(), quoted.data(), nullptr, nullptr);
+
+  // resolve wanted names against the (properly split) header row
+  std::vector<int64_t> src_idx(ncols, -1);
+  uint64_t header_fields = counts[0];
+  for (int32_t c = 0; c < ncols; ++c) {
+    PyObject* name = PyTuple_GET_ITEM(names, c);
+    Py_ssize_t name_len = 0;
+    const char* name_utf8 = PyUnicode_AsUTF8AndSize(name, &name_len);
+    if (name_utf8 == nullptr) {
+      PyErr_Clear();
+      continue;
+    }
+    for (uint64_t j = 0; j < header_fields; ++j) {
+      uint64_t fl = offsets[j + 1] - offsets[j];
+      if (fl == static_cast<uint64_t>(name_len) &&
+          std::memcmp(field_buf.data() + offsets[j], name_utf8, fl) == 0) {
+        src_idx[c] = static_cast<int64_t>(j);
+        break;
+      }
+    }
+  }
+
+  uint64_t f = header_fields;
+  std::string scratch;
+  for (uint64_t r = 1; r < nrows; ++r) {
+    uint64_t k = counts[r];
+    if (k == 1 && offsets[f + 1] == offsets[f] && !quoted[r]) {
+      f += k;
+      continue;  // blank line (a quoted "" row is genuine data)
+    }
+    PyObject* row = PyDict_New();
+    if (row == nullptr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    for (int32_t c = 0; c < ncols; ++c) {
+      int64_t j = src_idx[c];
+      if (j < 0) continue;  // column absent from header: omit, like DictReader
+      PyObject* name = PyTuple_GET_ITEM(names, c);
+      PyObject* value = nullptr;
+      if (static_cast<uint64_t>(j) >= k) {
+        Py_INCREF(Py_None);
+        value = Py_None;
+      } else {
+        const char* s = field_buf.data() + offsets[f + j];
+        size_t slen = offsets[f + j + 1] - offsets[f + j];
+        switch (tags[c]) {
+          case 1:
+            value = coerce_int(s, slen, error_obj, scratch);
+            break;
+          case 2:
+            value = coerce_float(s, slen, error_obj, scratch);
+            break;
+          case 3: {  // bool ("true"/"True"/"1" ... mirrors io/fs.py _coerce)
+            scratch.assign(s, slen);
+            if (scratch == "true" || scratch == "True" || scratch == "1") {
+              Py_INCREF(Py_True);
+              value = Py_True;
+            } else if (scratch == "false" || scratch == "False" || scratch == "0") {
+              Py_INCREF(Py_False);
+              value = Py_False;
+            } else {
+              Py_INCREF(error_obj);
+              value = error_obj;
+            }
+            break;
+          }
+          default:
+            value = PyUnicode_DecodeUTF8(s, static_cast<Py_ssize_t>(slen), "replace");
+        }
+      }
+      if (value == nullptr || PyDict_SetItem(row, name, value) < 0) {
+        Py_XDECREF(value);
+        Py_DECREF(row);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      Py_DECREF(value);
+    }
+    if (PyList_Append(out, row) < 0) {
+      Py_DECREF(row);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(row);
+    f += k;
+  }
+  return out;
+}
+
+}  // extern "C"

@@ -104,9 +104,81 @@ def pointer_from(*parts: Any) -> Pointer:
     return Pointer(hi, lo)
 
 
+def _classify_column(col: np.ndarray):
+    """Describe a column for the native hasher; None for unsupported array dtypes.
+
+    Returns (kind, data_array) with the array kept alive by the caller. Kinds mirror
+    ``csrc/pathway_native.cc``: 1=int64 2=float64 3=bool 5=pyobject. Object columns go
+    straight to the pyobject kind — type dispatch happens natively per value.
+    """
+    if col.dtype == object:
+        return (5, np.ascontiguousarray(col))
+    if col.dtype == np.bool_:
+        return (3, np.ascontiguousarray(col, dtype=np.uint8))
+    if np.issubdtype(col.dtype, np.integer):
+        if col.dtype == np.uint64 and len(col) and col.max() > np.uint64(2**63 - 1):
+            # int64 cast would wrap; the Python serializer encodes the true value
+            return None
+        return (1, np.ascontiguousarray(col, dtype=np.int64))
+    if np.issubdtype(col.dtype, np.floating):
+        # widening matches the Python serializer (_serialize_value casts to float64)
+        return (2, np.ascontiguousarray(col, dtype=np.float64))
+    return None
+
+
+def _native_keys(columns: Sequence[np.ndarray], n: int) -> np.ndarray | None:
+    from pathway_tpu import native as _native
+
+    lib = _native.get_lib()
+    if lib is None:
+        return None
+    descs = []
+    for col in columns:
+        desc = _classify_column(np.asarray(col))
+        if desc is None:
+            return None
+        descs.append(desc)
+    import ctypes
+
+    cols = (_native.PwCol * len(descs))()
+    for i, (kind, data) in enumerate(descs):
+        cols[i].kind = kind
+        cols[i].data = data.ctypes.data_as(ctypes.c_void_p)
+        cols[i].offsets = None
+        cols[i].mask = None
+    hi = np.empty(n, dtype=np.uint64)
+    lo = np.empty(n, dtype=np.uint64)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    status = lib.pwtpu_hash_typed(
+        ctypes.cast(cols, ctypes.c_void_p),
+        len(descs),
+        n,
+        _SALT,
+        len(_SALT),
+        np.bool_,
+        np.integer,
+        hi.ctypes.data_as(u64p),
+        lo.ctypes.data_as(u64p),
+    )
+    if status != -1:
+        return None  # unsupported value encountered: Python path handles the batch
+    out = np.empty(n, dtype=KEY_DTYPE)
+    out["hi"], out["lo"] = hi, lo
+    return out
+
+
 def keys_from_values(columns: Sequence[np.ndarray]) -> np.ndarray:
-    """Vectorized key derivation for a batch of rows, one key per row."""
+    """Vectorized key derivation for a batch of rows, one key per row.
+
+    Large simple-typed batches route through the native hasher
+    (``csrc/pathway_native.cc``, byte-identical serialization); anything else falls
+    back to the Python serializer.
+    """
     n = len(columns[0]) if columns else 0
+    if n >= 64:
+        native_out = _native_keys(columns, n)
+        if native_out is not None:
+            return native_out
     out = np.empty(n, dtype=KEY_DTYPE)
     for i in range(n):
         chunks: list[bytes] = [_SALT]
@@ -119,6 +191,26 @@ def keys_from_values(columns: Sequence[np.ndarray]) -> np.ndarray:
 def sequential_keys(start: int, count: int) -> np.ndarray:
     """Keys for autogenerated row ids (dense ints hashed for uniform sharding)."""
     out = np.empty(count, dtype=KEY_DTYPE)
+    if count >= 64:
+        from pathway_tpu import native as _native
+
+        lib = _native.get_lib()
+        if lib is not None:
+            import ctypes
+
+            hi = np.empty(count, dtype=np.uint64)
+            lo = np.empty(count, dtype=np.uint64)
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            lib.pwtpu_sequential_keys(
+                _SALT,
+                len(_SALT),
+                start,
+                count,
+                hi.ctypes.data_as(u64p),
+                lo.ctypes.data_as(u64p),
+            )
+            out["hi"], out["lo"] = hi, lo
+            return out
     for i in range(count):
         hi, lo = _fingerprint_bytes(_SALT + b"seq" + (start + i).to_bytes(16, "little", signed=True))
         out["hi"][i], out["lo"][i] = hi, lo

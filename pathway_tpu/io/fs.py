@@ -52,6 +52,45 @@ def _coerce(value: str, dtype: dt.DType) -> Any:
     return value
 
 
+def _parse_csv_native(
+    filepath: str, delimiter: str, dtypes: Dict[str, dt.DType], has_schema: bool
+) -> List[dict] | None:
+    """Fused native CSV parse (split + coercion + row dicts in C++); None → fallback.
+
+    Mirrors the reference's native Dsv parser (``data_format.rs:500``): typed coercion
+    happens inside the parser, malformed fields poison cells with ``Error``. JSON-typed
+    columns are post-coerced in Python (rare)."""
+    from pathway_tpu import native
+    from pathway_tpu.engine.columnar import ERROR
+
+    # without a schema the wanted-column set is the header itself, which only the
+    # DictReader fallback computes naturally
+    if not has_schema or native.get_lib() is None or len(delimiter) != 1:
+        return None
+    with open(filepath, "rb") as f:
+        data = f.read()
+    _TAGS = {dt.INT: 1, dt.FLOAT: 2, dt.BOOL: 3}
+    selected = []
+    json_cols = []
+    for name, dtype in dtypes.items():
+        base = dtype.strip_optional()
+        selected.append((name, _TAGS.get(base, 0)))
+        if base == dt.JSON:
+            json_cols.append(name)
+    rows = native.parse_dsv_rows(data, selected, delimiter, ERROR)
+    if rows is None:
+        return None
+    for name in json_cols:
+        for row in rows:
+            v = row.get(name)
+            if isinstance(v, str):
+                try:
+                    row[name] = Json.parse(v)
+                except Exception:
+                    row[name] = ERROR
+    return rows
+
+
 def _iter_files(path: str, object_pattern: str = "*") -> List[str]:
     p = Path(path)
     if p.is_dir():
@@ -92,11 +131,15 @@ def _parse_file(
             rows.append({"data": f.read()})
     elif format == "csv":
         delimiter = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
-        with open(filepath, newline="") as f:
-            reader = _csv.DictReader(f, delimiter=delimiter)
-            dtypes = schema.dtypes() if schema else {}
-            for rec in reader:
-                rows.append({k: _coerce(v, dtypes.get(k, dt.STR)) for k, v in rec.items() if k in dtypes or not schema})
+        dtypes = schema.dtypes() if schema else {}
+        native_rows = _parse_csv_native(filepath, delimiter, dtypes, bool(schema))
+        if native_rows is not None:
+            rows.extend(native_rows)
+        else:
+            with open(filepath, newline="") as f:
+                reader = _csv.DictReader(f, delimiter=delimiter)
+                for rec in reader:
+                    rows.append({k: _coerce(v, dtypes.get(k, dt.STR)) for k, v in rec.items() if k in dtypes or not schema})
     elif format in ("json", "jsonlines"):
         dtypes = schema.dtypes() if schema else {}
         with open(filepath) as f:

@@ -1,0 +1,153 @@
+"""Native (C++) kernel tests: key-fingerprint parity with the Python serializer,
+DSV splitter parity with the csv module, fused CSV parse parity with the fallback."""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+
+import numpy as np
+import pytest
+
+import pathway_tpu.native as native
+from pathway_tpu.internals import keys as K
+
+
+requires_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+def _python_keys(columns):
+    os.environ["PATHWAY_TPU_DISABLE_NATIVE"] = "1"
+    native._tried, native._lib = False, None
+    try:
+        return K.keys_from_values(columns)
+    finally:
+        del os.environ["PATHWAY_TPU_DISABLE_NATIVE"]
+        native._tried, native._lib = False, None
+
+
+@requires_native
+@pytest.mark.parametrize(
+    "col",
+    [
+        np.array(["a", "bb", "ccc"] * 30, dtype=object),
+        np.arange(90).astype(object),
+        np.array([1.5, -2.25, 0.0] * 30, dtype=object),
+        np.array([True, False, True] * 30, dtype=object),
+        np.array(["x", None, "z"] * 30, dtype=object),
+        np.array([1, None, 3] * 30, dtype=object),
+        np.array([np.int64(7), np.float64(1.5), "s", None] * 20, dtype=object),
+        np.array([(1, 2), "x", 3.5, None] * 20, dtype=object),  # tuple → fallback path
+        np.array([2**100, 1, 2] * 30, dtype=object),  # 128-bit int → fallback path
+    ],
+    ids=["str", "int", "float", "bool", "str-none", "int-none", "mixed", "tuple", "bigint"],
+)
+def test_key_parity(col):
+    got = K.keys_from_values([col])
+    want = _python_keys([col])
+    assert (got == want).all()
+
+
+@requires_native
+def test_key_parity_typed_arrays():
+    cols = [np.arange(80, dtype=np.int64), np.array(["q"] * 80, dtype=object)]
+    assert (K.keys_from_values(cols) == _python_keys(cols)).all()
+
+
+@requires_native
+def test_sequential_key_parity():
+    got = K.sequential_keys(5, 100)
+    os.environ["PATHWAY_TPU_DISABLE_NATIVE"] = "1"
+    native._tried, native._lib = False, None
+    try:
+        want = K.sequential_keys(5, 100)
+    finally:
+        del os.environ["PATHWAY_TPU_DISABLE_NATIVE"]
+        native._tried, native._lib = False, None
+    assert (got == want).all()
+
+
+@requires_native
+@pytest.mark.parametrize(
+    "text",
+    [
+        "a,b,c\n1,2,3\n4,5,6\n",
+        'a,b\n"x,y",2\n"with ""quotes""",3\n',
+        "a,b\r\n1,2\r\n",
+        "a\nonly\n",
+        "",
+        "a,b\n1,\n,2\n",
+        'a,b\n"multi\nline",5\n',
+        "a,b\nlast,noeol",
+    ],
+    ids=["plain", "quoted", "crlf", "single", "empty", "empties", "multiline", "noeol"],
+)
+def test_split_dsv_matches_csv_module(text):
+    got = native.split_dsv(text.encode())
+    want = [r for r in csv.reader(io.StringIO(text)) if r]
+    assert got == want
+
+
+@requires_native
+def test_fused_csv_parse_parity(tmp_path):
+    import pathway_tpu as pw
+    from pathway_tpu.io import fs
+
+    path = tmp_path / "t.csv"
+    path.write_text('word,count,ok,score\n"a,b",notanint,true,1.5\nc,5,False,bad\n,,,\n')
+    schema = pw.schema_from_types(word=str, count=int, ok=bool, score=float)
+    with_native = fs._parse_file(str(path), "csv", schema, False)
+    os.environ["PATHWAY_TPU_DISABLE_NATIVE"] = "1"
+    native._tried, native._lib = False, None
+    try:
+        without = fs._parse_file(str(path), "csv", schema, False)
+    finally:
+        del os.environ["PATHWAY_TPU_DISABLE_NATIVE"]
+        native._tried, native._lib = False, None
+    assert with_native == without
+
+
+@requires_native
+def test_uint64_overflow_keys():
+    col = np.array([2**63 + 5] * 70, dtype=np.uint64)
+    assert (K.keys_from_values([col]) == _python_keys([col])).all()
+
+
+@requires_native
+def test_split_dsv_stray_quote_mid_field():
+    text = 'a,b\n5\'10",x\n'
+    got = native.split_dsv(text.encode())
+    want = [r for r in csv.reader(io.StringIO(text)) if r]
+    assert got == want
+
+
+@requires_native
+@pytest.mark.parametrize(
+    "content,types",
+    [
+        ("i,f\n99999999999999999999999999,1e-320\n1_000,0x1p3\n", {"i": int, "f": float}),
+        ('"a\nb",c\n1,2\n', {"a\nb": int, "c": int}),
+        ('x\n""\nz\n', {"x": str}),
+        ("x\n1\n", {"x": int, "missing": str}),
+    ],
+    ids=["bigint-subnormal", "quoted-header", "quoted-empty-row", "missing-col"],
+)
+def test_fused_parse_edge_parity(tmp_path, content, types):
+    import pathway_tpu as pw
+    from pathway_tpu.io import fs
+
+    path = tmp_path / "t.csv"
+    path.write_text(content)
+    schema = pw.schema_from_types(**types)
+    with_native = fs._parse_file(str(path), "csv", schema, False)
+    os.environ["PATHWAY_TPU_DISABLE_NATIVE"] = "1"
+    native._tried, native._lib = False, None
+    try:
+        without = fs._parse_file(str(path), "csv", schema, False)
+    finally:
+        del os.environ["PATHWAY_TPU_DISABLE_NATIVE"]
+        native._tried, native._lib = False, None
+    assert with_native == without
