@@ -285,8 +285,12 @@ uint64_t pwtpu_split_dsv(const char* data, uint64_t len, char delimiter,
       had_quotes = true;
     } else if (ch == delimiter) {
       end_field();
-    } else if (ch == '\r' && i + 1 < len && data[i + 1] == '\n') {
-      // CRLF line ending: drop the \r, the \n closes the row next iteration
+    } else if (ch == '\r') {
+      if (i + 1 < len && data[i + 1] == '\n') {
+        // CRLF: drop the \r, the \n closes the row next iteration
+      } else {
+        end_row();  // bare CR line ending (csv-module behavior)
+      }
     } else if (ch == '\n') {
       end_row();
     } else {

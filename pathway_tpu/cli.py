@@ -1,0 +1,132 @@
+"""``pathway_tpu`` command-line launcher.
+
+Parity: reference ``python/pathway/cli.py`` — ``spawn`` (multi-process launcher setting
+``PATHWAY_*`` env vars, ``:53-110``), ``spawn-from-env`` (``:284``), record/``replay``
+(``:166,252``). Run as ``python -m pathway_tpu.cli <command>``.
+
+Processes launched by ``spawn -n N`` are partitioned-ingest replicas: each is told its
+``PATHWAY_PROCESS_ID``/``PATHWAY_PROCESSES`` and connectors shard their source partitions
+accordingly (the reference's ``parallel_readers``). On-device scale-out uses the JAX mesh
+(``pathway_tpu.parallel``), not OS processes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import uuid
+from typing import NoReturn
+
+import click
+
+
+def _plural(n: int, singular: str, plural: str) -> str:
+    return f"1 {singular}" if n == 1 else f"{n} {plural}"
+
+
+def _spawn_program(*, threads, processes, first_port, program, arguments, env_base):
+    processes_str = _plural(processes, "process", "processes")
+    workers_str = _plural(processes * threads, "total worker", "total workers")
+    click.echo(f"Preparing {processes_str} ({workers_str})", err=True)
+    run_id = uuid.uuid4()
+    handles = []
+    try:
+        for process_id in range(processes):
+            env = env_base.copy()
+            env["PATHWAY_THREADS"] = str(threads)
+            env["PATHWAY_PROCESSES"] = str(processes)
+            env["PATHWAY_FIRST_PORT"] = str(first_port)
+            env["PATHWAY_PROCESS_ID"] = str(process_id)
+            env["PATHWAY_RUN_ID"] = str(run_id)
+            handles.append(subprocess.Popen([program, *arguments], env=env))
+        for handle in handles:
+            handle.wait()
+    finally:
+        for handle in handles:
+            handle.terminate()
+    sys.exit(max(handle.returncode for handle in handles))
+
+
+@click.group
+def cli() -> None:
+    pass
+
+
+_SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
+
+
+@cli.command(context_settings=_SPAWN_SETTINGS)
+@click.option("-t", "--threads", metavar="N", type=int, default=1, help="number of threads per process")
+@click.option("-n", "--processes", metavar="N", type=int, default=1, help="number of processes")
+@click.option("--first-port", type=int, metavar="PORT", default=10000, help="first port to use for communication")
+@click.option("--record", is_flag=True, help="record data in the input connectors")
+@click.option("--record-path", type=str, default="record", help="directory in which record will be saved")
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def spawn(threads, processes, first_port, record, record_path, program, arguments):
+    env = os.environ.copy()
+    if record:
+        env["PATHWAY_REPLAY_STORAGE"] = record_path
+        env["PATHWAY_SNAPSHOT_ACCESS"] = "record"
+        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    _spawn_program(
+        threads=threads,
+        processes=processes,
+        first_port=first_port,
+        program=program,
+        arguments=arguments,
+        env_base=env,
+    )
+
+
+@cli.command(context_settings=_SPAWN_SETTINGS)
+@click.option("-t", "--threads", metavar="N", type=int, default=1, help="number of threads per process")
+@click.option("-n", "--processes", metavar="N", type=int, default=1, help="number of processes")
+@click.option("--first-port", type=int, metavar="PORT", default=10000, help="first port to use for communication")
+@click.option("--record-path", type=str, default="record", help="directory in which recording is stored")
+@click.option("--mode", type=click.Choice(["batch", "speedrun"], case_sensitive=False), help="mode of replaying data")
+@click.option(
+    "--continue",
+    "continue_after_replay",
+    is_flag=True,
+    help="continue with realtime data from connectors after stored recording is replayed",
+)
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def replay(threads, processes, first_port, record_path, mode, continue_after_replay, program, arguments):
+    env = os.environ.copy()
+    env["PATHWAY_REPLAY_STORAGE"] = record_path
+    env["PATHWAY_SNAPSHOT_ACCESS"] = "replay"
+    if mode:
+        env["PATHWAY_PERSISTENCE_MODE"] = mode
+        env["PATHWAY_REPLAY_MODE"] = mode
+    if continue_after_replay:
+        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    _spawn_program(
+        threads=threads,
+        processes=processes,
+        first_port=first_port,
+        program=program,
+        arguments=arguments,
+        env_base=env,
+    )
+
+
+@cli.command()
+def spawn_from_env():
+    cli_spawn_arguments = os.environ.get("PATHWAY_SPAWN_ARGS")
+    if cli_spawn_arguments is not None:
+        args = ["spawn"] + cli_spawn_arguments.split(" ")
+        os.execl(sys.executable, sys.executable, "-m", "pathway_tpu.cli", *args)
+    else:
+        logging.warning("PATHWAY_SPAWN_ARGS variable is unspecified, exiting...")
+
+
+def main() -> NoReturn:
+    cli.main()
+
+
+if __name__ == "__main__":
+    main()

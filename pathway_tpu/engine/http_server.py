@@ -1,0 +1,127 @@
+"""Per-process monitoring HTTP endpoint.
+
+Parity: reference ``src/engine/http_server.rs`` — an OpenMetrics ``/status`` endpoint on
+``PATHWAY_MONITORING_HTTP_PORT`` (default 20000) + process_id, exposing input/output
+latencies and row counters (``metrics_from_stats``, ``:25``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+DEFAULT_MONITORING_HTTP_PORT = 20000
+
+
+class ProberStats:
+    """Shared run statistics, updated by the commit loop (reference ``graph.rs:554``)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.started = time.time()
+        self.last_input_time: Optional[float] = None
+        self.last_output_time: Optional[float] = None
+        self.input_finished = False
+        self.rows_by_node: Dict[int, int] = {}
+        self.input_rows = 0
+        self.output_rows = 0
+        self.commits = 0
+
+    def record_commit(
+        self, input_rows: int, output_rows: int, row_counts: Dict[int, int], finished: bool
+    ) -> None:
+        now = time.time()
+        with self.lock:
+            self.commits += 1
+            if input_rows:
+                self.last_input_time = now
+                self.input_rows += input_rows
+            if output_rows:
+                self.last_output_time = now
+                self.output_rows += output_rows
+            for nid, n in row_counts.items():
+                self.rows_by_node[nid] = self.rows_by_node.get(nid, 0) + n
+            self.input_finished = finished
+
+    def to_openmetrics(self) -> str:
+        now = time.time()
+        with self.lock:
+            if self.input_finished:
+                input_latency = -1
+            elif self.last_input_time is None:
+                input_latency = int((now - self.started) * 1000)
+            else:
+                input_latency = int((now - self.last_input_time) * 1000)
+            if self.input_finished:
+                output_latency = -1
+            elif self.last_output_time is None:
+                output_latency = int((now - self.started) * 1000)
+            else:
+                output_latency = int((now - self.last_output_time) * 1000)
+            lines = [
+                "# HELP input_latency_ms A latency of input in milliseconds (-1 when finished)",
+                "# TYPE input_latency_ms gauge",
+                f"input_latency_ms {input_latency}",
+                "# HELP output_latency_ms A latency of output in milliseconds (-1 when finished)",
+                "# TYPE output_latency_ms gauge",
+                f"output_latency_ms {output_latency}",
+                "# HELP input_rows_total Rows ingested by input connectors",
+                "# TYPE input_rows_total counter",
+                f"input_rows_total {self.input_rows}",
+                "# HELP output_rows_total Rows delivered to sinks",
+                "# TYPE output_rows_total counter",
+                f"output_rows_total {self.output_rows}",
+                "# HELP commits_total Engine commits executed",
+                "# TYPE commits_total counter",
+                f"commits_total {self.commits}",
+                "# EOF",
+            ]
+        return "\n".join(lines) + "\n"
+
+
+class MonitoringServer:
+    def __init__(self, stats: ProberStats, port: int):
+        self.stats = stats
+        stats_ref = stats
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if self.path not in ("/status", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = stats_ref.to_openmetrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/openmetrics-text")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="pathway:monitoring-http"
+        )
+        self.thread.start()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def maybe_start_http_server(stats: ProberStats, enabled: bool) -> Optional[MonitoringServer]:
+    if not enabled:
+        return None
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    base = cfg.monitoring_http_port or DEFAULT_MONITORING_HTTP_PORT
+    try:
+        return MonitoringServer(stats, base + cfg.process_id)
+    except OSError:
+        return None

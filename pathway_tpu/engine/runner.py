@@ -36,6 +36,9 @@ class GraphRunner:
         self._snapshot_interval_s = 0.0
         self._last_checkpoint = time_mod.monotonic()
         self._warned_unpicklable = False
+        self.prober_stats: Any = None
+        self._output_rows_this_commit = 0
+        self._http_server: Any = None
         self.replay_outputs = True
 
     def state_of(self, node: pg.Node) -> StateTable:
@@ -107,6 +110,18 @@ class GraphRunner:
         self._ready = True
         # replay journaled input deltas through the (deterministic) graph to rebuild
         # every operator's state, before any realtime stepping
+        from pathway_tpu.internals.config import get_pathway_config
+
+        if replay_frames and get_pathway_config().persistence_mode == "batch":
+            # replay the whole recording as ONE commit (reference PersistenceMode::Batch)
+            merged: Dict[int, List[Delta]] = {}
+            for _cid, input_deltas, _offs in replay_frames:
+                for nid, delta in input_deltas.items():
+                    merged.setdefault(nid, []).append(delta)
+            combined = {
+                nid: Delta.concat(ds, list(ds[0].columns)) for nid, ds in merged.items()
+            }
+            replay_frames = [(replay_frames[-1][0], combined, replay_frames[-1][2])]
         for commit_id, input_deltas, _offsets in replay_frames:
             self._inject = input_deltas
             self.step()
@@ -259,6 +274,14 @@ class GraphRunner:
                 ):
                     if self._take_checkpoint():
                         self._last_checkpoint = time_mod.monotonic()
+        if self.prober_stats is not None:
+            input_rows = sum(len(d) for d in self._input_deltas.values())
+            self.prober_stats.record_commit(
+                input_rows,
+                self._output_rows_this_commit,
+                self._step_counts,
+                self.sources_finished(),
+            )
         if self._monitor is not None:
             self._monitor.update(self._commit, self._step_counts, self.states)
         self._commit += 1
@@ -267,10 +290,21 @@ class GraphRunner:
     def _substep(self, *, neu: bool) -> bool:
         if not neu:
             self._step_counts = {}
+            self._output_rows_this_commit = 0
         deltas: Dict[int, Delta] = {}
         any_output = False
         for node in self._nodes:
             evaluator = self.evaluators[node.id]
+            if (
+                isinstance(node, pg.OutputNode)
+                and not neu
+                and (self._inject is None or self.replay_outputs)
+            ):
+                # count only rows actually delivered to sinks (not forgetting-phase
+                # retractions, not silently-replayed history)
+                self._output_rows_this_commit += sum(
+                    len(deltas.get(inp._node.id, ())) for inp in node.inputs
+                )
             if isinstance(node, pg.InputNode):
                 if neu:
                     delta = Delta.empty(self.output_columns_of(node))
@@ -330,6 +364,9 @@ class GraphRunner:
             self._persistence.close()
         if self._monitor is not None:
             self._monitor.close()
+        if self._http_server is not None:
+            self._http_server.close()
+            self._http_server = None
 
     def run(
         self,
@@ -341,8 +378,27 @@ class GraphRunner:
         persistence_config: Any = None,
         **kwargs: Any,
     ) -> None:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        env_cfg = get_pathway_config()
+        if persistence_config is None and env_cfg.replay_storage:
+            # `pathway_tpu spawn --record` / `replay` contract (reference cli.py:166-284)
+            from pathway_tpu import persistence as _pers
+
+            persistence_config = _pers.Config(
+                _pers.Backend.filesystem(env_cfg.replay_storage)
+            )
+        from pathway_tpu.engine.http_server import ProberStats, maybe_start_http_server
+
+        self.prober_stats = ProberStats()
+        self._http_server = maybe_start_http_server(self.prober_stats, with_http_server)
         if not self._ready:
             self.setup(monitoring_level, persistence_config=persistence_config)
+        if env_cfg.snapshot_access == "replay" and not env_cfg.continue_after_replay:
+            # replay-only run: the journal has been fed through the graph in setup();
+            # stop without consuming realtime connector data
+            self.finish()
+            return
         commits = 0
         try:
             while True:
@@ -375,7 +431,9 @@ def _make_monitor(level: Any, nodes: List[pg.Node]) -> Any:
 
     if level in (MonitoringLevel.NONE, "none"):
         return None
-    return StatsMonitor(nodes)
+    if isinstance(level, str):
+        level = MonitoringLevel(level)
+    return StatsMonitor(nodes, level=level)
 
 
 def run(**kwargs: Any) -> None:

@@ -1,0 +1,65 @@
+"""Environment-driven runtime configuration.
+
+Parity: reference ``src/engine/dataflow/config.rs:88`` (``Config::from_env`` —
+``PATHWAY_THREADS``/``PATHWAY_PROCESSES``/``PATHWAY_PROCESS_ID``/``PATHWAY_FIRST_PORT``)
+plus the record/replay env contract set by the CLI (``python/pathway/cli.py:166-284``:
+``PATHWAY_REPLAY_STORAGE``, ``PATHWAY_SNAPSHOT_ACCESS``, ``PATHWAY_PERSISTENCE_MODE``,
+``PATHWAY_CONTINUE_AFTER_REPLAY``) and ``internals/config.py`` (``pathway_config``).
+
+Here processes are partitioned-ingest replicas (each process owns a hash-shard of the
+source partitions — the analogue of ``parallel_readers``); on-device scale-out rides the
+JAX mesh in ``pathway_tpu.parallel`` instead of OS threads.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class PathwayConfig:
+    threads: int = 1
+    processes: int = 1
+    process_id: int = 0
+    first_port: int = 10000
+    run_id: str | None = None
+    monitoring_http_port: int | None = None
+    replay_storage: str | None = None
+    snapshot_access: str | None = None  # "record" | "replay" | None
+    persistence_mode: str | None = None  # "batch" | "speedrun" | None
+    continue_after_replay: bool = True
+
+    @classmethod
+    def from_env(cls) -> "PathwayConfig":
+        port = os.environ.get("PATHWAY_MONITORING_HTTP_PORT")
+        cont_env = os.environ.get("PATHWAY_CONTINUE_AFTER_REPLAY")
+        if cont_env is not None:
+            cont = cont_env.lower() in ("true", "1", "yes")
+        else:
+            # like the reference: `pathway replay` stops after the recording unless
+            # --continue; normal and record runs keep consuming realtime data
+            cont = os.environ.get("PATHWAY_SNAPSHOT_ACCESS") != "replay"
+        return cls(
+            threads=max(_int_env("PATHWAY_THREADS", 1), 1),
+            processes=max(_int_env("PATHWAY_PROCESSES", 1), 1),
+            process_id=_int_env("PATHWAY_PROCESS_ID", 0),
+            first_port=_int_env("PATHWAY_FIRST_PORT", 10000),
+            run_id=os.environ.get("PATHWAY_RUN_ID"),
+            monitoring_http_port=int(port) if port else None,
+            replay_storage=os.environ.get("PATHWAY_REPLAY_STORAGE"),
+            snapshot_access=os.environ.get("PATHWAY_SNAPSHOT_ACCESS"),
+            persistence_mode=os.environ.get("PATHWAY_PERSISTENCE_MODE") or None,
+            continue_after_replay=cont,
+        )
+
+
+def get_pathway_config() -> PathwayConfig:
+    return PathwayConfig.from_env()

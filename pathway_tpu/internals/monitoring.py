@@ -1,4 +1,9 @@
-"""Run-progress monitoring (parity: reference ``internals/monitoring.py`` rich dashboard)."""
+"""Run-progress monitoring.
+
+Parity: reference ``internals/monitoring.py`` — a rich-powered live terminal dashboard
+(operator latencies, connector counts, ``:56-190``) with ``MonitoringLevel`` (``:228``)
+controlling detail. Falls back to plain stderr lines off-tty or without rich.
+"""
 
 from __future__ import annotations
 
@@ -17,19 +22,71 @@ class MonitoringLevel(enum.Enum):
 
 
 class StatsMonitor:
-    """Lightweight operator-counter monitor; rich live table when attached to a tty."""
+    """Operator-counter monitor: rich live table on a tty, plain lines otherwise."""
 
-    def __init__(self, nodes: List[Any]):
+    def __init__(self, nodes: List[Any], level: MonitoringLevel = MonitoringLevel.AUTO):
         self.nodes = nodes
+        self.level = level
         self.counts: Dict[int, int] = {}
+        self.latest_commit_rows: Dict[int, int] = {}
         self.start = time.monotonic()
         self._last_print = 0.0
+        self._live: Any = None
+        if sys.stderr.isatty():
+            try:
+                from rich.live import Live
 
-    def update(self, commit: int, row_counts: Dict[int, int], states: Dict[int, Any] | None = None) -> None:
+                self._live = Live(
+                    self._render(0), refresh_per_second=2, transient=True, console=None
+                )
+                self._live.start()
+            except Exception:
+                self._live = None
+
+    def _interesting_nodes(self) -> List[Any]:
+        show_all = self.level in (MonitoringLevel.ALL, MonitoringLevel.AUTO_ALL)
+        out = []
+        for node in self.nodes:
+            if node.kind in ("input", "output") or show_all:
+                out.append(node)
+        return out
+
+    def _render(self, commit: int) -> Any:
+        from rich.table import Table
+
+        table = Table(title=f"pathway_tpu run — commit {commit}")
+        table.add_column("operator")
+        table.add_column("kind")
+        table.add_column("rows in latest commit", justify="right")
+        table.add_column("rows total", justify="right")
+        for node in self._interesting_nodes():
+            table.add_row(
+                node.name,
+                node.kind,
+                str(self.latest_commit_rows.get(node.id, 0)),
+                str(self.counts.get(node.id, 0)),
+            )
+        table.caption = f"elapsed {time.monotonic() - self.start:.1f}s"
+        return table
+
+    def update(
+        self,
+        commit: int,
+        row_counts: Dict[int, int],
+        states: Dict[int, Any] | None = None,
+    ) -> None:
+        self.latest_commit_rows = dict(row_counts)
         for node_id, n in row_counts.items():
             self.counts[node_id] = self.counts.get(node_id, 0) + n
         now = time.monotonic()
-        if now - self._last_print > 1.0 and sys.stderr.isatty():
+        if self._live is not None:
+            if now - self._last_print > 0.4:
+                self._last_print = now
+                try:
+                    self._live.update(self._render(commit))
+                except Exception:
+                    pass
+        elif now - self._last_print > 1.0 and sys.stderr.isatty():
             self._last_print = now
             total = sum(self.counts.values())
             print(
@@ -39,4 +96,9 @@ class StatsMonitor:
             )
 
     def close(self) -> None:
-        pass
+        if self._live is not None:
+            try:
+                self._live.stop()
+            except Exception:
+                pass
+            self._live = None

@@ -65,7 +65,7 @@ def _parse_csv_native(
 
     # without a schema the wanted-column set is the header itself, which only the
     # DictReader fallback computes naturally
-    if not has_schema or native.get_lib() is None or len(delimiter) != 1:
+    if not has_schema or native.get_lib() is None or len(delimiter.encode()) != 1:
         return None
     with open(filepath, "rb") as f:
         data = f.read()
@@ -247,9 +247,20 @@ class _FsSubject:
         source.push_state({"file": filepath, "deleted": True})
 
     def run(self, source: StreamingDataSource) -> None:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        cfg = get_pathway_config()
         stop = False
         while not stop:
             present = _iter_files(self.path, self.object_pattern)
+            if cfg.processes > 1:
+                # partitioned parallel read (reference parallel_readers,
+                # dataflow.rs:3317): each spawned process owns a hash-shard of files
+                present = [
+                    f
+                    for f in present
+                    if pointer_from(f).lo % cfg.processes == cfg.process_id
+                ]
             for filepath in present:
                 try:
                     if self.seen.get(filepath) == os.stat(filepath).st_mtime:

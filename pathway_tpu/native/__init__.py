@@ -54,6 +54,7 @@ def _build() -> Optional[str]:
     import sysconfig
 
     py_include = sysconfig.get_paths()["include"]
+    tmp = f"{_SO}.{os.getpid()}.tmp"  # per-pid: concurrent spawned processes may race
     cmd = [
         "g++",
         "-O3",
@@ -65,13 +66,17 @@ def _build() -> Optional[str]:
         f"-I{py_include}",
         src,
         "-o",
-        _SO + ".tmp",
+        tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, _SO)
         return _SO
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 
@@ -216,7 +221,7 @@ def parse_dsv_rows(
     Malformed typed fields yield ``error_obj``.
     """
     lib = get_lib()
-    if lib is None or len(delimiter) != 1:
+    if lib is None or len(delimiter.encode()) != 1:
         return None
     tags = (ctypes.c_int32 * len(selected))(*[tag for _name, tag in selected])
     names = tuple(name for name, _tag in selected)

@@ -1,0 +1,47 @@
+"""Monitoring: /status OpenMetrics endpoint + ProberStats counters."""
+
+from __future__ import annotations
+
+import urllib.request
+
+import pathway_tpu as pw
+from pathway_tpu.engine.http_server import MonitoringServer, ProberStats
+from pathway_tpu.engine.runner import GraphRunner
+from pathway_tpu.internals.parse_graph import G
+
+
+def test_status_endpoint_serves_openmetrics():
+    stats = ProberStats()
+    stats.record_commit(10, 4, {1: 10, 2: 4}, finished=False)
+    server = MonitoringServer(stats, 0)  # ephemeral port
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/status", timeout=5
+        ).read().decode()
+    finally:
+        server.close()
+    assert "input_latency_ms" in body
+    assert "output_latency_ms" in body
+    assert "input_rows_total 10" in body
+    assert "output_rows_total 4" in body
+    assert body.rstrip().endswith("# EOF")
+
+
+def test_prober_stats_fed_by_run():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    seen = []
+    pw.io.subscribe(t, lambda key, row, time, is_addition: seen.append(row))
+    runner = GraphRunner(G._current)
+    runner.run()
+    assert runner.prober_stats is not None
+    assert runner.prober_stats.input_rows == 2
+    assert runner.prober_stats.output_rows == 2
+    assert len(seen) == 2
+    metrics = runner.prober_stats.to_openmetrics()
+    assert "input_latency_ms -1" in metrics  # finished

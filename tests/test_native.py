@@ -151,3 +151,30 @@ def test_fused_parse_edge_parity(tmp_path, content, types):
         del os.environ["PATHWAY_TPU_DISABLE_NATIVE"]
         native._tried, native._lib = False, None
     assert with_native == without
+
+
+@requires_native
+def test_split_dsv_cr_only_line_endings():
+    # csv.reader errors on untranslated bare-CR input; the native splitter applies
+    # universal-newline row breaks, matching csv over translated text
+    text = "a,b\r1,2\r3,4\r"
+    got = native.split_dsv(text.encode())
+    translated = text.replace("\r\n", "\n").replace("\r", "\n")
+    want = [r for r in csv.reader(io.StringIO(translated)) if r]
+    assert got == want
+
+
+@requires_native
+def test_multibyte_delimiter_falls_back(tmp_path):
+    import pathway_tpu as pw
+    from pathway_tpu.io import fs
+
+    path = tmp_path / "t.csv"
+    path.write_text("a¦b\n1¦2\n")
+
+    class Settings:
+        delimiter = "¦"
+
+    schema = pw.schema_from_types(a=int, b=int)
+    rows = fs._parse_file(str(path), "csv", schema, False, csv_settings=Settings())
+    assert rows == [{"a": 1, "b": 2}]
