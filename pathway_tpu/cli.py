@@ -46,7 +46,13 @@ def _spawn_program(*, threads, processes, first_port, program, arguments, env_ba
     finally:
         for handle in handles:
             handle.terminate()
-    sys.exit(max(handle.returncode for handle in handles))
+    codes = [handle.returncode for handle in handles]
+    failures = [c for c in codes if c != 0]
+    if not failures:
+        sys.exit(0)
+    # signal-killed children have negative codes; surface any failure as nonzero
+    first = failures[0]
+    sys.exit(first if 0 < first < 256 else 1)
 
 
 @click.group
@@ -101,7 +107,6 @@ def replay(threads, processes, first_port, record_path, mode, continue_after_rep
     env["PATHWAY_SNAPSHOT_ACCESS"] = "replay"
     if mode:
         env["PATHWAY_PERSISTENCE_MODE"] = mode
-        env["PATHWAY_REPLAY_MODE"] = mode
     if continue_after_replay:
         env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
     _spawn_program(

@@ -120,7 +120,13 @@ class InputEvaluator(Evaluator):
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         source = self.node.config["source"]
-        return source.next_batch(self.output_columns)
+        delta = source.next_batch(self.output_columns)
+        if len(delta) == 0:
+            return delta
+        # a keyed upsert stream (e.g. Debezium CDC) can retract and re-add the same key
+        # within one commit; net the multiplicities so state application is order-free
+        # (reference UpsertSession semantics, adaptors.rs:67)
+        return delta.consolidated()
 
 
 class RowwiseEvaluator(Evaluator):

@@ -121,7 +121,13 @@ def maybe_start_http_server(stats: ProberStats, enabled: bool) -> Optional[Monit
 
     cfg = get_pathway_config()
     base = cfg.monitoring_http_port or DEFAULT_MONITORING_HTTP_PORT
+    port = base + cfg.process_id
     try:
-        return MonitoringServer(stats, base + cfg.process_id)
-    except OSError:
+        return MonitoringServer(stats, port)
+    except OSError as exc:
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "monitoring HTTP endpoint requested but port %d is unavailable: %s", port, exc
+        )
         return None

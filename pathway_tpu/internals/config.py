@@ -39,7 +39,11 @@ class PathwayConfig:
 
     @classmethod
     def from_env(cls) -> "PathwayConfig":
-        port = os.environ.get("PATHWAY_MONITORING_HTTP_PORT")
+        port_env = os.environ.get("PATHWAY_MONITORING_HTTP_PORT")
+        try:
+            port = int(port_env) if port_env else None
+        except ValueError:
+            port = None  # malformed optional knob must not kill the pipeline
         cont_env = os.environ.get("PATHWAY_CONTINUE_AFTER_REPLAY")
         if cont_env is not None:
             cont = cont_env.lower() in ("true", "1", "yes")
@@ -53,7 +57,7 @@ class PathwayConfig:
             process_id=_int_env("PATHWAY_PROCESS_ID", 0),
             first_port=_int_env("PATHWAY_FIRST_PORT", 10000),
             run_id=os.environ.get("PATHWAY_RUN_ID"),
-            monitoring_http_port=int(port) if port else None,
+            monitoring_http_port=port,
             replay_storage=os.environ.get("PATHWAY_REPLAY_STORAGE"),
             snapshot_access=os.environ.get("PATHWAY_SNAPSHOT_ACCESS"),
             persistence_mode=os.environ.get("PATHWAY_PERSISTENCE_MODE") or None,

@@ -34,10 +34,15 @@ class StatsMonitor:
         self._live: Any = None
         if sys.stderr.isatty():
             try:
+                from rich.console import Console
                 from rich.live import Live
 
+                # stderr console: program stdout stays clean under redirection
                 self._live = Live(
-                    self._render(0), refresh_per_second=2, transient=True, console=None
+                    self._render(0),
+                    refresh_per_second=2,
+                    transient=True,
+                    console=Console(stderr=True),
                 )
                 self._live.start()
             except Exception:

@@ -6,19 +6,62 @@ Parity: reference ``python/pathway/io/`` — 27 connector namespaces. Connectors
 ImportError at call time, not import time.
 """
 
-from pathway_tpu.io import csv, fs, http, jsonlines, kafka, null, plaintext, python, s3, sqlite
+from pathway_tpu.io import (
+    airbyte,
+    bigquery,
+    csv,
+    debezium,
+    deltalake,
+    elasticsearch,
+    fs,
+    gdrive,
+    http,
+    jsonlines,
+    kafka,
+    logstash,
+    minio,
+    mongodb,
+    nats,
+    null,
+    plaintext,
+    postgres,
+    pubsub,
+    pyfilesystem,
+    python,
+    redpanda,
+    s3,
+    s3_csv,
+    slack,
+    sqlite,
+)
 from pathway_tpu.io._subscribe import subscribe
 
 __all__ = [
+    "airbyte",
+    "bigquery",
     "csv",
+    "debezium",
+    "deltalake",
+    "elasticsearch",
     "fs",
+    "gdrive",
     "http",
     "jsonlines",
     "kafka",
+    "logstash",
+    "minio",
+    "mongodb",
+    "nats",
     "null",
     "plaintext",
+    "postgres",
+    "pubsub",
+    "pyfilesystem",
     "python",
+    "redpanda",
     "s3",
+    "s3_csv",
+    "slack",
     "sqlite",
     "subscribe",
 ]

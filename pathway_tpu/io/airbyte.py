@@ -1,0 +1,24 @@
+"""Airbyte connector (parity: reference ``io/airbyte`` + vendored airbyte_serverless).
+Runs Airbyte sources via docker or a local venv; neither is available in this image, so
+the surface degrades with a clear error."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def read(
+    config_file_path: str,
+    streams: list[str],
+    *,
+    mode: str = "streaming",
+    execution_type: str = "local",
+    env_vars: dict | None = None,
+    refresh_interval_ms: int = 60_000,
+    **kwargs: Any,
+) -> Any:
+    raise ImportError(
+        "the Airbyte runtime (docker or airbyte-serverless) is not available in this "
+        "environment; materialize the Airbyte stream to files and use pw.io.fs / "
+        "pw.io.jsonlines, or feed records through pw.io.python.ConnectorSubject"
+    )

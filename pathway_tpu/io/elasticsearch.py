@@ -1,0 +1,120 @@
+"""Elasticsearch sink.
+
+Parity: reference ``io/elasticsearch`` over the Elastic writer
+(``src/connectors/data_storage.rs:1336``). Implemented against the REST ``_bulk`` API via
+``requests`` (no elasticsearch-py needed): additions index documents, retractions delete
+by the row key, matching the reference's update-stream semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+class ElasticSearchAuth:
+    """Auth settings holder (reference ``io/elasticsearch`` ``ElasticSearchAuth``)."""
+
+    def __init__(self, kind: str, **params: Any):
+        self.kind = kind
+        self.params = params
+
+    @classmethod
+    def basic(cls, username: str, password: str) -> "ElasticSearchAuth":
+        return cls("basic", username=username, password=password)
+
+    @classmethod
+    def apikey(cls, api_key_id: str, api_key: str) -> "ElasticSearchAuth":
+        return cls("apikey", api_key_id=api_key_id, api_key=api_key)
+
+    @classmethod
+    def bearer(cls, token: str) -> "ElasticSearchAuth":
+        return cls("bearer", token=token)
+
+    def apply(self, session: Any) -> None:
+        if self.kind == "basic":
+            session.auth = (self.params["username"], self.params["password"])
+        elif self.kind == "apikey":
+            session.headers["Authorization"] = (
+                f"ApiKey {self.params['api_key_id']}:{self.params['api_key']}"
+            )
+        elif self.kind == "bearer":
+            session.headers["Authorization"] = f"Bearer {self.params['token']}"
+
+
+class _BulkWriter:
+    def __init__(self, host: str, index_name: str, auth: ElasticSearchAuth | None, batch_size: int = 500):
+        import requests
+
+        self.host = host.rstrip("/")
+        self.index = index_name
+        self.session = requests.Session()
+        if auth is not None:
+            auth.apply(self.session)
+        self.batch: list[str] = []
+        self.batch_size = batch_size
+        self.lock = threading.Lock()
+
+    def add(self, key: Any, row: dict, is_addition: bool) -> None:
+        doc_id = repr(key)
+        with self.lock:
+            if is_addition:
+                self.batch.append(json.dumps({"index": {"_index": self.index, "_id": doc_id}}))
+                self.batch.append(json.dumps(_plain_row(row)))
+            else:
+                self.batch.append(json.dumps({"delete": {"_index": self.index, "_id": doc_id}}))
+            if len(self.batch) >= self.batch_size:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self.batch:
+            return
+        body = "\n".join(self.batch) + "\n"
+        self.batch = []
+        response = self.session.post(
+            f"{self.host}/_bulk",
+            data=body.encode(),
+            headers={"Content-Type": "application/x-ndjson"},
+            timeout=30,
+        )
+        response.raise_for_status()
+
+    def close(self) -> None:
+        with self.lock:
+            self._flush_locked()
+
+
+def _plain_row(row: dict) -> dict:
+    from pathway_tpu.internals.json import Json
+
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, Json):
+            out[k] = v.value
+        elif hasattr(v, "item"):
+            out[k] = v.item()
+        elif type(v).__name__ == "Pointer":
+            out[k] = repr(v)
+        else:
+            out[k] = v
+    return out
+
+
+def write(
+    table: Table,
+    host: str,
+    auth: ElasticSearchAuth | None = None,
+    index_name: str | None = None,
+    **kwargs: Any,
+) -> None:
+    writer = _BulkWriter(host, index_name or "pathway", auth)
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        writer.add(key, row, is_addition)
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=writer.close))

@@ -1,0 +1,50 @@
+"""Logstash sink (parity: reference ``io/logstash`` — HTTP input plugin).
+
+Posts one JSON document per update to a Logstash HTTP input endpoint via ``requests``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def write(
+    table: Table,
+    endpoint: str,
+    n_retries: int = 0,
+    retry_policy: Any = None,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int | None = None,
+    **kwargs: Any,
+) -> None:
+    import requests
+
+    session = requests.Session()
+    timeout = (request_timeout_ms or 10_000) / 1000.0
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        from pathway_tpu.io.elasticsearch import _plain_row
+
+        doc = {**_plain_row(row), "time": time, "diff": 1 if is_addition else -1}
+        last_error: Exception | None = None
+        for _attempt in range(n_retries + 1):
+            try:
+                response = session.post(
+                    endpoint,
+                    data=json.dumps(doc).encode(),
+                    headers={"Content-Type": "application/json"},
+                    timeout=timeout,
+                )
+                response.raise_for_status()
+                return
+            except Exception as exc:  # retry per policy
+                last_error = exc
+        if last_error is not None:
+            raise last_error
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=session.close))

@@ -1,0 +1,42 @@
+"""MinIO connector (parity: reference ``io/minio`` — S3-compatible endpoint settings)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io import s3 as _s3
+from pathway_tpu.io.s3 import AwsS3Settings
+
+
+class MinIOSettings:
+    def __init__(
+        self,
+        endpoint: str,
+        bucket_name: str,
+        access_key: str,
+        secret_access_key: str,
+        *,
+        with_path_style: bool = True,
+        region: str | None = None,
+    ):
+        self.endpoint = endpoint
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.with_path_style = with_path_style
+        self.region = region
+
+    def create_aws_settings(self) -> AwsS3Settings:
+        return AwsS3Settings(
+            bucket_name=self.bucket_name,
+            access_key=self.access_key,
+            secret_access_key=self.secret_access_key,
+            region=self.region,
+            endpoint=self.endpoint,
+            with_path_style=self.with_path_style,
+        )
+
+
+def read(path: str, minio_settings: MinIOSettings | None = None, **kwargs: Any) -> Any:
+    settings = minio_settings.create_aws_settings() if minio_settings else None
+    return _s3.read(path, aws_s3_settings=settings, **kwargs)

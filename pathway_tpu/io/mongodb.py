@@ -1,0 +1,27 @@
+"""MongoDB sink (parity: reference ``io/mongodb`` over ``data_storage.rs:2232`` with the
+Bson formatter ``data_format.rs:1975``). Requires pymongo."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def write(table: Table, connection_string: str, database: str, collection: str, **kwargs: Any) -> None:
+    try:
+        import pymongo
+    except ImportError:
+        raise ImportError("pymongo is not available in this environment")
+
+    client = pymongo.MongoClient(connection_string)
+    coll = client[database][collection]
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        from pathway_tpu.io.elasticsearch import _plain_row
+
+        coll.insert_one({**_plain_row(row), "time": time, "diff": 1 if is_addition else -1})
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=client.close))

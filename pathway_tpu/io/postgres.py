@@ -1,0 +1,115 @@
+"""PostgreSQL sink.
+
+Parity: reference ``io/postgres`` over the Psql writer (``src/connectors/data_storage.rs:1080``)
+with the ``PsqlUpdates``/``PsqlSnapshot`` formatters (``data_format.rs:1625,1684``).
+Statement generation is pure (testable without a server); execution needs psycopg2/pg8000.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def _sql_value(v: Any) -> Any:
+    from pathway_tpu.internals.json import Json
+
+    if isinstance(v, Json):
+        import json as _json
+
+        return _json.dumps(v.value)
+    if hasattr(v, "item"):
+        return v.item()
+    if type(v).__name__ == "Pointer":
+        return repr(v)
+    return v
+
+
+def updates_statement(table_name: str, row: dict, time: int, diff: int) -> tuple[str, Sequence[Any]]:
+    """INSERT carrying (time, diff) — the ``PsqlUpdates`` wire format."""
+    cols = [*row.keys(), "time", "diff"]
+    placeholders = ", ".join(["%s"] * len(cols))
+    sql = f'INSERT INTO {table_name} ({", ".join(cols)}) VALUES ({placeholders})'
+    return sql, [*(_sql_value(v) for v in row.values()), time, diff]
+
+
+def snapshot_statement(
+    table_name: str, primary_key: Sequence[str], row: dict, diff: int
+) -> tuple[str, Sequence[Any]]:
+    """Upsert/delete keeping only the current snapshot — the ``PsqlSnapshot`` format."""
+    if diff > 0:
+        cols = list(row.keys())
+        placeholders = ", ".join(["%s"] * len(cols))
+        updates = ", ".join(f"{c}=EXCLUDED.{c}" for c in cols if c not in primary_key)
+        sql = (
+            f'INSERT INTO {table_name} ({", ".join(cols)}) VALUES ({placeholders}) '
+            f'ON CONFLICT ({", ".join(primary_key)}) DO UPDATE SET {updates}'
+        )
+        return sql, [_sql_value(v) for v in row.values()]
+    conds = " AND ".join(f"{c}=%s" for c in primary_key)
+    sql = f"DELETE FROM {table_name} WHERE {conds}"
+    return sql, [_sql_value(row[c]) for c in primary_key]
+
+
+def _connect(postgres_settings: dict) -> Any:
+    try:
+        import psycopg2
+
+        return psycopg2.connect(**postgres_settings)
+    except ImportError:
+        pass
+    try:
+        import pg8000.dbapi
+
+        return pg8000.dbapi.connect(**postgres_settings)
+    except ImportError:
+        raise ImportError(
+            "no PostgreSQL driver (psycopg2 / pg8000) is available in this environment"
+        )
+
+
+def write(
+    table: Table,
+    postgres_settings: dict,
+    table_name: str,
+    *,
+    max_batch_size: int | None = None,
+    init_mode: str = "default",
+    **kwargs: Any,
+) -> None:
+    """Stream updates as ``(…, time, diff)`` INSERTs (reference ``io/postgres.write``)."""
+    connection = _connect(postgres_settings)
+    cursor = connection.cursor()
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        sql, params = updates_statement(table_name, row, time, 1 if is_addition else -1)
+        cursor.execute(sql, params)
+        connection.commit()
+
+    G.add_node(
+        pg.OutputNode(inputs=[table], callback=callback, on_end=connection.close)
+    )
+
+
+def write_snapshot(
+    table: Table,
+    postgres_settings: dict,
+    table_name: str,
+    primary_key: Sequence[str],
+    **kwargs: Any,
+) -> None:
+    """Maintain the current snapshot via upserts/deletes (reference ``write_snapshot``)."""
+    connection = _connect(postgres_settings)
+    cursor = connection.cursor()
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        sql, params = snapshot_statement(table_name, primary_key, row, 1 if is_addition else -1)
+        cursor.execute(sql, params)
+        connection.commit()
+
+    G.add_node(
+        pg.OutputNode(inputs=[table], callback=callback, on_end=connection.close)
+    )

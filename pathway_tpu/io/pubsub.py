@@ -1,0 +1,31 @@
+"""Google Pub/Sub sink (parity: reference ``io/pubsub`` — pure-Python publisher).
+Requires google-cloud-pubsub; degrades with a clear error."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def write(table: Table, publisher: Any, project_id: str, topic_id: str, **kwargs: Any) -> None:
+    if publisher is None:
+        try:
+            from google.cloud import pubsub_v1
+
+            publisher = pubsub_v1.PublisherClient()
+        except ImportError:
+            raise ImportError("google-cloud-pubsub is not available in this environment")
+    topic_path = publisher.topic_path(project_id, topic_id)
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        import json
+
+        from pathway_tpu.io.elasticsearch import _plain_row
+
+        data = json.dumps({**_plain_row(row), "time": time, "diff": 1 if is_addition else -1})
+        publisher.publish(topic_path, data.encode())
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback))

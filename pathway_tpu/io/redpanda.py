@@ -1,0 +1,7 @@
+"""Redpanda connector (parity: reference ``io/redpanda`` — Kafka-protocol compatible)."""
+
+from __future__ import annotations
+
+from pathway_tpu.io.kafka import read, read_from_iterable, write
+
+__all__ = ["read", "write", "read_from_iterable"]

@@ -1,0 +1,19 @@
+"""S3 CSV shortcut (parity: reference ``io/s3_csv``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io import s3 as _s3
+
+
+def read(path: str, *, aws_s3_settings: Any = None, schema: Any = None, mode: str = "streaming", csv_settings: Any = None, **kwargs: Any) -> Any:
+    return _s3.read(
+        path,
+        aws_s3_settings=aws_s3_settings,
+        format="csv",
+        schema=schema,
+        mode=mode,
+        csv_settings=csv_settings,
+        **kwargs,
+    )

@@ -1,0 +1,42 @@
+"""Slack sink (parity: reference ``io/slack`` — ``send_alerts`` posting one message per
+row to a channel via chat.postMessage)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+_API_URL = "https://slack.com/api/chat.postMessage"
+
+
+def send_alerts(
+    alerts: Any,
+    slack_channel_id: str,
+    slack_token: str,
+    *,
+    api_url: str = _API_URL,
+    **kwargs: Any,
+) -> None:
+    """Post each new value of ``alerts`` (a column reference or single-column table)."""
+    import requests
+
+    column = alerts
+    table: Table = column.table if hasattr(column, "table") else alerts
+    name = column.name if hasattr(column, "name") else table.column_names()[0]
+    session = requests.Session()
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        if not is_addition:
+            return
+        response = session.post(
+            api_url,
+            headers={"Authorization": f"Bearer {slack_token}"},
+            json={"channel": slack_channel_id, "text": str(row[name])},
+            timeout=10,
+        )
+        response.raise_for_status()
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=session.close))

@@ -64,6 +64,14 @@ class PersistenceManager:
         self.config = config
         self.root = backend.root
         self._memory = backend.kind in ("memory", "mock") or self.root is None
+        if not self._memory:
+            from pathway_tpu.internals.config import get_pathway_config
+
+            cfg = get_pathway_config()
+            if cfg.processes > 1:
+                # spawned replicas each own a journal shard; a shared file would
+                # interleave frames from different processes into garbage
+                self.root = os.path.join(str(self.root), f"process-{cfg.process_id}")
         self._mem_journal: io.BytesIO = io.BytesIO()
         self._journal_file: Any = None
         # byte offset of the last complete frame, set by load_journal; open_for_append
