@@ -66,11 +66,22 @@ from pathway_tpu.internals.udfs import (
 )
 from pathway_tpu.internals.monitoring import MonitoringLevel
 from pathway_tpu.internals.iterate import iterate, iteration_limit
+from pathway_tpu.internals.row_transformer import (
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
+
+from pathway_tpu.internals.interactive import LiveTable, enable_interactive_mode
 
 # namespaces
 from pathway_tpu import debug, demo, io
 from pathway_tpu import persistence
-from pathway_tpu.stdlib import graphs, indexing, ml, ordered, statistical, stateful, temporal, utils as _stdlib_utils
+from pathway_tpu.stdlib import graphs, indexing, ml, ordered, statistical, stateful, temporal, viz, utils as _stdlib_utils
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer
 from pathway_tpu.internals.sql import sql

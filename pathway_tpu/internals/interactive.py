@@ -1,0 +1,94 @@
+"""Interactive (notebook) mode — ``pw.enable_interactive_mode`` + ``LiveTable``.
+
+Parity: reference ``internals/interactive.py`` — a live-updating table view backed by a
+background run thread; printing a ``LiveTable`` shows the current snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_interactive_enabled = False
+
+
+def is_interactive_mode_enabled() -> bool:
+    return _interactive_enabled
+
+
+def enable_interactive_mode() -> None:
+    """Switch the session into interactive mode: ``Table.live()`` becomes available and
+    runs the dataflow on a background thread, keeping live snapshots updated."""
+    global _interactive_enabled
+    _interactive_enabled = True
+    from pathway_tpu.internals.table import Table
+
+    if not hasattr(Table, "live"):
+        Table.live = _table_live  # type: ignore[attr-defined]
+
+
+class LiveTable:
+    """A self-updating snapshot of a table (reference ``LiveTable``)."""
+
+    def __init__(self, table: Any):
+        self._table = table
+        self._rows: Dict[Any, dict] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._failed: Optional[BaseException] = None
+        self._start()
+
+    def _start(self) -> None:
+        from pathway_tpu.engine.runner import GraphRunner
+        from pathway_tpu.internals.parse_graph import G
+        from pathway_tpu.io import subscribe
+
+        def on_change(key: Any, row: dict, time: int, is_addition: bool) -> None:
+            with self._lock:
+                if is_addition:
+                    self._rows[key] = row
+                else:
+                    self._rows.pop(key, None)
+
+        subscribe(self._table, on_change)
+        graph = G._current
+
+        def run() -> None:
+            try:
+                GraphRunner(graph).run()
+            except BaseException as exc:  # surfaced via .failed
+                self._failed = exc
+
+        self._thread = threading.Thread(target=run, daemon=True, name="pathway:live-table")
+        self._thread.start()
+
+    @property
+    def failed(self) -> bool:
+        return self._failed is not None
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(row) for row in self._rows.values()]
+
+    def to_pandas(self) -> Any:
+        import pandas as pd
+
+        return pd.DataFrame(self.snapshot())
+
+    def __str__(self) -> str:
+        rows = self.snapshot()
+        if not rows:
+            return "<LiveTable: empty>"
+        names = list(rows[0])
+        header = " | ".join(names)
+        body = "\n".join(" | ".join(str(r[n]) for n in names) for r in rows)
+        return f"{header}\n{body}"
+
+    def _repr_pretty_(self, p: Any, cycle: bool) -> None:
+        p.text(str(self))
+
+
+def _table_live(self: Any) -> LiveTable:
+    if not _interactive_enabled:
+        raise RuntimeError("call pw.enable_interactive_mode() first")
+    return LiveTable(self)

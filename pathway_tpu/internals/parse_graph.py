@@ -148,6 +148,14 @@ class StatefulReduceNode(Node):
     kind = "stateful_reduce"
 
 
+class RowTransformerNode(Node):
+    kind = "row_transformer"
+
+
+class RowTransformerResultNode(Node):
+    kind = "row_transformer_result"
+
+
 class TimedSourceClock:
     """Serializes debug ``_TimedSource`` streams onto one global clock.
 

@@ -1,0 +1,22 @@
+"""Shared sink helpers (parity: reference ``io/_utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def plain_row(row: dict) -> dict:
+    """Engine values → JSON-friendly plain Python values (one rule set for all sinks)."""
+    from pathway_tpu.internals.json import Json
+
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, Json):
+            out[k] = v.value
+        elif hasattr(v, "item"):
+            out[k] = v.item()
+        elif type(v).__name__ == "Pointer":
+            out[k] = repr(v)
+        else:
+            out[k] = v
+    return out

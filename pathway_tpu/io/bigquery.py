@@ -28,14 +28,26 @@ def write(
     else:
         client = bigquery.Client()
     target = f"{client.project}.{dataset_name}.{table_name}"
+    batch: list[dict] = []
+    batch_size = int(kwargs.get("max_batch_size") or 500)
 
-    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
-        from pathway_tpu.io.elasticsearch import _plain_row
+    from pathway_tpu.io._utils import plain_row
 
-        errors = client.insert_rows_json(
-            target, [{**_plain_row(row), "time": time, "diff": 1 if is_addition else -1}]
-        )
+    def flush() -> None:
+        if not batch:
+            return
+        rows, batch[:] = list(batch), []
+        errors = client.insert_rows_json(target, rows)
         if errors:
             raise RuntimeError(f"BigQuery insert failed: {errors}")
 
-    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=client.close))
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        batch.append({**plain_row(row), "time": time, "diff": 1 if is_addition else -1})
+        if len(batch) >= batch_size:
+            flush()
+
+    def close() -> None:
+        flush()
+        client.close()
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=close))

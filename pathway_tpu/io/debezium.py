@@ -83,14 +83,20 @@ def read(
             "pw.io.debezium.read_from_iterable(...) to feed envelopes from your own "
             "consumer"
         )
+    if topic_name is None:
+        raise ValueError("pw.io.debezium.read requires topic_name")
 
     def consume() -> Iterable[bytes]:
         consumer = confluent_kafka.Consumer(rdkafka_settings)
         consumer.subscribe([topic_name])
         while True:
             msg = consumer.poll(1.0)
-            if msg is None or msg.error():
+            if msg is None:
                 continue
+            if msg.error():
+                if msg.error().code() == confluent_kafka.KafkaError._PARTITION_EOF:
+                    continue
+                raise RuntimeError(f"kafka consumer error: {msg.error()}")
             yield msg.value()
 
     return read_from_iterable(

@@ -1,28 +1,107 @@
-"""Delta Lake connector (parity: reference ``io/deltalake`` over
-``data_storage.rs:1924,1621``). Requires the deltalake package; degrades with a clear
-error pointing at the fs/csv surface."""
+"""Delta Lake connector.
+
+Parity: reference ``io/deltalake`` over ``data_storage.rs:1924`` (reader) and ``:1621``
+(writer). Implemented against the ``deltalake`` Python package (absent from this image —
+the code paths are exercised only where the package is installed): the reader polls table
+versions and emits row-level diffs between snapshots; the writer appends update batches
+with ``time``/``diff`` columns.
+"""
 
 from __future__ import annotations
 
 from typing import Any
 
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
 
-def _no_client() -> None:
-    raise ImportError(
-        "the deltalake package is not available in this environment; export the table "
-        "to parquet/csv and use pw.io.fs.read, or install deltalake"
+
+def _require() -> Any:
+    try:
+        import deltalake
+
+        return deltalake
+    except ImportError:
+        raise ImportError(
+            "the deltalake package is not available in this environment; export the "
+            "table to parquet/csv and use pw.io.fs.read, or install deltalake"
+        )
+
+
+def read(
+    uri: str,
+    *,
+    schema: sch.SchemaMetaclass,
+    mode: str = "streaming",
+    autocommit_duration_ms: int | None = 1500,
+    refresh_interval_s: float = 5.0,
+    **kwargs: Any,
+) -> Table:
+    deltalake = _require()
+
+    from pathway_tpu.io.python import ConnectorSubject, read as py_read
+
+    names = schema.column_names()
+
+    class _DeltaSubject(ConnectorSubject):
+        def run(self) -> None:
+            import time as _time
+
+            emitted: dict[tuple, int] = {}  # row tuple -> multiplicity
+            last_version = -1
+            while True:
+                table = deltalake.DeltaTable(uri)
+                version = table.version()
+                if version != last_version:
+                    last_version = version
+                    current: dict[tuple, int] = {}
+                    for batch in table.to_pyarrow_dataset().to_batches():
+                        for record in batch.to_pylist():
+                            token = tuple(record.get(n) for n in names)
+                            current[token] = current.get(token, 0) + 1
+                    # diff snapshots: retract vanished rows, add new ones
+                    for token, count in emitted.items():
+                        delta = current.get(token, 0) - count
+                        for _ in range(-delta if delta < 0 else 0):
+                            self._emit(dict(zip(names, token)), diff=-1)
+                    for token, count in current.items():
+                        delta = count - emitted.get(token, 0)
+                        for _ in range(delta if delta > 0 else 0):
+                            self._emit(dict(zip(names, token)))
+                    emitted = current
+                if mode in ("static", "batch"):
+                    break
+                _time.sleep(refresh_interval_s)
+
+    return py_read(
+        _DeltaSubject(), schema=schema, autocommit_duration_ms=autocommit_duration_ms
     )
 
 
-def read(uri: str, *, schema: Any = None, mode: str = "streaming", autocommit_duration_ms: int | None = 1500, **kwargs: Any) -> Any:
-    try:
-        import deltalake  # noqa: F401
-    except ImportError:
-        _no_client()
+def write(
+    table: Table,
+    uri: str,
+    *,
+    min_commit_frequency: int | None = 60_000,
+    **kwargs: Any,
+) -> None:
+    deltalake = _require()
+    import pyarrow as pa
 
+    from pathway_tpu.io._utils import plain_row
 
-def write(table: Any, uri: str, *, min_commit_frequency: int | None = 60_000, **kwargs: Any) -> None:
-    try:
-        import deltalake  # noqa: F401
-    except ImportError:
-        _no_client()
+    batch: list[dict] = []
+
+    def flush() -> None:
+        if not batch:
+            return
+        rows, batch[:] = list(batch), []
+        deltalake.write_deltalake(uri, pa.Table.from_pylist(rows), mode="append")
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        batch.append({**plain_row(row), "time": time, "diff": 1 if is_addition else -1})
+        if len(batch) >= 10_000:
+            flush()
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=flush))

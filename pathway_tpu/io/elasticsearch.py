@@ -14,6 +14,7 @@ from typing import Any
 
 from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._utils import plain_row as _plain_row
 from pathway_tpu.internals.table import Table
 
 
@@ -87,22 +88,6 @@ class _BulkWriter:
     def close(self) -> None:
         with self.lock:
             self._flush_locked()
-
-
-def _plain_row(row: dict) -> dict:
-    from pathway_tpu.internals.json import Json
-
-    out = {}
-    for k, v in row.items():
-        if isinstance(v, Json):
-            out[k] = v.value
-        elif hasattr(v, "item"):
-            out[k] = v.item()
-        elif type(v).__name__ == "Pointer":
-            out[k] = repr(v)
-        else:
-            out[k] = v
-    return out
 
 
 def write(

@@ -43,8 +43,23 @@ def read(
             emitted: dict[str, bytes] = {}
             while True:
                 query = f"'{object_id}' in parents and trashed=false"
-                listing = service.files().list(q=query, fields="files(id,name,version,size)").execute()
-                for f in listing.get("files", []):
+                files: list[dict] = []
+                page_token = None
+                while True:
+                    listing = (
+                        service.files()
+                        .list(
+                            q=query,
+                            fields="nextPageToken, files(id,name,version,size)",
+                            pageToken=page_token,
+                        )
+                        .execute()
+                    )
+                    files.extend(listing.get("files", []))
+                    page_token = listing.get("nextPageToken")
+                    if not page_token:
+                        break
+                for f in files:
                     if object_size_limit and int(f.get("size", 0)) > object_size_limit:
                         continue
                     version = f.get("version", "")

@@ -10,6 +10,7 @@ from typing import Any
 
 from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._utils import plain_row
 from pathway_tpu.internals.table import Table
 
 
@@ -28,9 +29,7 @@ def write(
     timeout = (request_timeout_ms or 10_000) / 1000.0
 
     def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
-        from pathway_tpu.io.elasticsearch import _plain_row
-
-        doc = {**_plain_row(row), "time": time, "diff": 1 if is_addition else -1}
+        doc = {**plain_row(row), "time": time, "diff": 1 if is_addition else -1}
         last_error: Exception | None = None
         for _attempt in range(n_retries + 1):
             try:

@@ -7,6 +7,7 @@ from typing import Any
 
 from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._utils import plain_row
 from pathway_tpu.internals.table import Table
 
 
@@ -20,8 +21,6 @@ def write(table: Table, connection_string: str, database: str, collection: str, 
     coll = client[database][collection]
 
     def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
-        from pathway_tpu.io.elasticsearch import _plain_row
-
-        coll.insert_one({**_plain_row(row), "time": time, "diff": 1 if is_addition else -1})
+        coll.insert_one({**plain_row(row), "time": time, "diff": 1 if is_addition else -1})
 
     G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=client.close))

@@ -1,33 +1,108 @@
-"""NATS connector (parity: reference ``io/nats`` over ``data_storage.rs:2271,2345``).
-Requires nats-py; ``read_from_iterable`` offers the client-free surface."""
+"""NATS connector.
+
+Parity: reference ``io/nats`` over ``data_storage.rs:2271`` (reader) / ``:2345`` (writer).
+Implemented against nats-py (absent from this image — these paths run only where it is
+installed): a background asyncio loop subscribes/publishes; ``read_from_iterable`` offers
+the client-free surface used by tests.
+"""
 
 from __future__ import annotations
 
 import json
+import threading
 from typing import Any, Iterable
 
+from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
 
 
-def _no_client() -> None:
-    raise ImportError(
-        "nats-py is not available in this environment; use "
-        "pw.io.nats.read_from_iterable(...) or pw.io.python.read(...)"
+def _require() -> Any:
+    try:
+        import nats
+
+        return nats
+    except ImportError:
+        raise ImportError(
+            "nats-py is not available in this environment; use "
+            "pw.io.nats.read_from_iterable(...) or pw.io.python.read(...)"
+        )
+
+
+def read(
+    uri: str,
+    topic: str,
+    *,
+    format: str = "json",
+    schema: sch.SchemaMetaclass | None = None,
+    autocommit_duration_ms: int | None = 1500,
+    **kwargs: Any,
+) -> Table:
+    nats = _require()
+
+    from pathway_tpu.io.python import ConnectorSubject, read as py_read
+
+    if schema is None:
+        schema = sch.schema_from_types(data=str)
+    names = schema.column_names()
+
+    class _NatsSubject(ConnectorSubject):
+        def run(self) -> None:
+            import asyncio
+
+            async def main() -> None:
+                client = await nats.connect(uri)
+                subscription = await client.subscribe(topic)
+                async for msg in subscription.messages:
+                    if format == "json":
+                        record = json.loads(msg.data)
+                        self._emit({n: record.get(n) for n in names})
+                    else:
+                        self._emit({"data": msg.data.decode()})
+
+            asyncio.run(main())
+
+    return py_read(
+        _NatsSubject(), schema=schema, autocommit_duration_ms=autocommit_duration_ms
     )
 
 
-def read(uri: str, topic: str, *, format: str = "json", schema: Any = None, **kwargs: Any) -> Any:
-    try:
-        import nats  # noqa: F401
-    except ImportError:
-        _no_client()
+def write(table: Table, uri: str, topic: str, *, format: str = "json", **kwargs: Any) -> None:
+    nats = _require()
+    import asyncio
 
+    from pathway_tpu.io._utils import plain_row
 
-def write(table: Any, uri: str, topic: str, *, format: str = "json", **kwargs: Any) -> None:
-    try:
-        import nats  # noqa: F401
-    except ImportError:
-        _no_client()
+    loop = asyncio.new_event_loop()
+    ready = threading.Event()
+    state: dict = {}
+
+    def loop_runner() -> None:
+        asyncio.set_event_loop(loop)
+
+        async def connect() -> None:
+            state["client"] = await nats.connect(uri)
+            ready.set()
+
+        loop.create_task(connect())
+        loop.run_forever()
+
+    threading.Thread(target=loop_runner, daemon=True, name="pathway:nats").start()
+
+    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+        ready.wait(timeout=30)
+        doc = json.dumps({**plain_row(row), "time": time, "diff": 1 if is_addition else -1})
+        asyncio.run_coroutine_threadsafe(
+            state["client"].publish(topic, doc.encode()), loop
+        ).result(timeout=30)
+
+    def close() -> None:
+        if "client" in state:
+            asyncio.run_coroutine_threadsafe(state["client"].drain(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=close))
 
 
 def read_from_iterable(

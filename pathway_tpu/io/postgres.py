@@ -71,6 +71,37 @@ def _connect(postgres_settings: dict) -> Any:
         )
 
 
+_PG_TYPES = {
+    "INT": "BIGINT",
+    "FLOAT": "DOUBLE PRECISION",
+    "BOOL": "BOOLEAN",
+    "STR": "TEXT",
+    "JSON": "JSONB",
+    "DATE_TIME_NAIVE": "TIMESTAMP",
+    "DATE_TIME_UTC": "TIMESTAMPTZ",
+}
+
+
+def create_table_statement(table: Table, table_name: str, *, extra_columns: Sequence[str] = ()) -> str:
+    cols = []
+    for name, column in table.schema.columns().items():
+        base = column.dtype.strip_optional()
+        cols.append(f"{name} {_PG_TYPES.get(repr(base).upper(), 'TEXT')}")
+    cols.extend(extra_columns)
+    return f'CREATE TABLE IF NOT EXISTS {table_name} ({", ".join(cols)})'
+
+
+def _apply_init_mode(connection: Any, cursor: Any, table: Table, table_name: str, init_mode: str, extra: Sequence[str]) -> None:
+    if init_mode == "default":
+        return
+    if init_mode not in ("create_if_not_exists", "replace"):
+        raise ValueError(f"unsupported init_mode {init_mode!r}")
+    if init_mode == "replace":
+        cursor.execute(f"DROP TABLE IF EXISTS {table_name}")
+    cursor.execute(create_table_statement(table, table_name, extra_columns=extra))
+    connection.commit()
+
+
 def write(
     table: Table,
     postgres_settings: dict,
@@ -83,6 +114,9 @@ def write(
     """Stream updates as ``(…, time, diff)`` INSERTs (reference ``io/postgres.write``)."""
     connection = _connect(postgres_settings)
     cursor = connection.cursor()
+    _apply_init_mode(
+        connection, cursor, table, table_name, init_mode, ("time BIGINT", "diff BIGINT")
+    )
 
     def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
         sql, params = updates_statement(table_name, row, time, 1 if is_addition else -1)

@@ -19,13 +19,19 @@ def write(table: Table, publisher: Any, project_id: str, topic_id: str, **kwargs
         except ImportError:
             raise ImportError("google-cloud-pubsub is not available in this environment")
     topic_path = publisher.topic_path(project_id, topic_id)
+    futures: list[Any] = []
 
     def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
         import json
 
-        from pathway_tpu.io.elasticsearch import _plain_row
+        from pathway_tpu.io._utils import plain_row
 
-        data = json.dumps({**_plain_row(row), "time": time, "diff": 1 if is_addition else -1})
-        publisher.publish(topic_path, data.encode())
+        data = json.dumps({**plain_row(row), "time": time, "diff": 1 if is_addition else -1})
+        futures.append(publisher.publish(topic_path, data.encode()))
 
-    G.add_node(pg.OutputNode(inputs=[table], callback=callback))
+    def flush() -> None:
+        # publish() only enqueues into the client's batcher; block until delivered
+        for future in futures:
+            future.result(timeout=60)
+
+    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=flush))

@@ -23,9 +23,14 @@ def send_alerts(
     """Post each new value of ``alerts`` (a column reference or single-column table)."""
     import requests
 
-    column = alerts
-    table: Table = column.table if hasattr(column, "table") else alerts
-    name = column.name if hasattr(column, "name") else table.column_names()[0]
+    from pathway_tpu.internals.expression import ColumnReference
+
+    if isinstance(alerts, ColumnReference):
+        table: Table = alerts.table
+        name = alerts.name
+    else:
+        table = alerts
+        name = table.column_names()[0]
     session = requests.Session()
 
     def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:

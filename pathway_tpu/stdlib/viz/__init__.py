@@ -1,0 +1,90 @@
+"""Live visualization (parity: reference ``stdlib/viz`` — Bokeh/Panel auto-updating
+plots and table widgets). Bokeh/Panel are optional; without them ``plot``/``show``
+degrade with a clear error while ``table_snapshot`` (plain data) always works."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict
+
+from pathway_tpu.internals.table import Table
+
+
+def _require_bokeh() -> None:
+    try:
+        import bokeh  # noqa: F401
+        import panel  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "bokeh/panel are not available in this environment; use "
+            "pw.viz.table_snapshot(table) for the raw updating data"
+        )
+
+
+class _SnapshotCollector:
+    """Subscribes to a table, maintains the current snapshot thread-safely."""
+
+    def __init__(self, table: Table):
+        self.rows: Dict[Any, dict] = {}
+        self.lock = threading.Lock()
+        self.listeners: list[Callable[[list], None]] = []
+        from pathway_tpu.io import subscribe
+
+        def on_change(key: Any, row: dict, time: int, is_addition: bool) -> None:
+            with self.lock:
+                if is_addition:
+                    self.rows[key] = row
+                else:
+                    self.rows.pop(key, None)
+                current = [dict(r) for r in self.rows.values()]
+            for listener in self.listeners:
+                listener(current)
+
+        subscribe(table, on_change)
+
+    def snapshot(self) -> list[dict]:
+        with self.lock:
+            return [dict(r) for r in self.rows.values()]
+
+
+def table_snapshot(table: Table) -> _SnapshotCollector:
+    """A live snapshot collector over ``table`` (works without bokeh/panel)."""
+    return _SnapshotCollector(table)
+
+
+def plot(table: Table, plotting_function: Callable, sorting_col: Any = None) -> Any:
+    """Bokeh plot auto-updating as the table changes (reference ``viz/plotting.py:35``)."""
+    _require_bokeh()
+    from bokeh.models import ColumnDataSource
+    import pandas as pd
+    import panel as pn
+
+    collector = _SnapshotCollector(table)
+    frame = pd.DataFrame(collector.snapshot())
+    source = ColumnDataSource(frame)
+    figure = plotting_function(source)
+
+    def refresh(current: list) -> None:
+        df = pd.DataFrame(current)
+        if sorting_col is not None and sorting_col in df:
+            df = df.sort_values(sorting_col)
+        source.data = dict(ColumnDataSource(df).data)
+
+    collector.listeners.append(refresh)
+    return pn.Column(figure)
+
+
+def show(table: Table, **kwargs: Any) -> Any:
+    """Live table widget (reference ``viz`` ``Table.show``)."""
+    _require_bokeh()
+    import pandas as pd
+    import panel as pn
+
+    collector = _SnapshotCollector(table)
+    widget = pn.widgets.Tabulator(pd.DataFrame(collector.snapshot()), **kwargs)
+
+    def refresh(current: list) -> None:
+        widget.value = pd.DataFrame(current)
+
+    collector.listeners.append(refresh)
+    return widget

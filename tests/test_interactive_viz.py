@@ -1,0 +1,56 @@
+"""Interactive mode + viz snapshot collector tests."""
+
+from __future__ import annotations
+
+import time
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+from tests.utils import T
+
+
+def test_live_table_snapshot():
+    pw.enable_interactive_mode()
+    t = T(
+        """
+        | a
+    1   | 10
+    2   | 20
+    """
+    )
+    live = t.live()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(live.snapshot()) < 2:
+        time.sleep(0.05)
+    assert not live.failed
+    assert sorted(r["a"] for r in live.snapshot()) == [10, 20]
+    assert "a" in str(live)
+
+
+def test_viz_table_snapshot_collector():
+    t = T(
+        """
+        | a
+    1   | 1
+    2   | 2
+    """
+    )
+    collector = pw.viz.table_snapshot(t)
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals.parse_graph import G
+
+    GraphRunner(G._current).run()
+    assert sorted(r["a"] for r in collector.snapshot()) == [1, 2]
+
+
+def test_viz_plot_requires_bokeh():
+    import pytest
+
+    t = T(
+        """
+        | a
+    1   | 1
+    """
+    )
+    with pytest.raises(ImportError):
+        pw.viz.plot(t, lambda source: None)
