@@ -62,4 +62,15 @@ def cpu_virtual_devices(n: int) -> None:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the axon TPU plugin (registered by sitecustomize) grabs the tunnel and overrides
+    # platform selection even under JAX_PLATFORMS=cpu — force CPU and drop its factory
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
