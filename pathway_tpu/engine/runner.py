@@ -333,7 +333,12 @@ class GraphRunner:
                 elif originates:
                     delta = evaluator.drain_neu(inputs)
                 else:
-                    delta = evaluator.process(inputs)
+                    try:
+                        delta = evaluator.process(inputs)
+                    except Exception as exc:
+                        from pathway_tpu.internals.trace import add_error_context
+
+                        raise add_error_context(exc, node) from exc
                 if neu and len(delta):
                     delta.neu = True
             deltas[node.id] = delta
@@ -392,8 +397,11 @@ class GraphRunner:
 
         self.prober_stats = ProberStats()
         self._http_server = maybe_start_http_server(self.prober_stats, with_http_server)
+        from pathway_tpu.engine.telemetry import span
+
         if not self._ready:
-            self.setup(monitoring_level, persistence_config=persistence_config)
+            with span("graph_runner.build", nodes=len(self.graph.nodes)):
+                self.setup(monitoring_level, persistence_config=persistence_config)
         if env_cfg.snapshot_access == "replay" and not env_cfg.continue_after_replay:
             # replay-only run: the journal has been fed through the graph in setup();
             # stop without consuming realtime connector data
@@ -401,15 +409,16 @@ class GraphRunner:
             return
         commits = 0
         try:
-            while True:
-                any_output = self.step()
-                commits += 1
-                if max_commits is not None and commits >= max_commits:
-                    break
-                if self.sources_finished() and not any_output and not self.has_pending():
-                    break
-                if not any_output and not self.sources_finished():
-                    time_mod.sleep(0.001)
+            with span("graph_runner.run"):
+                while True:
+                    any_output = self.step()
+                    commits += 1
+                    if max_commits is not None and commits >= max_commits:
+                        break
+                    if self.sources_finished() and not any_output and not self.has_pending():
+                        break
+                    if not any_output and not self.sources_finished():
+                        time_mod.sleep(0.001)
         finally:
             if max_commits is None:
                 self.finish()

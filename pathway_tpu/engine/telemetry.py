@@ -1,0 +1,36 @@
+"""OpenTelemetry hooks.
+
+Parity: reference ``src/engine/telemetry.rs`` (OTLP traces + metrics around runs) and
+``graph_runner/telemetry.py`` (Python-side spans around graph build/run). Spans go
+through the opentelemetry API; without a configured SDK they are no-ops, and operators
+can attach any exporter by configuring the global tracer provider before ``pw.run``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator
+
+
+def _tracer() -> Any:
+    try:
+        from opentelemetry import trace
+
+        return trace.get_tracer("pathway_tpu")
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes: Any) -> Iterator[None]:
+    tracer = _tracer()
+    if tracer is None:
+        yield
+        return
+    with tracer.start_as_current_span(name) as current:
+        for key, value in attributes.items():
+            try:
+                current.set_attribute(key, value)
+            except Exception:
+                pass
+        yield

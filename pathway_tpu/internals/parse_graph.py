@@ -203,6 +203,11 @@ class ParseGraph:
 
     def add_node(self, node: Node) -> Node:
         node.id = len(self.nodes)
+        from pathway_tpu.internals.trace import capture_user_frame
+
+        # remember the user line that created this operator so runtime errors can
+        # point at pipeline code (reference internals/trace.py)
+        node.user_frame = capture_user_frame()
         self.nodes.append(node)
         return node
 

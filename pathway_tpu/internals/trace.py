@@ -1,0 +1,69 @@
+"""User-frame trace capture for operator errors.
+
+Parity: reference ``internals/trace.py`` — every operator remembers the user code line
+that created it, so an engine error during execution points at the user's pipeline code
+(``EngineErrorWithTrace``), not at framework internals.
+"""
+
+from __future__ import annotations
+
+import traceback
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class Frame:
+    filename: str
+    line_number: int | None
+    line: str | None
+    function: str
+
+    def is_external(self) -> bool:
+        if "tests/test_" in self.filename:
+            return True
+        exclude = ["pathway_tpu/internals", "pathway_tpu/io", "pathway_tpu/stdlib",
+                   "pathway_tpu/debug", "pathway_tpu/engine", "pathway_tpu/xpacks"]
+        return all(pattern not in self.filename for pattern in exclude)
+
+
+def capture_user_frame() -> Optional[Frame]:
+    """The innermost stack frame belonging to user code (not the framework)."""
+    for entry in reversed(traceback.extract_stack()[:-1]):
+        frame = Frame(
+            filename=entry.filename,
+            line_number=entry.lineno,
+            line=entry.line,
+            function=entry.name,
+        )
+        if frame.is_external():
+            return frame
+    return None
+
+
+class EngineErrorWithTrace(Exception):
+    """Engine failure annotated with the user line that defined the failing operator."""
+
+    def __init__(self, cause: BaseException, operator: str, frame: Optional[Frame]):
+        self.cause = cause
+        self.operator = operator
+        self.user_frame = frame
+        location = ""
+        if frame is not None:
+            location = (
+                f"\noccurred in operator {operator!r} defined at "
+                f"{frame.filename}:{frame.line_number}"
+            )
+            if frame.line:
+                location += f"\n    {frame.line.strip()}"
+        else:
+            location = f"\noccurred in operator {operator!r}"
+        super().__init__(f"{type(cause).__name__}: {cause}{location}")
+
+
+def add_error_context(exc: BaseException, node: Any) -> BaseException:
+    """Wrap ``exc`` with the node's creation trace (no-op if already wrapped)."""
+    if isinstance(exc, EngineErrorWithTrace):
+        return exc
+    frame = getattr(node, "user_frame", None)
+    return EngineErrorWithTrace(exc, getattr(node, "name", node.kind), frame)

@@ -1,0 +1,32 @@
+"""Error traces point at user code (reference internals/trace.py semantics)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.runner import GraphRunner
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.trace import EngineErrorWithTrace
+from tests.utils import T
+
+
+def test_runtime_error_carries_user_frame():
+    t = T(
+        """
+        | a
+    1   | 1
+    """
+    )
+
+    def boom(x):
+        raise ValueError("user function exploded")
+
+    bad = t.select(b=pw.apply(boom, t.a))  # <- the user line the trace must cite
+    rows = {}
+    pw.io.subscribe(bad, lambda key, row, time, is_addition: rows.update({key: row}))
+    with pytest.raises(EngineErrorWithTrace) as err:
+        GraphRunner(G._current).run()
+    message = str(err.value)
+    assert "test_trace.py" in message
+    assert "user function exploded" in message or "ValueError" in message
