@@ -77,6 +77,7 @@ from pathway_tpu.internals.row_transformer import (
 )
 
 from pathway_tpu.internals.interactive import LiveTable, enable_interactive_mode
+from pathway_tpu.internals.errors import global_error_log, local_error_log
 
 # namespaces
 from pathway_tpu import debug, demo, io

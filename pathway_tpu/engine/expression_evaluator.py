@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import operator
 from functools import lru_cache
-from typing import Any, Callable, Mapping
+from typing import Any, Callable, Dict, Mapping
 
 import numpy as np
 
@@ -39,6 +39,54 @@ class EvalContext:
         self.n_rows = n_rows
         self.resolver = resolver
         self.keys = keys
+
+
+# Run-scoped UDF error policy, set per thread by the GraphRunner (reference
+# terminate_on_error switch, graph.rs:996): when not terminating, a raising UDF poisons
+# its cell with Error and reports to the error log instead of failing the run.
+# Thread-local: LiveTable background runs and concurrent runners don't interfere.
+import threading as _threading
+
+_runtime_tls = _threading.local()
+
+
+def get_runtime() -> Dict[str, Any]:
+    rt = getattr(_runtime_tls, "rt", None)
+    if rt is None:
+        rt = _runtime_tls.rt = {
+            "terminate_on_error": True,
+            # fallback error sink for operators without a local log (set by the
+            # outermost run; nested iterate runners inherit it)
+            "global_source": None,
+            "node": None,  # the operator Node currently evaluating
+        }
+    return rt
+
+
+def report_udf_error(message: str) -> None:
+    rt = get_runtime()
+    node = rt["node"]
+    source = getattr(node, "error_log_source", None) or rt["global_source"]
+    if source is not None:
+        frame = getattr(node, "user_frame", None)
+        trace = None
+        if frame is not None:
+            trace = {
+                "file": frame.filename,
+                "line": frame.line_number,
+                "function": frame.function,
+            }
+        source.push(node.id if node is not None else -1, message, trace)
+
+
+def _call_udf(fun: Callable, args: list, kwargs: dict) -> Any:
+    if get_runtime()["terminate_on_error"]:
+        return fun(*args, **kwargs)
+    try:
+        return fun(*args, **kwargs)
+    except Exception as exc:
+        report_udf_error(f"{type(exc).__name__}: {exc}")
+        return ERROR
 
 
 def _broadcast_const(value: Any, n: int) -> np.ndarray:
@@ -311,7 +359,7 @@ class ExpressionEvaluator:
             ):
                 out[i] = ERROR
                 continue
-            out[i] = e._fun(*row_args, **row_kwargs)
+            out[i] = _call_udf(e._fun, row_args, row_kwargs)
         return _tidy(out) if e._return_type != dt.ANY else out
 
     def _eval_BatchApplyExpression(self, e: expr.ApplyExpression) -> np.ndarray:
@@ -330,10 +378,13 @@ class ExpressionEvaluator:
         out[poisoned] = ERROR
         for start in range(0, len(clean_idx), max_bs):
             idx = clean_idx[start : start + max_bs]
-            results = e._fun(
-                *[list(a[idx]) for a in args],
-                **{k: list(v[idx]) for k, v in kwargs.items()},
-            )
+            batch_args = [list(a[idx]) for a in args]
+            batch_kwargs = {k: list(v[idx]) for k, v in kwargs.items()}
+            results = _call_udf(e._fun, batch_args, batch_kwargs)
+            if isinstance(results, Error):
+                for i in idx:
+                    out[i] = ERROR
+                continue
             results = list(results)
             if len(results) != len(idx):
                 raise ValueError(
@@ -358,8 +409,15 @@ class ExpressionEvaluator:
 
         results = _run_coro(run_all())
         out = np.empty(self.ctx.n_rows, dtype=object)
+        terminate = get_runtime()["terminate_on_error"]
         for i, r in enumerate(results):
-            out[i] = ERROR if isinstance(r, Exception) else r
+            if isinstance(r, Exception):
+                if terminate:
+                    raise r
+                report_udf_error(f"{type(r).__name__}: {r}")
+                out[i] = ERROR
+            else:
+                out[i] = r
         return _tidy(out)
 
     _eval_FullyAsyncApplyExpression = _eval_AsyncApplyExpression

@@ -293,8 +293,11 @@ class GraphRunner:
             self._output_rows_this_commit = 0
         deltas: Dict[int, Delta] = {}
         any_output = False
+        from pathway_tpu.engine import expression_evaluator as ee_mod
+
         for node in self._nodes:
             evaluator = self.evaluators[node.id]
+            ee_mod.get_runtime()["node"] = node
             if (
                 isinstance(node, pg.OutputNode)
                 and not neu
@@ -407,6 +410,14 @@ class GraphRunner:
             # stop without consuming realtime connector data
             self.finish()
             return
+        from pathway_tpu.engine import expression_evaluator as ee_mod
+
+        runtime = ee_mod.get_runtime()
+        prev_runtime = dict(runtime)
+        runtime["terminate_on_error"] = terminate_on_error
+        # fallback sink for operators with no local log; nested iterate runners run on
+        # this thread and inherit it, while their inner node objects route precisely
+        runtime["global_source"] = getattr(self.graph, "_error_log_source", None)
         commits = 0
         try:
             with span("graph_runner.run"):
@@ -420,6 +431,7 @@ class GraphRunner:
                     if not any_output and not self.sources_finished():
                         time_mod.sleep(0.001)
         finally:
+            runtime.update(prev_runtime)
             if max_commits is None:
                 self.finish()
 

@@ -208,6 +208,9 @@ class ParseGraph:
         # remember the user line that created this operator so runtime errors can
         # point at pipeline code (reference internals/trace.py)
         node.user_frame = capture_user_frame()
+        # operators created inside a local_error_log context report there
+        stack = getattr(self, "_error_log_stack", None)
+        node.error_log_source = stack[-1] if stack else None
         self.nodes.append(node)
         return node
 

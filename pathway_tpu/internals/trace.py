@@ -7,7 +7,7 @@ that created it, so an engine error during execution points at the user's pipeli
 
 from __future__ import annotations
 
-import traceback
+
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -20,24 +20,38 @@ class Frame:
     function: str
 
     def is_external(self) -> bool:
-        if "tests/test_" in self.filename:
-            return True
-        exclude = ["pathway_tpu/internals", "pathway_tpu/io", "pathway_tpu/stdlib",
-                   "pathway_tpu/debug", "pathway_tpu/engine", "pathway_tpu/xpacks"]
-        return all(pattern not in self.filename for pattern in exclude)
+        return _is_external_path(self.filename)
+
+
+def _is_external_path(filename: str) -> bool:
+    normalized = filename.replace("\\", "/")  # windows tracebacks
+    if "tests/test_" in normalized:
+        return True
+    exclude = ["pathway_tpu/internals", "pathway_tpu/io", "pathway_tpu/stdlib",
+               "pathway_tpu/debug", "pathway_tpu/engine", "pathway_tpu/xpacks"]
+    return all(pattern not in normalized for pattern in exclude)
 
 
 def capture_user_frame() -> Optional[Frame]:
-    """The innermost stack frame belonging to user code (not the framework)."""
-    for entry in reversed(traceback.extract_stack()[:-1]):
-        frame = Frame(
-            filename=entry.filename,
-            line_number=entry.lineno,
-            line=entry.line,
-            function=entry.name,
-        )
-        if frame.is_external():
-            return frame
+    """The innermost stack frame belonging to user code (not the framework).
+
+    Walks raw frames (cheap) and reads source for the single matched frame only —
+    this runs on every operator creation."""
+    import linecache
+    import sys
+
+    frame = sys._getframe(1)
+    while frame is not None:
+        filename = frame.f_code.co_filename
+        if _is_external_path(filename):
+            lineno = frame.f_lineno
+            return Frame(
+                filename=filename,
+                line_number=lineno,
+                line=linecache.getline(filename, lineno).rstrip() or None,
+                function=frame.f_code.co_name,
+            )
+        frame = frame.f_back
     return None
 
 

@@ -1,0 +1,86 @@
+"""terminate_on_error + error-log tables (reference internals/errors.py, graph.rs:996)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.columnar import Error
+from pathway_tpu.engine.runner import GraphRunner
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.trace import EngineErrorWithTrace
+from tests.utils import T
+
+
+def _collect(table):
+    rows = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[key] = row
+        else:
+            rows.pop(key, None)
+
+    pw.io.subscribe(table, on_change)
+    return rows
+
+
+def test_terminate_on_error_true_raises_with_trace():
+    t = T(
+        """
+        | a
+    1   | 1
+    """
+    )
+    bad = t.select(b=pw.apply(lambda x: 1 / 0, t.a))
+    _collect(bad)
+    with pytest.raises(EngineErrorWithTrace):
+        GraphRunner(G._current).run(terminate_on_error=True)
+
+
+def test_terminate_on_error_false_poisons_and_logs():
+    t = T(
+        """
+        | a
+    1   | 1
+    2   | 2
+    """
+    )
+
+    def sometimes(x):
+        if x == 1:
+            raise ValueError("bad row")
+        return x * 10
+
+    out = t.select(b=pw.apply(sometimes, t.a))
+    log = pw.global_error_log()
+    out_rows = _collect(out)
+    log_rows = _collect(log)
+    GraphRunner(G._current).run(terminate_on_error=False)
+    values = sorted(
+        (
+            (int(row["b"]) if not isinstance(row["b"], Error) else "ERR")
+            for row in out_rows.values()
+        ),
+        key=str,
+    )
+    assert values == [20, "ERR"]
+    messages = [row["message"] for row in log_rows.values()]
+    assert messages == ["ValueError: bad row"]
+    assert all(isinstance(row["operator_id"], int) for row in log_rows.values())
+
+
+def test_local_error_log_scopes_operators():
+    t = T(
+        """
+        | a
+    1   | 1
+    """
+    )
+    with pw.local_error_log() as log:
+        bad = t.select(b=pw.apply(lambda x: 1 / 0, t.a))
+    log_rows = _collect(log)
+    _collect(bad)
+    GraphRunner(G._current).run(terminate_on_error=False)
+    assert len(log_rows) == 1
+    assert "ZeroDivisionError" in next(iter(log_rows.values()))["message"]
