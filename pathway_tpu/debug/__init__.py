@@ -317,7 +317,7 @@ def table_from_pandas(
     return Table(node, schema_cls, name="pandas")
 
 
-def _capture_table(table: Table) -> Dict[bytes, dict]:
+def _capture_table(table: Table, *, terminate_on_error: bool = True) -> Dict[bytes, dict]:
     """Run the graph and return the table's final rows keyed by key bytes."""
     from pathway_tpu.internals.keys import pointers_to_keys
 
@@ -331,18 +331,18 @@ def _capture_table(table: Table) -> Dict[bytes, dict]:
             captured.pop(kb, None)
 
     G.add_node(pg.OutputNode(inputs=[table], callback=on_change))
-    GraphRunner(G).run()
+    GraphRunner(G).run(terminate_on_error=terminate_on_error)
     return captured
 
 
-def _capture_update_stream(table: Table) -> List[dict]:
+def _capture_update_stream(table: Table, *, terminate_on_error: bool = True) -> List[dict]:
     updates: List[dict] = []
 
     def on_change(key: Pointer, row: dict, time: int, is_addition: bool) -> None:
         updates.append({"__key__": key, "__time__": time, "__diff__": 1 if is_addition else -1, **row})
 
     G.add_node(pg.OutputNode(inputs=[table], callback=on_change))
-    GraphRunner(G).run()
+    GraphRunner(G).run(terminate_on_error=terminate_on_error)
     return updates
 
 
@@ -366,7 +366,7 @@ def compute_and_print(
     squash_updates: bool = True,
     terminate_on_error: bool = True,
 ) -> None:
-    captured = _capture_table(table)
+    captured = _capture_table(table, terminate_on_error=terminate_on_error)
     names = table.column_names()
     rows = sorted(captured.values(), key=lambda r: r["__key__"])
     if n_rows is not None:
@@ -390,7 +390,7 @@ def compute_and_print_update_stream(
     n_rows: int | None = None,
     terminate_on_error: bool = True,
 ) -> None:
-    updates = _capture_update_stream(table)
+    updates = _capture_update_stream(table, terminate_on_error=terminate_on_error)
     names = table.column_names() + ["__time__", "__diff__"]
     if n_rows is not None:
         updates = updates[:n_rows]
