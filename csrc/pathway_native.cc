@@ -65,6 +65,8 @@ extern "C" {
 //   4 = utf8     (data: char buffer, offsets: uint64_t[n+1])
 //   5 = pyobject (data: PyObject** — a numpy object column's backing array;
 //                 caller must hold the GIL, i.e. load via ctypes.PyDLL)
+//   6 = key128   (data: uint64_t pairs [hi,lo] little-endian, i.e. the raw bytes of
+//                 a KEY_DTYPE structured column — serialized as a Pointer value)
 // A column's mask (optional, uint8_t*) marks rows as present (1) or None (0).
 struct PwCol {
   int32_t kind;
@@ -186,6 +188,11 @@ int64_t pwtpu_hash_typed(const PwCol* cols, int32_t ncols, uint64_t n,
           }
           break;
         }
+        case 6:
+          // Pointer tag + raw hi/lo (already little-endian in a KEY_DTYPE column)
+          buf.push_back('\x01');
+          buf.append(static_cast<const char*>(col.data) + 16 * i, 16);
+          break;
         default:
           return static_cast<int64_t>(i);
       }

@@ -22,6 +22,7 @@ from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals.keys import (
     KEY_DTYPE,
     Pointer,
+    keys_from_values,
     keys_to_pointers,
     pointer_from,
     pointers_to_keys,
@@ -79,7 +80,13 @@ class Evaluator:
     # -- helpers ------------------------------------------------------------
 
     def _resolver_for(self, table: Any, delta: Delta) -> Callable[[expr.ColumnReference], np.ndarray]:
-        """Resolve column refs against a delta of ``table``; cross-table refs hit state."""
+        """Resolve column refs against a delta of ``table``; cross-table refs hit state.
+
+        Retraction rows resolve cross-table refs against the *retracted* values: when the
+        referenced table replaced a key this commit (a -1/+1 pair on the same key), the
+        materialized state already holds the new value, but a retraction must carry what
+        was originally emitted (DD value-matched semantics — ``dataflow.rs`` joins match
+        on values, not on current state)."""
 
         def resolver(ref: expr.ColumnReference) -> np.ndarray:
             if ref.table is table:
@@ -94,9 +101,24 @@ class Evaluator:
                 out = np.empty(len(delta), dtype=object)
                 out[:] = keys_to_pointers(delta.keys)
                 return out
+            retracted: Dict[bytes, Any] | None = None
+            if np.any(delta.diffs < 0):
+                ref_delta = self.runner.current_delta_of(ref.table._node)
+                if ref_delta is not None and len(ref_delta):
+                    neg = np.nonzero(ref_delta.diffs < 0)[0]
+                    if len(neg):
+                        col = ref_delta.columns.get(ref.name)
+                        if col is not None:
+                            retracted = {
+                                ref_delta.keys[i].tobytes(): col[i] for i in neg
+                            }
             out = np.empty(len(delta), dtype=object)
             for i in range(len(delta)):
-                row = state.get_row(delta.keys[i].tobytes())
+                kb = delta.keys[i].tobytes()
+                if retracted is not None and delta.diffs[i] < 0 and kb in retracted:
+                    out[i] = retracted[kb]
+                    continue
+                row = state.get_row(kb)
                 # a same-universe reference must hit: a miss means the tables' key sets
                 # genuinely differ (e.g. select over a reindexed table referencing the
                 # pre-reindex table) — poison instead of silently yielding None
@@ -185,8 +207,33 @@ class ConcatEvaluator(Evaluator):
         return Delta.concat(parts, self.output_columns)
 
 
+def _rows_equal(a: Optional[dict], b: Optional[dict]) -> bool:
+    if a is None or b is None:
+        return a is b
+    if a.keys() != b.keys():
+        return False
+    for k, va in a.items():
+        vb = b[k]
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            if not (
+                isinstance(va, np.ndarray)
+                and isinstance(vb, np.ndarray)
+                and np.array_equal(va, vb)
+            ):
+                return False
+        elif not (va is vb or va == vb):
+            return False
+    return True
+
+
 class GroupbyEvaluator(Evaluator):
-    """Incremental groupby-reduce (reference ``reduce.rs`` + DD reduce)."""
+    """Incremental groupby-reduce (reference ``reduce.rs`` + DD reduce).
+
+    The whole commit batch is processed columnar: group keys derive from one vectorized
+    hash (``keys_from_values``, native xxh3), rows map to dense segment ids via
+    ``np.unique``, semigroup reducers (count/sum/avg) update through segment kernels
+    (``pathway_tpu.ops.segment``), multiset reducers batch through ``Counter.update``,
+    and output expressions evaluate once over all touched groups."""
 
     # reducer_leaves is graph config: checkpoints must not replace it — identity (id())
     # keys the leaf-value mapping
@@ -215,6 +262,62 @@ class GroupbyEvaluator(Evaluator):
         for e in out_exprs.values():
             walk(e)
 
+    def _rows_for_groups(self, groups: List[Dict[str, Any]]) -> List[dict]:
+        """Output rows for the given groups: the out-expression tree evaluated once,
+        vectorized over all groups, with reducer leaves bound to accumulator values."""
+        if not groups:
+            return []
+        leaf_value_arrays: Dict[int, np.ndarray] = {}
+        for li, leaf in enumerate(self.reducer_leaves):
+            leaf_value_arrays[id(leaf)] = objarray(
+                [g["accs"][li].value() for g in groups]
+            )
+        grouping_names = self.node.config["grouping_names"]
+        gval_arrays = {
+            name: objarray([g["gvals"][gi] for g in groups])
+            for gi, name in enumerate(grouping_names)
+        }
+
+        class _GroupEval(ee.ExpressionEvaluator):
+            def _eval_ReducerExpression(self, re: expr.ReducerExpression) -> np.ndarray:
+                return leaf_value_arrays[id(re)]
+
+            def _eval_ColumnReference(self, ref: expr.ColumnReference) -> np.ndarray:
+                return gval_arrays[ref.name]
+
+        evaluator = _GroupEval(ee.EvalContext(len(groups), lambda ref: None))
+        out_cols = {
+            name: evaluator.eval(e) for name, e in self.node.config["out_exprs"].items()
+        }
+        return [
+            {name: out_cols[name][a] for name in out_cols} for a in range(len(groups))
+        ]
+
+    def load_state_dict(self, state: Dict[str, bytes]) -> None:
+        super().load_state_dict(state)
+        # checkpoints from builds predating the last-emitted-row cache lack "row"
+        missing = [g for g in self.groups.values() if "row" not in g]
+        for g, row in zip(missing, self._rows_for_groups(missing)):
+            g["row"] = row
+
+    def _group_keys(self, grouping_vals: List[np.ndarray], n: int, set_id: bool) -> np.ndarray:
+        if not grouping_vals:
+            # global reduce: every row lands in the single salt-only group
+            p = pointer_from()
+            out = np.empty(n, dtype=KEY_DTYPE)
+            out["hi"], out["lo"] = p.hi, p.lo
+            return out
+        if not set_id:
+            return keys_from_values(grouping_vals)
+        col = grouping_vals[0]
+        out = np.empty(n, dtype=KEY_DTYPE)
+        for i in range(n):
+            p = col[i]
+            if not isinstance(p, Pointer):
+                p = pointer_from(*(g[i] for g in grouping_vals))
+            out[i]["hi"], out[i]["lo"] = p.hi, p.lo
+        return out
+
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
         if len(delta) == 0:
@@ -222,13 +325,14 @@ class GroupbyEvaluator(Evaluator):
         table = self.node.inputs[0]
         resolver = self._resolver_for(table, delta)
         n = len(delta)
+        diffs = delta.diffs
 
         grouping_vals = [
             ee.evaluate(g, n, resolver) for g in self.node.config["grouping"]
         ]
         set_id = self.node.config.get("set_id", False)
 
-        # reducer argument values per leaf
+        # reducer argument values per leaf (vectorized)
         leaf_args: List[List[np.ndarray]] = []
         for leaf in self.reducer_leaves:
             arrays = []
@@ -245,101 +349,99 @@ class GroupbyEvaluator(Evaluator):
             leaf_args.append(arrays)
         self.seq += n
 
-        # group keys
-        group_keys: List[Pointer] = []
-        for i in range(n):
-            gvals = tuple(g[i] for g in grouping_vals)
-            if set_id:
-                gk = gvals[0] if isinstance(gvals[0], Pointer) else pointer_from(*gvals)
-            else:
-                gk = pointer_from(*gvals)
-            group_keys.append(gk)
+        # dense segment ids per row
+        gkeys = self._group_keys(grouping_vals, n, set_id)
+        uniq, first_idx, inverse = np.unique(
+            gkeys, return_index=True, return_inverse=True
+        )
+        m = len(uniq)
+        uniq_kb = [uniq[j].tobytes() for j in range(m)]
 
-        touched: Dict[bytes, Pointer] = {}
-        old_rows: Dict[bytes, Optional[dict]] = {}
-
-        for i in range(n):
-            gk = group_keys[i]
-            gb = pointers_to_keys([gk]).tobytes()
-            if gb not in touched:
-                touched[gb] = gk
-                old_rows[gb] = self._current_row(gb)
-            group = self.groups.get(gb)
+        # ensure groups exist; snapshot last-emitted rows
+        touched: List[Dict[str, Any]] = []
+        for j in range(m):
+            group = self.groups.get(uniq_kb[j])
             if group is None:
+                i0 = int(first_idx[j])
                 group = {
                     "count": 0,
-                    "gvals": tuple(g[i] for g in grouping_vals),
+                    "gvals": tuple(g[i0] for g in grouping_vals),
                     "accs": [leaf._reducer.make() for leaf in self.reducer_leaves],
+                    "row": None,
                 }
-                self.groups[gb] = group
-            diff = int(delta.diffs[i])
-            vals_per_leaf = [tuple(arr[i] for arr in arrays) for arrays in leaf_args]
-            if diff > 0:
-                group["count"] += 1
-                for acc, vals in zip(group["accs"], vals_per_leaf):
-                    acc.insert(vals)
-            else:
-                group["count"] -= 1
-                for acc, vals in zip(group["accs"], vals_per_leaf):
-                    acc.retract(vals)
-            if group["count"] == 0:
-                del self.groups[gb]
+                self.groups[uniq_kb[j]] = group
+            touched.append(group)
+        old_rows = [g.get("row") for g in touched]
 
-        # emit output deltas for touched groups
-        out_keys: List[Pointer] = []
+        # apply the batch to every accumulator
+        from pathway_tpu.ops.segment import segment_count, segment_slices
+
+        cnt_delta = segment_count(inverse, m, weights=diffs)
+        slices = None
+        for li, (leaf, arrays) in enumerate(zip(self.reducer_leaves, leaf_args)):
+            accs = [g["accs"][li] for g in touched]
+            if leaf._reducer.batch_update(accs, arrays, diffs, inverse, m, cnt_delta):
+                continue
+            if slices is None:
+                slices = segment_slices(inverse, m)
+            order, starts, ends = slices
+            any_retract = bool(np.any(diffs < 0))
+            for j, acc in enumerate(accs):
+                rows = order[starts[j] : ends[j]]
+                if len(rows) == 0:
+                    continue
+                if not any_retract:
+                    acc.insert_many(zip(*(arr[rows] for arr in arrays)))
+                else:
+                    # mixed commit: preserve original row order (retract/insert interleave)
+                    for i in rows:
+                        vals = tuple(arr[i] for arr in arrays)
+                        if diffs[i] > 0:
+                            acc.insert(vals)
+                        else:
+                            acc.retract(vals)
+
+        alive: List[int] = []
+        for j, g in enumerate(touched):
+            g["count"] += int(cnt_delta[j])
+            if g["count"] == 0:
+                del self.groups[uniq_kb[j]]
+            else:
+                alive.append(j)
+
+        # new output rows for alive groups — one vectorized expression pass
+        new_rows: List[Optional[dict]] = [None] * m
+        for a, row in zip(alive, self._rows_for_groups([touched[j] for j in alive])):
+            new_rows[a] = row
+
+        # emit (retract old, insert new) for changed groups
+        out_keys: List[np.void] = []
         out_diffs: List[int] = []
         out_rows: List[dict] = []
-        for gb, gk in touched.items():
-            old = old_rows[gb]
-            new = self._current_row(gb)
-            if old == new:
+        for j in range(m):
+            old, new = old_rows[j], new_rows[j]
+            if _rows_equal(old, new):
                 continue
             if old is not None:
-                out_keys.append(gk)
+                out_keys.append(uniq[j])
                 out_diffs.append(-1)
                 out_rows.append(old)
             if new is not None:
-                out_keys.append(gk)
+                out_keys.append(uniq[j])
                 out_diffs.append(1)
                 out_rows.append(new)
+            if uniq_kb[j] in self.groups:
+                self.groups[uniq_kb[j]]["row"] = new
         if not out_keys:
             return Delta.empty(self.output_columns)
+        keys_arr = np.empty(len(out_keys), dtype=KEY_DTYPE)
+        for i, k in enumerate(out_keys):
+            keys_arr[i] = k
         columns = {
             name: ee._tidy(objarray([r[name] for r in out_rows]))
             for name in self.output_columns
         }
-        return Delta(pointers_to_keys(out_keys), np.array(out_diffs, dtype=np.int64), columns)
-
-    def _current_row(self, gb: bytes) -> Optional[dict]:
-        group = self.groups.get(gb)
-        if group is None:
-            return None
-        leaf_values = {id(leaf): acc.value() for leaf, acc in zip(self.reducer_leaves, group["accs"])}
-        grouping_names = self.node.config["grouping_names"]
-        gval_map = dict(zip(grouping_names, group["gvals"]))
-
-        out = {}
-        for name, e in self.node.config["out_exprs"].items():
-            out[name] = self._eval_out_expr(e, leaf_values, gval_map)
-        return out
-
-    def _eval_out_expr(
-        self, e: expr.ColumnExpression, leaf_values: Dict[int, Any], gval_map: Dict[str, Any]
-    ) -> Any:
-        class _GroupEval(ee.ExpressionEvaluator):
-            def _eval_ReducerExpression(self, re: expr.ReducerExpression) -> np.ndarray:
-                out = np.empty(1, dtype=object)
-                out[0] = leaf_values[id(re)]
-                return out
-
-            def _eval_ColumnReference(self, ref: expr.ColumnReference) -> np.ndarray:
-                out = np.empty(1, dtype=object)
-                out[0] = gval_map[ref.name]
-                return out
-
-        ctx = ee.EvalContext(1, lambda ref: None)
-        result = _GroupEval(ctx).eval(e)
-        return result[0]
+        return Delta(keys_arr, np.array(out_diffs, dtype=np.int64), columns)
 
 
 class DeduplicateEvaluator(Evaluator):

@@ -40,9 +40,15 @@ class GraphRunner:
         self._output_rows_this_commit = 0
         self._http_server: Any = None
         self.replay_outputs = True
+        self._substep_deltas: Dict[int, Delta] = {}
 
     def state_of(self, node: pg.Node) -> StateTable:
         return self.states[node.id]
+
+    def current_delta_of(self, node: pg.Node) -> Optional[Delta]:
+        """The delta ``node`` emitted in the current substep (None before it ran).
+        Lets evaluators resolve retraction rows against retracted upstream values."""
+        return self._substep_deltas.get(node.id)
 
     def setup(self, monitoring_level: Any = None, persistence_config: Any = None) -> None:
         from pathway_tpu.engine.evaluators import EVALUATORS
@@ -292,6 +298,7 @@ class GraphRunner:
             self._step_counts = {}
             self._output_rows_this_commit = 0
         deltas: Dict[int, Delta] = {}
+        self._substep_deltas = deltas
         any_output = False
         from pathway_tpu.engine import expression_evaluator as ee_mod
 

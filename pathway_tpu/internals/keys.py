@@ -82,6 +82,13 @@ def _serialize_value(value: Any, out: list[bytes]) -> None:
         out.append(b"\x07" + len(value).to_bytes(8, "little"))
         for item in value:
             _serialize_value(item, out)
+    elif isinstance(value, np.void) and value.dtype == KEY_DTYPE:
+        # a raw KEY_DTYPE cell serializes exactly like the Pointer it denotes
+        out.append(
+            b"\x01"
+            + int(value["hi"]).to_bytes(8, "little")
+            + int(value["lo"]).to_bytes(8, "little")
+        )
     elif isinstance(value, np.ndarray):
         out.append(b"\x08" + str(value.dtype).encode() + str(value.shape).encode() + value.tobytes())
     else:
@@ -108,9 +115,11 @@ def _classify_column(col: np.ndarray):
     """Describe a column for the native hasher; None for unsupported array dtypes.
 
     Returns (kind, data_array) with the array kept alive by the caller. Kinds mirror
-    ``csrc/pathway_native.cc``: 1=int64 2=float64 3=bool 5=pyobject. Object columns go
-    straight to the pyobject kind — type dispatch happens natively per value.
+    ``csrc/pathway_native.cc``: 1=int64 2=float64 3=bool 5=pyobject 6=key128. Object
+    columns go straight to the pyobject kind — type dispatch happens natively per value.
     """
+    if col.dtype == KEY_DTYPE:
+        return (6, np.ascontiguousarray(col))
     if col.dtype == object:
         return (5, np.ascontiguousarray(col))
     if col.dtype == np.bool_:
@@ -126,7 +135,11 @@ def _classify_column(col: np.ndarray):
     return None
 
 
-def _native_keys(columns: Sequence[np.ndarray], n: int) -> np.ndarray | None:
+def _native_keys(
+    columns: Sequence[np.ndarray],
+    n: int,
+    masks: Sequence[np.ndarray | None] | None = None,
+) -> np.ndarray | None:
     from pathway_tpu import native as _native
 
     lib = _native.get_lib()
@@ -140,12 +153,19 @@ def _native_keys(columns: Sequence[np.ndarray], n: int) -> np.ndarray | None:
         descs.append(desc)
     import ctypes
 
+    mask_arrays = []  # keep alive over the call
     cols = (_native.PwCol * len(descs))()
     for i, (kind, data) in enumerate(descs):
         cols[i].kind = kind
         cols[i].data = data.ctypes.data_as(ctypes.c_void_p)
         cols[i].offsets = None
-        cols[i].mask = None
+        mask = masks[i] if masks is not None else None
+        if mask is None:
+            cols[i].mask = None
+        else:
+            m = np.ascontiguousarray(mask, dtype=np.uint8)
+            mask_arrays.append(m)
+            cols[i].mask = m.ctypes.data_as(ctypes.c_void_p)
     hi = np.empty(n, dtype=np.uint64)
     lo = np.empty(n, dtype=np.uint64)
     u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -167,23 +187,30 @@ def _native_keys(columns: Sequence[np.ndarray], n: int) -> np.ndarray | None:
     return out
 
 
-def keys_from_values(columns: Sequence[np.ndarray]) -> np.ndarray:
+def keys_from_values(
+    columns: Sequence[np.ndarray],
+    masks: Sequence[np.ndarray | None] | None = None,
+) -> np.ndarray:
     """Vectorized key derivation for a batch of rows, one key per row.
 
-    Large simple-typed batches route through the native hasher
-    (``csrc/pathway_native.cc``, byte-identical serialization); anything else falls
-    back to the Python serializer.
+    ``masks[j]``, when given, marks present rows of column ``j`` (False serializes as
+    None — used for outer-join null sides). Simple-typed batches route through the
+    native hasher (``csrc/pathway_native.cc``, byte-identical serialization); anything
+    else falls back to the Python serializer.
     """
     n = len(columns[0]) if columns else 0
     if n >= 64:
-        native_out = _native_keys(columns, n)
+        native_out = _native_keys(columns, n, masks)
         if native_out is not None:
             return native_out
     out = np.empty(n, dtype=KEY_DTYPE)
     for i in range(n):
         chunks: list[bytes] = [_SALT]
-        for col in columns:
-            _serialize_value(col[i], chunks)
+        for j, col in enumerate(columns):
+            if masks is not None and masks[j] is not None and not masks[j][i]:
+                chunks.append(b"\x00")
+            else:
+                _serialize_value(col[i], chunks)
         out["hi"][i], out["lo"][i] = _fingerprint_bytes(b"".join(chunks))
     return out
 

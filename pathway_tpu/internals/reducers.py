@@ -34,6 +34,21 @@ class Reducer:
     def __call__(self, *args: Any, **kwargs: Any) -> expr.ReducerExpression:
         return expr.ReducerExpression(self, *args, **kwargs)
 
+    def batch_update(
+        self,
+        accs: list["Accumulator"],
+        arrays: list[np.ndarray],
+        diffs: np.ndarray,
+        inverse: np.ndarray,
+        m: int,
+        counts: np.ndarray | None = None,
+    ) -> bool:
+        """Vectorized whole-delta update: apply every row to ``accs[inverse[i]]`` at
+        once (``pathway_tpu.ops.segment`` kernels). ``counts`` is the caller's
+        precomputed per-segment signed row count. Return False to fall back to the
+        per-group generic path."""
+        return False
+
 
 class Accumulator:
     def insert(self, values: tuple) -> None:
@@ -44,6 +59,14 @@ class Accumulator:
 
     def value(self) -> Any:
         raise NotImplementedError
+
+    def insert_many(self, rows: Iterable[tuple]) -> None:
+        for r in rows:
+            self.insert(r)
+
+    def retract_many(self, rows: Iterable[tuple]) -> None:
+        for r in rows:
+            self.retract(r)
 
 
 class _CountAcc(Accumulator):
@@ -73,6 +96,15 @@ class CountReducer(Reducer):
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.INT
 
+    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None) -> bool:
+        if counts is None:
+            from pathway_tpu.ops.segment import segment_count
+
+            counts = segment_count(inverse, m, weights=diffs)
+        for j, acc in enumerate(accs):
+            acc.n += int(counts[j])
+        return True
+
 
 class _SumAcc(Accumulator):
     __slots__ = ("total", "n")
@@ -96,12 +128,36 @@ class _SumAcc(Accumulator):
         return self.total
 
 
+def _batch_sum_into(accs, arrays, diffs, inverse, m, counts, *, zero_on_empty: bool) -> bool:
+    """Shared segment-sum path for _SumAcc/_AvgAcc-shaped accumulators."""
+    vals = np.asarray(arrays[0])
+    if vals.dtype == object or vals.dtype.kind not in "bif":
+        return False
+    from pathway_tpu.ops.segment import segment_count, segment_sum
+
+    # keep float32 batches float32 so the XLA device path stays reachable
+    weights = diffs if vals.dtype.kind != "f" else diffs.astype(vals.dtype)
+    sums = segment_sum(vals * weights, inverse, m)
+    if counts is None:
+        counts = segment_count(inverse, m, weights=diffs)
+    for j, acc in enumerate(accs):
+        acc.n += int(counts[j])
+        if zero_on_empty and acc.n == 0:
+            acc.total = 0
+        else:
+            acc.total = acc.total + sums[j].item()
+    return True
+
+
 class SumReducer(Reducer):
     name = "sum"
     semigroup = True
 
     def make(self) -> Accumulator:
         return _SumAcc()
+
+    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None) -> bool:
+        return _batch_sum_into(accs, arrays, diffs, inverse, m, counts, zero_on_empty=True)
 
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         base = arg_dtypes[0].strip_optional()
@@ -128,6 +184,15 @@ class _MultisetAcc(Accumulator):
         k = _hashable(self._key(values))
         self.items[k] -= 1
         if self.items[k] == 0:
+            del self.items[k]
+
+    def insert_many(self, rows: Iterable[tuple]) -> None:
+        # Counter.update over a generator runs at C speed
+        self.items.update(_hashable(self._key(r)) for r in rows)
+
+    def retract_many(self, rows: Iterable[tuple]) -> None:
+        self.items.subtract(_hashable(self._key(r)) for r in rows)
+        for k in [k for k, c in self.items.items() if c == 0]:
             del self.items[k]
 
 
@@ -352,6 +417,16 @@ class _SortedTupleAcc(_MultisetAcc):
             return
         super().retract(values)
 
+    def insert_many(self, rows: Iterable[tuple]) -> None:
+        super().insert_many(
+            r for r in rows if not (self.skip_nones and r[0] is None)
+        )
+
+    def retract_many(self, rows: Iterable[tuple]) -> None:
+        super().retract_many(
+            r for r in rows if not (self.skip_nones and r[0] is None)
+        )
+
     def value(self) -> tuple:
         out = []
         for k in sorted(self.items):
@@ -416,6 +491,9 @@ class AvgReducer(Reducer):
 
     def make(self) -> Accumulator:
         return _AvgAcc()
+
+    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None) -> bool:
+        return _batch_sum_into(accs, arrays, diffs, inverse, m, counts, zero_on_empty=False)
 
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.FLOAT
@@ -487,6 +565,14 @@ class _UdfAcc(Accumulator):
         k = _hashable(values)
         self.rows[k] -= 1
         if self.rows[k] == 0:
+            del self.rows[k]
+
+    def insert_many(self, rows: Iterable[tuple]) -> None:
+        self.rows.update(_hashable(r) for r in rows)
+
+    def retract_many(self, rows: Iterable[tuple]) -> None:
+        self.rows.subtract(_hashable(r) for r in rows)
+        for k in [k for k, c in self.rows.items() if c == 0]:
             del self.rows[k]
 
     def value(self) -> Any:
