@@ -15,7 +15,7 @@ from typing import Any, Dict, Iterable, Mapping, Sequence
 import numpy as np
 
 from pathway_tpu.internals import dtype as dt
-from pathway_tpu.internals.keys import KEY_DTYPE, Pointer, keys_to_pointers
+from pathway_tpu.internals.keys import KEY_DTYPE, Pointer, key_bytes, keys_to_pointers
 
 
 class Error:
@@ -131,34 +131,34 @@ class Delta:
         return Delta(keys, diffs, columns, neu=neu)
 
     def consolidated(self) -> "Delta":
-        """Cancel matching (+1, -1) rows with identical key+values within the batch."""
+        """Cancel matching (+1, -1) rows with identical key+values within the batch.
+
+        Rows are identified by (key, xxh3-128 content signature); the signature batch
+        rides the native typed hasher (``keys_from_values``), so consolidation is one
+        vectorized pass instead of a per-row token loop (the DD ``consolidate``
+        counterpart at commit granularity)."""
         if len(self) == 0:
             return self
-        sig: Dict[Any, int] = {}
-        net: list[int] = []
-        order: list[tuple] = []
-        for i in range(len(self)):
-            token = (self.keys[i].tobytes(), _row_token(self.columns, i))
-            if token in sig:
-                net[sig[token]] += int(self.diffs[i])
-            else:
-                sig[token] = len(net)
-                net.append(int(self.diffs[i]))
-                order.append((i, token))
-        keep_rows = []
-        keep_diffs = []
-        for (i, token) in order:
-            d = net[sig[token]]
-            if d != 0:
-                keep_rows.append(i)
-                keep_diffs.append(d)
-        if len(keep_rows) == len(self) and all(
-            d == int(self.diffs[i]) for d, i in zip(keep_diffs, keep_rows)
-        ):
-            return self
-        idx = np.array(keep_rows, dtype=np.int64)
+        from pathway_tpu.internals.keys import KEY_DTYPE as _KD
+        from pathway_tpu.internals.keys import keys_from_values
+
+        sig = keys_from_values(list(self.columns.values()))
+        combo = np.zeros(len(self), dtype=[("k", _KD), ("s", _KD)])
+        combo["k"] = self.keys
+        if len(sig):
+            combo["s"] = sig
+        uniq, first_idx, inverse = np.unique(
+            combo, return_index=True, return_inverse=True
+        )
+        if len(uniq) == len(self):
+            return self  # all rows distinct: nothing cancels
+        net = np.zeros(len(uniq), dtype=np.int64)
+        np.add.at(net, inverse, self.diffs)
+        order = np.argsort(first_idx, kind="stable")  # first-appearance order
+        keep = order[net[order] != 0]
+        idx = first_idx[keep]
         out = self.select(idx)
-        out.diffs = np.array(keep_diffs, dtype=np.int64)
+        out.diffs = net[keep]
         # expand |diff|>1 into repeated unit rows to preserve row-per-key invariants downstream
         if np.any(np.abs(out.diffs) > 1):
             reps = np.abs(out.diffs).astype(np.int64)
@@ -255,35 +255,48 @@ class StateTable:
         self._capacity = new_cap
 
     def apply(self, delta: Delta) -> None:
+        n = len(delta)
+        if n == 0:
+            return
+        kbs = key_bytes(delta.keys)
         retract = delta.diffs < 0
-        for i in np.nonzero(retract)[0]:
-            kb = delta.keys[i].tobytes()
-            slot = self._index.pop(kb, None)
-            if slot is None:
-                raise KeyError(f"retraction of absent key {delta.keys[i]!r}")
-            self._valid[slot] = False
+        ret_rows = np.nonzero(retract)[0]
+        if len(ret_rows):
+            slots = np.empty(len(ret_rows), dtype=np.int64)
+            for j, i in enumerate(ret_rows):
+                slot = self._index.pop(kbs[i], None)
+                if slot is None:
+                    raise KeyError(f"retraction of absent key {delta.keys[i]!r}")
+                slots[j] = slot
+            self._valid[slots] = False
             for name in self.column_names:
-                self._columns[name][slot] = None
-            self._free.append(slot)
-        insert_rows = np.nonzero(~retract)[0]
-        if len(insert_rows) > len(self._free):
-            self._grow(len(insert_rows) - len(self._free))
-        for i in insert_rows:
-            kb = delta.keys[i].tobytes()
-            if kb in self._index:
-                raise KeyError(f"duplicate key {keys_to_pointers(delta.keys[i:i+1])[0]!r}")
-            slot = self._free.pop()
-            self._index[kb] = slot
-            self._keys[slot] = delta.keys[i]
-            self._valid[slot] = True
+                self._columns[name][slots] = None
+            self._free.extend(slots.tolist())
+        ins_rows = np.nonzero(~retract)[0]
+        if len(ins_rows):
+            if len(ins_rows) > len(self._free):
+                self._grow(len(ins_rows) - len(self._free))
+            slots = np.empty(len(ins_rows), dtype=np.int64)
+            for j, i in enumerate(ins_rows):
+                kb = kbs[i]
+                if kb in self._index:
+                    raise KeyError(
+                        f"duplicate key {keys_to_pointers(delta.keys[i:i+1])[0]!r}"
+                    )
+                slot = self._free.pop()
+                self._index[kb] = slot
+                slots[j] = slot
+            self._keys[slots] = delta.keys[ins_rows]
+            self._valid[slots] = True
             for name in self.column_names:
-                self._columns[name][slot] = delta.columns[name][i]
+                self._columns[name][slots] = delta.columns[name][ins_rows]
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Row slots for keys; -1 when absent."""
         out = np.empty(len(keys), dtype=np.int64)
-        for i in range(len(keys)):
-            out[i] = self._index.get(keys[i].tobytes(), -1)
+        get = self._index.get
+        for i, kb in enumerate(key_bytes(keys)):
+            out[i] = get(kb, -1)
         return out
 
     def contains(self, keys: np.ndarray) -> np.ndarray:

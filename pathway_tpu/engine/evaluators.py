@@ -22,6 +22,8 @@ from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals.keys import (
     KEY_DTYPE,
     Pointer,
+    broadcast_key,
+    key_bytes,
     keys_from_values,
     keys_to_pointers,
     pointer_from,
@@ -303,10 +305,7 @@ class GroupbyEvaluator(Evaluator):
     def _group_keys(self, grouping_vals: List[np.ndarray], n: int, set_id: bool) -> np.ndarray:
         if not grouping_vals:
             # global reduce: every row lands in the single salt-only group
-            p = pointer_from()
-            out = np.empty(n, dtype=KEY_DTYPE)
-            out["hi"], out["lo"] = p.hi, p.lo
-            return out
+            return broadcast_key(pointer_from(), n)
         if not set_id:
             return keys_from_values(grouping_vals)
         col = grouping_vals[0]
@@ -355,7 +354,7 @@ class GroupbyEvaluator(Evaluator):
             gkeys, return_index=True, return_inverse=True
         )
         m = len(uniq)
-        uniq_kb = [uniq[j].tobytes() for j in range(m)]
+        uniq_kb = key_bytes(uniq)
 
         # ensure groups exist; snapshot last-emitted rows
         touched: List[Dict[str, Any]] = []
@@ -498,8 +497,79 @@ class DeduplicateEvaluator(Evaluator):
         return Delta(pointers_to_keys(out_keys), np.array(out_diffs, dtype=np.int64), columns)
 
 
+class _JoinSide:
+    """Columnar arrangement for one join side: slot-based value arrays plus a
+    join-key hash index. The DD-arrangement stand-in for the join's build state —
+    rows live in struct-of-arrays, so event emission gathers with fancy indexing
+    instead of building per-row dicts (reference keeps these in Rust arrangements,
+    ``dataflow.rs`` join over arranged collections)."""
+
+    def __init__(self, names: Iterable[str]):
+        self.names = list(names)
+        self.cap = 0
+        self.keys = np.empty(0, dtype=KEY_DTYPE)
+        self.jk = np.empty(0, dtype=KEY_DTYPE)
+        self.cols: Dict[str, np.ndarray] = {c: np.empty(0, dtype=object) for c in self.names}
+        self.by_jk: Dict[bytes, Dict[bytes, int]] = {}
+        self.by_kb: Dict[bytes, int] = {}
+        self.free: List[int] = []
+
+    def _grow(self, needed: int) -> None:
+        new_cap = max(16, self.cap * 2, self.cap + needed)
+
+        def grown(a: np.ndarray, dtype: Any) -> np.ndarray:
+            out = np.empty(new_cap, dtype=dtype)
+            out[: self.cap] = a
+            return out
+
+        self.keys = grown(self.keys, KEY_DTYPE)
+        self.jk = grown(self.jk, KEY_DTYPE)
+        for c in self.names:
+            self.cols[c] = grown(self.cols[c], object)
+        self.free.extend(range(self.cap, new_cap))
+        self.cap = new_cap
+
+    def alloc(self, k: int) -> np.ndarray:
+        if k > len(self.free):
+            self._grow(k - len(self.free))
+        return np.array([self.free.pop() for _ in range(k)], dtype=np.int64)
+
+    def register(self, jkb: bytes, kb: bytes, slot: int) -> None:
+        bucket = self.by_jk.get(jkb)
+        if bucket is None:
+            bucket = self.by_jk[jkb] = {}
+        old = self.by_kb.get(kb)
+        if old is not None:
+            # duplicate key insert: replace (mirrors dict-overwrite semantics)
+            bucket.pop(kb, None)
+            self.free.append(old)
+        bucket[kb] = slot
+        self.by_kb[kb] = slot
+
+    def deregister(self, jkb: bytes, kb: bytes) -> int | None:
+        slot = self.by_kb.pop(kb, None)
+        if slot is None:
+            return None
+        bucket = self.by_jk.get(jkb)
+        if bucket is not None:
+            bucket.pop(kb, None)
+            if not bucket:
+                del self.by_jk[jkb]
+        return slot
+
+    def release(self, slots: Iterable[int]) -> None:
+        for slot in slots:
+            for c in self.names:
+                self.cols[c][slot] = None
+            self.free.append(slot)
+
+
 class JoinEvaluator(Evaluator):
-    """Symmetric incremental hash join (reference DD join replacement)."""
+    """Symmetric incremental hash join (reference DD join replacement).
+
+    Hot path is columnar: join keys hash in one vectorized pass, the probe loop
+    tracks integer slots only, and all output expressions (plus output-key
+    derivation) evaluate once over the whole event batch."""
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
@@ -507,120 +577,176 @@ class JoinEvaluator(Evaluator):
 
         self.kind = node.config["kind"]
         self.JoinKind = JoinKind
-        # jk_bytes -> {row_key_bytes: (Pointer, row_dict)}
-        self.left_map: Dict[bytes, Dict[bytes, tuple]] = defaultdict(dict)
-        self.right_map: Dict[bytes, Dict[bytes, tuple]] = defaultdict(dict)
+        self.left = _JoinSide(node.inputs[0].column_names())
+        self.right = _JoinSide(node.inputs[1].column_names())
 
-    def _join_keys(self, side: str, delta: Delta) -> List[bytes]:
+    def load_state_dict(self, state: Dict[str, bytes]) -> None:
+        super().load_state_dict(state)
+        # migrate checkpoints from the dict-of-dicts build (left_map/right_map)
+        for attr, side_name in (("left_map", "left"), ("right_map", "right")):
+            legacy = self.__dict__.pop(attr, None)
+            if not legacy:
+                continue
+            side: _JoinSide = getattr(self, side_name)
+            for jkb, rows in legacy.items():
+                for kb, (ptr, row) in rows.items():
+                    slot = int(side.alloc(1)[0])
+                    side.keys[slot] = np.frombuffer(kb, dtype=KEY_DTYPE)[0]
+                    side.jk[slot] = np.frombuffer(jkb, dtype=KEY_DTYPE)[0]
+                    for c in side.names:
+                        side.cols[c][slot] = row.get(c)
+                    side.register(jkb, kb, slot)
+
+    def _join_keys(self, side: str, delta: Delta) -> np.ndarray:
         table = self.node.inputs[0 if side == "left" else 1]
         exprs = self.node.config["left_on" if side == "left" else "right_on"]
+        if not exprs:
+            # no on-condition: every row shares the salt-only bucket (cross join)
+            return broadcast_key(pointer_from(), len(delta))
         resolver = self._resolver_for(table, delta)
         arrays = [ee.evaluate(e, len(delta), resolver) for e in exprs]
-        out = []
-        for i in range(len(delta)):
-            out.append(pointers_to_keys([pointer_from(*(a[i] for a in arrays))]).tobytes())
-        return out
+        return keys_from_values(arrays)
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         left_delta, right_delta = input_deltas
         JK = self.JoinKind
-        events: List[tuple] = []  # (diff, lrow|None, rrow|None); row = (Pointer, dict)
+        # events as parallel lists of (diff, left_slot, right_slot); -1 = null side
+        ev_d: List[int] = []
+        ev_l: List[int] = []
+        ev_r: List[int] = []
+        freed: List[Tuple[_JoinSide, int]] = []
 
-        def run_side(delta: Delta, side: str) -> None:
+        def run_side(delta: Delta, side_name: str) -> None:
             if len(delta) == 0:
                 return
-            jks = self._join_keys(side, delta)
-            own_map = self.left_map if side == "left" else self.right_map
-            other_map = self.right_map if side == "left" else self.left_map
-            own_null = self.kind in (
-                (JK.LEFT, JK.OUTER) if side == "left" else (JK.RIGHT, JK.OUTER)
-            )
-            other_null = self.kind in (
-                (JK.RIGHT, JK.OUTER) if side == "left" else (JK.LEFT, JK.OUTER)
-            )
-            ptrs = keys_to_pointers(delta.keys)
+            jkeys = self._join_keys(side_name, delta)
+            is_left = side_name == "left"
+            own = self.left if is_left else self.right
+            other = self.right if is_left else self.left
+            own_null = self.kind in ((JK.LEFT, JK.OUTER) if is_left else (JK.RIGHT, JK.OUTER))
+            other_null = self.kind in ((JK.RIGHT, JK.OUTER) if is_left else (JK.LEFT, JK.OUTER))
+
+            diffs = delta.diffs
+            ins_rows = np.nonzero(diffs > 0)[0]
+            # batch-store insert rows: values land in state arrays before the probe
+            # loop, so events reference slots uniformly
+            ins_slots = own.alloc(len(ins_rows))
+            if len(ins_rows):
+                own.keys[ins_slots] = delta.keys[ins_rows]
+                own.jk[ins_slots] = jkeys[ins_rows]
+                for c in own.names:
+                    own.cols[c][ins_slots] = delta.columns[c][ins_rows]
+            slot_of_row = np.full(len(delta), -1, dtype=np.int64)
+            slot_of_row[ins_rows] = ins_slots
+
+            jkb_list = key_bytes(jkeys)
+            kb_list = key_bytes(delta.keys)
+
+            def emit(d: int, own_slot: int, other_slot: int) -> None:
+                ev_d.append(d)
+                if is_left:
+                    ev_l.append(own_slot)
+                    ev_r.append(other_slot)
+                else:
+                    ev_l.append(other_slot)
+                    ev_r.append(own_slot)
+
             for i in range(len(delta)):
-                jk = jks[i]
-                kb = delta.keys[i].tobytes()
-                d = int(delta.diffs[i])
-                row = (ptrs[i], {c: delta.columns[c][i] for c in delta.column_names})
-                matches = other_map.get(jk, {})
-                own_before = len(own_map.get(jk, {}))
-                for _, other_row in list(matches.items()):
-                    pair = (row, other_row) if side == "left" else (other_row, row)
-                    events.append((d, pair[0], pair[1]))
-                if own_null and not matches:
-                    pair = (row, None) if side == "left" else (None, row)
-                    events.append((d, pair[0], pair[1]))
+                jkb, kb, d = jkb_list[i], kb_list[i], int(diffs[i])
+                if d > 0:
+                    slot = int(slot_of_row[i])
+                else:
+                    slot = own.by_kb.get(kb, -1)
+                matches = other.by_jk.get(jkb)
+                own_before = len(own.by_jk.get(jkb, ()))
+                if matches:
+                    for oslot in matches.values():
+                        emit(d, slot, oslot)
+                elif own_null:
+                    emit(d, slot, -1)
                 if other_null and matches:
                     if d > 0 and own_before == 0:
-                        for _, other_row in list(matches.items()):
-                            pair = (None, other_row) if side == "left" else (other_row, None)
-                            events.append((-1, pair[0], pair[1]))
+                        for oslot in matches.values():
+                            emit(-1, -1, oslot)
                     elif d < 0 and own_before == 1:
-                        for _, other_row in list(matches.items()):
-                            pair = (None, other_row) if side == "left" else (other_row, None)
-                            events.append((1, pair[0], pair[1]))
+                        for oslot in matches.values():
+                            emit(1, -1, oslot)
                 if d > 0:
-                    own_map[jk][kb] = row
+                    own.register(jkb, kb, slot)
                 else:
-                    own_map[jk].pop(kb, None)
-                    if not own_map[jk]:
-                        del own_map[jk]
+                    gone = own.deregister(jkb, kb)
+                    if gone is not None:
+                        freed.append((own, gone))  # release after emission gathers
 
         run_side(left_delta, "left")
         run_side(right_delta, "right")
 
-        if not events:
-            return Delta.empty(self.output_columns)
-        return self._emit(events).consolidated()
+        try:
+            if not ev_d:
+                return Delta.empty(self.output_columns)
+            return self._emit(
+                np.array(ev_d, dtype=np.int64),
+                np.array(ev_l, dtype=np.int64),
+                np.array(ev_r, dtype=np.int64),
+            ).consolidated()
+        finally:
+            # slots freed only after _emit gathered their values
+            for side, slot in freed:
+                side.release([slot])
 
-    def _emit(self, events: List[tuple]) -> Delta:
+    def _emit(self, ev_d: np.ndarray, ev_l: np.ndarray, ev_r: np.ndarray) -> Delta:
         left_table, right_table = self.node.inputs
         exprs = self.node.config["exprs"]
         id_expr = self.node.config.get("id_expr")
-        out_keys: List[Pointer] = []
-        out_diffs: List[int] = []
-        rows_cols: Dict[str, list] = {name: [] for name in self.output_columns}
+        n_ev = len(ev_d)
+        lmask = ev_l >= 0
+        rmask = ev_r >= 0
+        cache: Dict[Tuple[int, str], np.ndarray] = {}
 
-        for diff, lrow, rrow in events:
-            lptr = lrow[0] if lrow else None
-            rptr = rrow[0] if rrow else None
-            if id_expr is not None and lrow is not None:
-                key = self._eval_scalar(id_expr, lrow, rrow)
+        def gather(side: _JoinSide, slots: np.ndarray, mask: np.ndarray, name: str) -> np.ndarray:
+            key = (id(side), name)
+            hit = cache.get(key)
+            if hit is not None:
+                return hit
+            out = np.empty(n_ev, dtype=object)
+            out[~mask] = None
+            if name == "id":
+                idx = np.nonzero(mask)[0]
+                ptrs = keys_to_pointers(side.keys[slots[idx]])
+                for a, p in zip(idx, ptrs):
+                    out[a] = p
             else:
-                key = pointer_from(lptr, rptr, "join")
-            out_keys.append(key)
-            out_diffs.append(diff)
-            for name, e in exprs.items():
-                rows_cols[name].append(self._eval_scalar(e, lrow, rrow))
-
-        columns = {
-            name: ee._tidy(objarray(vals)) for name, vals in rows_cols.items()
-        }
-        return Delta(pointers_to_keys(out_keys), np.array(out_diffs, dtype=np.int64), columns)
-
-    def _eval_scalar(self, e: expr.ColumnExpression, lrow: tuple | None, rrow: tuple | None) -> Any:
-        left_table, right_table = self.node.inputs
-        this = self
-
-        def resolver(ref: expr.ColumnReference) -> np.ndarray:
-            out = np.empty(1, dtype=object)
-            if ref.table is left_table:
-                if ref.name == "id":
-                    out[0] = lrow[0] if lrow else None
-                else:
-                    out[0] = lrow[1][ref.name] if lrow else None
-            elif ref.table is right_table:
-                if ref.name == "id":
-                    out[0] = rrow[0] if rrow else None
-                else:
-                    out[0] = rrow[1][ref.name] if rrow else None
-            else:
-                raise ValueError(f"join select references foreign table: {ref!r}")
+                out[mask] = side.cols[name][slots[mask]]
+            cache[key] = out
             return out
 
-        return ee.evaluate(e, 1, resolver)[0]
+        def resolver(ref: expr.ColumnReference) -> np.ndarray:
+            if ref.table is left_table:
+                return ee._tidy(gather(self.left, ev_l, lmask, ref.name))
+            if ref.table is right_table:
+                return ee._tidy(gather(self.right, ev_r, rmask, ref.name))
+            raise ValueError(f"join select references foreign table: {ref!r}")
+
+        columns = {
+            name: ee.evaluate(e, n_ev, resolver) for name, e in exprs.items()
+        }
+
+        # output keys: id_expr rows (left present) take the evaluated pointer;
+        # the rest hash (left_key, right_key, "join") in one vectorized pass
+        lkeys = np.zeros(n_ev, dtype=KEY_DTYPE)
+        lkeys[lmask] = self.left.keys[ev_l[lmask]]
+        rkeys = np.zeros(n_ev, dtype=KEY_DTYPE)
+        rkeys[rmask] = self.right.keys[ev_r[rmask]]
+        join_salt = np.empty(n_ev, dtype=object)
+        join_salt[:] = "join"
+        keys = keys_from_values([lkeys, rkeys, join_salt], masks=[lmask, rmask, None])
+        if id_expr is not None and np.any(lmask):
+            id_vals = ee.evaluate(id_expr, n_ev, resolver)
+            for i in np.nonzero(lmask)[0]:
+                p = id_vals[i]
+                if isinstance(p, Pointer):
+                    keys[i]["hi"], keys[i]["lo"] = p.hi, p.lo
+        return Delta(keys, ev_d, columns)
 
 
 class UpdateRowsEvaluator(Evaluator):
@@ -1343,6 +1469,17 @@ class ExternalIndexEvaluator(Evaluator):
         # kb -> (key, qvec, limit, filter) for re-answering mode
         self.live_queries: Dict[bytes, tuple] = {}
 
+    def _search_batch(
+        self, vecs: List[Any], limits: List[int], filters: List[Any]
+    ) -> List[List[tuple]]:
+        if not vecs:
+            return []
+        if hasattr(self.index, "search_many"):
+            return self.index.search_many(vecs, limits, filters)
+        return [
+            self.index.search(v, l, f) for v, l, f in zip(vecs, limits, filters)
+        ]
+
     def process(self, input_deltas: List[Delta]) -> Delta:
         index_delta, query_delta = input_deltas
         index_table, query_table = self.node.inputs
@@ -1384,13 +1521,20 @@ class ExternalIndexEvaluator(Evaluator):
                 if qfilter_col is not None
                 else None
             )
+            q_kbs = key_bytes(query_delta.keys)
+            ins = [i for i in range(len(query_delta)) if query_delta.diffs[i] > 0]
+            ins_replies = self._search_batch(
+                [qvecs[i] for i in ins],
+                [int(limits[i]) if limits is not None else 1 for i in ins],
+                [qfilters[i] if qfilters is not None else None for i in ins],
+            )
+            reply_of = dict(zip(ins, ins_replies))
             for i in range(len(query_delta)):
-                kb = query_delta.keys[i].tobytes()
+                kb = q_kbs[i]
                 if query_delta.diffs[i] > 0:
                     limit = int(limits[i]) if limits is not None else 1
                     flt = qfilters[i] if qfilters is not None else None
-                    matches = self.index.search(qvecs[i], limit, flt)
-                    reply = tuple(matches)
+                    reply = tuple(reply_of[i])
                     out_keys.append(query_delta.keys[i])
                     out_diffs.append(1)
                     out_rows.append({"_pw_index_reply": reply})
@@ -1410,11 +1554,19 @@ class ExternalIndexEvaluator(Evaluator):
                         out_rows.append(stored)
 
         if not self.asof_now and index_changed and self.live_queries:
-            answered = {query_delta.keys[i].tobytes() for i in range(len(query_delta))}
-            for kb, (key, qvec, limit, flt) in self.live_queries.items():
-                if kb in answered:
-                    continue
-                reply = tuple(self.index.search(qvec, limit, flt))
+            answered = set(key_bytes(query_delta.keys))
+            live = [
+                (kb, entry)
+                for kb, entry in self.live_queries.items()
+                if kb not in answered
+            ]
+            live_replies = self._search_batch(
+                [entry[1] for _, entry in live],
+                [entry[2] for _, entry in live],
+                [entry[3] for _, entry in live],
+            )
+            for (kb, (key, qvec, limit, flt)), matches in zip(live, live_replies):
+                reply = tuple(matches)
                 stored = self.replies.get_row(kb)
                 if stored is not None and stored["_pw_index_reply"] == reply:
                     continue

@@ -97,6 +97,25 @@ def _serialize_value(value: Any, out: list[bytes]) -> None:
         if isinstance(value, Json):
             encoded = value.dumps().encode()
             out.append(b"\x09" + len(encoded).to_bytes(8, "little") + encoded)
+        elif isinstance(value, dict):
+            # order-insensitive: equal dicts built in different insertion orders
+            # must fingerprint identically (consolidation relies on it)
+            items = sorted(
+                ((repr(k), k, v) for k, v in value.items()), key=lambda kv: kv[0]
+            )
+            out.append(b"\x0b" + len(items).to_bytes(8, "little"))
+            for _, k, v in items:
+                _serialize_value(k, out)
+                _serialize_value(v, out)
+        elif isinstance(value, (set, frozenset)):
+            parts: list[list[bytes]] = []
+            for item in value:
+                chunk: list[bytes] = []
+                _serialize_value(item, chunk)
+                parts.append(chunk)
+            out.append(b"\x0c" + len(parts).to_bytes(8, "little"))
+            for chunk in sorted(parts, key=b"".join):
+                out.extend(chunk)
         else:
             encoded = repr(value).encode()
             out.append(b"\x0a" + len(encoded).to_bytes(8, "little") + encoded)
@@ -256,9 +275,18 @@ def pointers_to_keys(pointers: Iterable[Pointer]) -> np.ndarray:
     return out
 
 
+def broadcast_key(p: Pointer, n: int) -> np.ndarray:
+    """A KEY_DTYPE column with every row set to ``p`` (constant-key buckets)."""
+    out = np.empty(n, dtype=KEY_DTYPE)
+    out["hi"], out["lo"] = p.hi, p.lo
+    return out
+
+
 def key_bytes(keys: np.ndarray) -> list[bytes]:
-    """Per-row 16-byte representations, usable as dict keys."""
-    return [row.tobytes() for row in keys]
+    """Per-row 16-byte representations, usable as dict keys (one C-level tobytes
+    plus slicing, instead of a per-row ``np.void.tobytes`` call)."""
+    blob = np.ascontiguousarray(keys).tobytes()
+    return [blob[i : i + 16] for i in range(0, len(blob), 16)]
 
 
 def shard_of(keys: np.ndarray, n_shards: int) -> np.ndarray:

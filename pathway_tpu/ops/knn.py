@@ -195,30 +195,53 @@ class BruteForceKnnIndex:
         self.filter_data.pop(key, None)
 
     def search(self, query_vector: Any, limit: int, filter_expr: Any = None) -> List[tuple]:
-        if len(self.store) == 0:
-            return []
-        overfetch = limit if filter_expr is None else max(limit * 4, 16)
-        overfetch = min(overfetch, max(len(self.store), 1))
-        scores, idx, valid = self.store.search_batch(
-            _as_vector(query_vector)[None, :], overfetch
+        return self.search_many([query_vector], [limit], [filter_expr])[0]
+
+    def search_many(
+        self,
+        query_vectors: List[Any],
+        limits: List[int],
+        filter_exprs: List[Any] | None = None,
+    ) -> List[List[tuple]]:
+        """Answer a whole commit's queries with ONE device matmul+top-k (the per-batch
+        kernel the reference runs per worker, ``brute_force_knn_integration.rs:113``)."""
+        n = len(query_vectors)
+        if n == 0 or len(self.store) == 0:
+            return [[] for _ in range(n)]
+        limits = [int(l) for l in limits]
+        if max(limits) <= 0:
+            return [[] for _ in range(n)]
+        has_filter = filter_exprs is not None and any(
+            f is not None for f in filter_exprs
         )
-        out: List[tuple] = []
+        overfetch = max(limits) if not has_filter else max(max(limits) * 4, 16)
+        overfetch = min(overfetch, max(len(self.store), 1))
+        q = np.stack([_as_vector(v) for v in query_vectors])
+        scores, idx, valid = self.store.search_batch(q, overfetch)
         from pathway_tpu.stdlib.indexing.filters import matches_filter
 
-        for j in range(idx.shape[1]):
-            if not valid[0, j]:
+        results: List[List[tuple]] = []
+        for qi in range(n):
+            if limits[qi] <= 0:
+                results.append([])
                 continue
-            key = self.store.key_of.get(int(idx[0, j]))
-            if key is None:
-                continue
-            if filter_expr is not None and not matches_filter(
-                self.filter_data.get(key), filter_expr
-            ):
-                continue
-            out.append((key, float(scores[0, j])))
-            if len(out) >= limit:
-                break
-        return out
+            flt = filter_exprs[qi] if filter_exprs is not None else None
+            out: List[tuple] = []
+            for j in range(idx.shape[1]):
+                if not valid[qi, j]:
+                    continue
+                key = self.store.key_of.get(int(idx[qi, j]))
+                if key is None:
+                    continue
+                if flt is not None and not matches_filter(
+                    self.filter_data.get(key), flt
+                ):
+                    continue
+                out.append((key, float(scores[qi, j])))
+                if len(out) >= limits[qi]:
+                    break
+            results.append(out)
+        return results
 
 
 class LshKnnIndex:
