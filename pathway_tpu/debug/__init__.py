@@ -182,6 +182,7 @@ class _TimedSource(StaticDataSource):
         self._schedule = sorted(set(times))
         self._pos = 0
         self._occurrences: dict = {}
+        self._col_arrays: Dict[str, np.ndarray] | None = None
         # All timed sources of one graph share a global clock: each commit releases the
         # rows of the earliest pending __time__ across the whole graph, so interleaved
         # streams (e.g. events vs a wall-clock table) arrive in deterministic order.
@@ -202,9 +203,50 @@ class _TimedSource(StaticDataSource):
             return None
         return self._schedule[self._pos]
 
-    def next_batch(self, column_names: List[str]) -> Delta:
-        from pathway_tpu.internals.keys import pointers_to_keys
+    def _materialize(self, column_names: List[str]) -> None:
+        """One-time columnar layout: whole-dataset column arrays, per-time row index
+        slices, and (when keys are value-derived) one vectorized base-key hash."""
+        from pathway_tpu.engine.expression_evaluator import _tidy
+        from pathway_tpu.internals.keys import KEY_DTYPE, pointers_to_keys
 
+        n = len(self._rows)
+        self._col_arrays = {}
+        for name in column_names:
+            col = np.empty(n, dtype=object)
+            for i, row in enumerate(self._rows):
+                col[i] = row.get(name)
+            self._col_arrays[name] = _tidy(col)
+        times = np.asarray(self._times)
+        self._time_rows = {}
+        if n:
+            order = np.argsort(times, kind="stable")
+            sorted_t = times[order]
+            bounds = np.nonzero(np.diff(sorted_t))[0] + 1
+            for chunk in np.split(order, bounds):
+                self._time_rows[sorted_t[chunk[0]].item()] = chunk
+        if self._pointers:
+            self._all_keys = pointers_to_keys(self._pointers)
+            self._base_keys = None
+        else:
+            # value-derived row identity: one native hash over all value columns
+            # (sorted names, as the old per-row token did)
+            from pathway_tpu.internals.keys import keys_from_values
+
+            value_cols = [
+                self._col_arrays[name] for name in sorted(self._col_arrays)
+            ]
+            self._base_keys = (
+                keys_from_values(value_cols)
+                if value_cols
+                else np.zeros(n, dtype=KEY_DTYPE)
+            )
+            self._all_keys = None
+
+    def next_batch(self, column_names: List[str]) -> Delta:
+        from pathway_tpu.internals.keys import key_bytes, keys_from_values
+
+        if getattr(self, "_col_arrays", None) is None:
+            self._materialize(column_names)
         if self._pos >= len(self._schedule):
             self._done = True
             return Delta.empty(column_names)
@@ -215,35 +257,29 @@ class _TimedSource(StaticDataSource):
         self._pos += 1
         if self._pos >= len(self._schedule):
             self._done = True
-        idx = [i for i, ti in enumerate(self._times) if ti == t]
+        idx = self._time_rows[t]
         n = len(idx)
-        columns = {}
-        for name in column_names:
-            col = np.empty(n, dtype=object)
-            for j, i in enumerate(idx):
-                col[j] = self._rows[i].get(name)
-            from pathway_tpu.engine.expression_evaluator import _tidy
-
-            columns[name] = _tidy(col)
-        if self._pointers:
-            keys = pointers_to_keys([self._pointers[i] for i in idx])
-        else:
-            # value-derived keys so a later __diff__=-1 row retracts its matching insert;
-            # occurrence counters pair duplicate rows LIFO
-            from pathway_tpu.internals.keys import pointers_to_keys as _ptk
-
-            ptrs = []
-            for i in idx:
-                token = tuple(sorted(self._rows[i].items()))
-                if self._diffs[i] > 0:
-                    occ = self._occurrences.get(token, 0)
-                    self._occurrences[token] = occ + 1
-                else:
-                    occ = self._occurrences.get(token, 1) - 1
-                    self._occurrences[token] = occ
-                ptrs.append(pointer_from(repr(token), occ, "timedrow"))
-            keys = _ptk(ptrs)
+        columns = {name: self._col_arrays[name][idx] for name in column_names}
         diffs = np.array([self._diffs[i] for i in idx], dtype=np.int64)
+        if self._all_keys is not None:
+            keys = self._all_keys[idx]
+        else:
+            # occurrence counters pair duplicate rows LIFO so a later __diff__=-1 row
+            # retracts its matching insert
+            base = self._base_keys[idx]
+            occ = np.empty(n, dtype=np.int64)
+            occurrences = self._occurrences
+            for j, bb in enumerate(key_bytes(base)):
+                if diffs[j] > 0:
+                    o = occurrences.get(bb, 0)
+                    occurrences[bb] = o + 1
+                else:
+                    o = occurrences.get(bb, 1) - 1
+                    occurrences[bb] = o
+                occ[j] = o
+            salt = np.empty(n, dtype=object)
+            salt[:] = "timedrow"
+            keys = keys_from_values([base, occ, salt])
         return Delta(keys, diffs, columns)
 
     def is_finished(self) -> bool:

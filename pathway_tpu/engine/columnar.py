@@ -173,51 +173,6 @@ class Delta:
         return out
 
 
-# Unforgeable tags so user tuples like ("__nd__", ...) can't collide with our tokens.
-_ND_TAG = object()
-_JSON_TAG = object()
-_SET_TAG = object()
-_PICKLE_TAG = object()
-_REPR_TAG = object()
-
-
-def _hashable_value(v: Any) -> Any:
-    """Best-effort hashable token equal for equal cell values.
-
-    For unhashable non-container objects the token is pickle bytes (or repr as a last
-    resort) — not strictly equality-faithful for exotic types, but retractions in this
-    engine carry the *same* object produced upstream, so token equality holds in practice.
-    """
-    if isinstance(v, (int, float, str, bool, bytes, type(None))):
-        return v
-    if isinstance(v, np.ndarray):
-        return (_ND_TAG, str(v.dtype), v.shape, v.tobytes())
-    if isinstance(v, (tuple, list)):
-        return tuple(_hashable_value(x) for x in v)
-    if isinstance(v, dict):
-        items = [(k, _hashable_value(x)) for k, x in v.items()]
-        items.sort(key=lambda kv: (type(kv[0]).__name__, repr(kv[0])))
-        return (_JSON_TAG, tuple(items))
-    if isinstance(v, (set, frozenset)):
-        elems = [_hashable_value(x) for x in v]
-        elems.sort(key=repr)
-        return (_SET_TAG, tuple(elems))
-    try:
-        hash(v)
-    except TypeError:
-        import pickle
-
-        try:
-            return (_PICKLE_TAG, pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL))
-        except Exception:
-            return (_REPR_TAG, type(v).__name__, repr(v))
-    return v
-
-
-def _row_token(columns: Mapping[str, np.ndarray], i: int) -> tuple:
-    return tuple((name, _hashable_value(columns[name][i])) for name in sorted(columns))
-
-
 class StateTable:
     """Materialized keyed state: the arrangement replacement.
 

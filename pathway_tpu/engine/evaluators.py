@@ -209,13 +209,12 @@ class ConcatEvaluator(Evaluator):
         return Delta.concat(parts, self.output_columns)
 
 
-def _rows_equal(a: Optional[dict], b: Optional[dict]) -> bool:
+def _rows_equal(a: Optional[tuple], b: Optional[tuple]) -> bool:
     if a is None or b is None:
         return a is b
-    if a.keys() != b.keys():
-        return False
-    for k, va in a.items():
-        vb = b[k]
+    for va, vb in zip(a, b):
+        if va is vb:
+            continue
         if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
             if not (
                 isinstance(va, np.ndarray)
@@ -223,7 +222,7 @@ def _rows_equal(a: Optional[dict], b: Optional[dict]) -> bool:
                 and np.array_equal(va, vb)
             ):
                 return False
-        elif not (va is vb or va == vb):
+        elif not va == vb:
             return False
     return True
 
@@ -264,9 +263,10 @@ class GroupbyEvaluator(Evaluator):
         for e in out_exprs.values():
             walk(e)
 
-    def _rows_for_groups(self, groups: List[Dict[str, Any]]) -> List[dict]:
-        """Output rows for the given groups: the out-expression tree evaluated once,
-        vectorized over all groups, with reducer leaves bound to accumulator values."""
+    def _rows_for_groups(self, groups: List[Dict[str, Any]]) -> List[tuple]:
+        """Output rows (tuples in ``output_columns`` order) for the given groups: the
+        out-expression tree evaluated once, vectorized over all groups, with reducer
+        leaves bound to accumulator values."""
         if not groups:
             return []
         leaf_value_arrays: Dict[int, np.ndarray] = {}
@@ -288,16 +288,17 @@ class GroupbyEvaluator(Evaluator):
                 return gval_arrays[ref.name]
 
         evaluator = _GroupEval(ee.EvalContext(len(groups), lambda ref: None))
-        out_cols = {
-            name: evaluator.eval(e) for name, e in self.node.config["out_exprs"].items()
-        }
-        return [
-            {name: out_cols[name][a] for name in out_cols} for a in range(len(groups))
-        ]
+        out_exprs = self.node.config["out_exprs"]
+        out_cols = [list(evaluator.eval(out_exprs[name])) for name in self.output_columns]
+        return list(zip(*out_cols)) if out_cols else [() for _ in groups]
 
     def load_state_dict(self, state: Dict[str, bytes]) -> None:
         super().load_state_dict(state)
-        # checkpoints from builds predating the last-emitted-row cache lack "row"
+        # checkpoints from builds predating the tuple-row cache lack "row" (or hold
+        # the older dict form)
+        for g in self.groups.values():
+            if isinstance(g.get("row"), dict):
+                g["row"] = tuple(g["row"].get(name) for name in self.output_columns)
         missing = [g for g in self.groups.values() if "row" not in g]
         for g, row in zip(missing, self._rows_for_groups(missing)):
             g["row"] = row
@@ -414,31 +415,30 @@ class GroupbyEvaluator(Evaluator):
             new_rows[a] = row
 
         # emit (retract old, insert new) for changed groups
-        out_keys: List[np.void] = []
+        out_key_idx: List[int] = []
         out_diffs: List[int] = []
-        out_rows: List[dict] = []
+        out_rows: List[tuple] = []
         for j in range(m):
             old, new = old_rows[j], new_rows[j]
             if _rows_equal(old, new):
                 continue
             if old is not None:
-                out_keys.append(uniq[j])
+                out_key_idx.append(j)
                 out_diffs.append(-1)
                 out_rows.append(old)
             if new is not None:
-                out_keys.append(uniq[j])
+                out_key_idx.append(j)
                 out_diffs.append(1)
                 out_rows.append(new)
             if uniq_kb[j] in self.groups:
                 self.groups[uniq_kb[j]]["row"] = new
-        if not out_keys:
+        if not out_key_idx:
             return Delta.empty(self.output_columns)
-        keys_arr = np.empty(len(out_keys), dtype=KEY_DTYPE)
-        for i, k in enumerate(out_keys):
-            keys_arr[i] = k
+        keys_arr = uniq[np.array(out_key_idx, dtype=np.int64)]
+        cols_t = list(zip(*out_rows))
         columns = {
-            name: ee._tidy(objarray([r[name] for r in out_rows]))
-            for name in self.output_columns
+            name: ee._tidy(objarray(list(vals)))
+            for name, vals in zip(self.output_columns, cols_t)
         }
         return Delta(keys_arr, np.array(out_diffs, dtype=np.int64), columns)
 
@@ -535,14 +535,21 @@ class _JoinSide:
         return np.array([self.free.pop() for _ in range(k)], dtype=np.int64)
 
     def register(self, jkb: bytes, kb: bytes, slot: int) -> None:
+        old = self.by_kb.get(kb)
+        if old is not None:
+            # duplicate key insert: replace (mirrors dict-overwrite semantics).
+            # The old row may sit in a DIFFERENT join-key bucket — find it via its
+            # stored jk, not the incoming one.
+            old_jkb = self.jk[old].tobytes()
+            old_bucket = self.by_jk.get(old_jkb)
+            if old_bucket is not None:
+                old_bucket.pop(kb, None)
+                if not old_bucket:
+                    del self.by_jk[old_jkb]
+            self.free.append(old)
         bucket = self.by_jk.get(jkb)
         if bucket is None:
             bucket = self.by_jk[jkb] = {}
-        old = self.by_kb.get(kb)
-        if old is not None:
-            # duplicate key insert: replace (mirrors dict-overwrite semantics)
-            bucket.pop(kb, None)
-            self.free.append(old)
         bucket[kb] = slot
         self.by_kb[kb] = slot
 
@@ -1599,10 +1606,13 @@ class OutputEvaluator(Evaluator):
         if self.callback is not None and len(delta):
             ptrs = keys_to_pointers(delta.keys)
             time = self.runner.current_time
-            for i in range(len(delta)):
-                row = {c: delta.columns[c][i] for c in self.input_columns}
-                self.callback(
-                    key=ptrs[i], row=row, time=time, is_addition=bool(delta.diffs[i] > 0)
+            names = self.input_columns
+            cols = [list(delta.columns[c]) for c in names]  # one C pass per column
+            additions = (delta.diffs > 0).tolist()
+            callback = self.callback
+            for ptr, is_add, *vals in zip(ptrs, additions, *cols):
+                callback(
+                    key=ptr, row=dict(zip(names, vals)), time=time, is_addition=is_add
                 )
         return Delta.empty([])
 

@@ -264,7 +264,9 @@ def sequential_keys(start: int, count: int) -> np.ndarray:
 
 
 def keys_to_pointers(keys: np.ndarray) -> list[Pointer]:
-    return [Pointer(int(h), int(l)) for h, l in zip(keys["hi"], keys["lo"])]
+    # .tolist() converts to python ints in one C pass (values already in range,
+    # so Pointer's masking is a no-op)
+    return [Pointer(h, l) for h, l in zip(keys["hi"].tolist(), keys["lo"].tolist())]
 
 
 def pointers_to_keys(pointers: Iterable[Pointer]) -> np.ndarray:
