@@ -380,7 +380,9 @@ class GroupbyEvaluator(Evaluator):
         slices = None
         for li, (leaf, arrays) in enumerate(zip(self.reducer_leaves, leaf_args)):
             accs = [g["accs"][li] for g in touched]
-            if leaf._reducer.batch_update(accs, arrays, diffs, inverse, m, cnt_delta):
+            if leaf._reducer.batch_update(
+                accs, arrays, diffs, inverse, m, cnt_delta, key_lo=gkeys["lo"]
+            ):
                 continue
             if slices is None:
                 slices = segment_slices(inverse, m)

@@ -42,11 +42,13 @@ class Reducer:
         inverse: np.ndarray,
         m: int,
         counts: np.ndarray | None = None,
+        key_lo: np.ndarray | None = None,
     ) -> bool:
         """Vectorized whole-delta update: apply every row to ``accs[inverse[i]]`` at
         once (``pathway_tpu.ops.segment`` kernels). ``counts`` is the caller's
-        precomputed per-segment signed row count. Return False to fall back to the
-        per-group generic path."""
+        precomputed per-segment signed row count; ``key_lo`` enables the mesh-exchange
+        path for float batches. Return False to fall back to the per-group generic
+        path."""
         return False
 
 
@@ -96,7 +98,7 @@ class CountReducer(Reducer):
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.INT
 
-    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None) -> bool:
+    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None, key_lo=None) -> bool:
         if counts is None:
             from pathway_tpu.ops.segment import segment_count
 
@@ -128,7 +130,7 @@ class _SumAcc(Accumulator):
         return self.total
 
 
-def _batch_sum_into(accs, arrays, diffs, inverse, m, counts, *, zero_on_empty: bool) -> bool:
+def _batch_sum_into(accs, arrays, diffs, inverse, m, counts, key_lo, *, zero_on_empty: bool) -> bool:
     """Shared segment-sum path for _SumAcc/_AvgAcc-shaped accumulators."""
     vals = np.asarray(arrays[0])
     if vals.dtype == object or vals.dtype.kind not in "bif":
@@ -137,7 +139,7 @@ def _batch_sum_into(accs, arrays, diffs, inverse, m, counts, *, zero_on_empty: b
 
     # keep float32 batches float32 so the XLA device path stays reachable
     weights = diffs if vals.dtype.kind != "f" else diffs.astype(vals.dtype)
-    sums = segment_sum(vals * weights, inverse, m)
+    sums = segment_sum(vals * weights, inverse, m, key_lo=key_lo)
     if counts is None:
         counts = segment_count(inverse, m, weights=diffs)
     for j, acc in enumerate(accs):
@@ -156,8 +158,8 @@ class SumReducer(Reducer):
     def make(self) -> Accumulator:
         return _SumAcc()
 
-    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None) -> bool:
-        return _batch_sum_into(accs, arrays, diffs, inverse, m, counts, zero_on_empty=True)
+    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None, key_lo=None) -> bool:
+        return _batch_sum_into(accs, arrays, diffs, inverse, m, counts, key_lo, zero_on_empty=True)
 
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         base = arg_dtypes[0].strip_optional()
@@ -492,8 +494,8 @@ class AvgReducer(Reducer):
     def make(self) -> Accumulator:
         return _AvgAcc()
 
-    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None) -> bool:
-        return _batch_sum_into(accs, arrays, diffs, inverse, m, counts, zero_on_empty=False)
+    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None, key_lo=None) -> bool:
+        return _batch_sum_into(accs, arrays, diffs, inverse, m, counts, key_lo, zero_on_empty=False)
 
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.FLOAT

@@ -181,8 +181,23 @@ class BruteForceKnnIndex:
     auxiliary filter data support (jmespath replaced by a python callable / jsonpath-lite).
     """
 
-    def __init__(self, dim: int, metric: str = "l2sq", initial_capacity: int = 1024):
-        self.store = DenseKNNStore(dim, metric=metric, initial_capacity=initial_capacity)
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "l2sq",
+        initial_capacity: int = 1024,
+        mesh: Any = None,
+    ):
+        if mesh is not None:
+            from pathway_tpu.parallel.knn_sharded import ShardedKNNStore
+
+            self.store: Any = ShardedKNNStore(
+                mesh, dim, metric=metric, initial_capacity=initial_capacity
+            )
+        else:
+            self.store = DenseKNNStore(
+                dim, metric=metric, initial_capacity=initial_capacity
+            )
         self.filter_data: Dict[Any, Any] = {}
 
     def add(self, key: Any, vector: Any, filter_data: Any = None) -> None:

@@ -53,17 +53,45 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+# Above this row count, a configured multi-shard mesh routes the reduction through
+# the key-hash exchange (mutable for tests/dryruns to force the collective path).
+MESH_THRESHOLD = 1 << 15
+
+# The mesh path computes in float32 (TPUs have no f64). float64 batches stay on host
+# unless a deployment opts in to the cast — an explicit precision/scale trade.
+MESH_ALLOW_F32_CAST = False
+
+
 def segment_sum(
-    values: np.ndarray, segment_ids: np.ndarray, num_segments: int
+    values: np.ndarray,
+    segment_ids: np.ndarray,
+    num_segments: int,
+    key_lo: np.ndarray | None = None,
 ) -> np.ndarray:
     """Sum ``values`` into ``num_segments`` buckets given per-row segment ids.
 
     Exactness contract: integer inputs reduce in int64 on host; float64 reduces on host
     (TPU would downcast to f32). float32 batches above the device threshold ride XLA.
+    With a default mesh configured (``parallel.set_default_mesh``) and ``key_lo`` given,
+    large float batches route through the mesh exchange (``groupby_sharded``).
     """
     values = np.asarray(values)
     segment_ids = np.asarray(segment_ids, dtype=np.int64)
     jax = _jax()
+    if (
+        jax is not None
+        and key_lo is not None
+        and (values.dtype == np.float32 or (values.dtype.kind == "f" and MESH_ALLOW_F32_CAST))
+    ):
+        from pathway_tpu.parallel.mesh import data_shards, get_default_mesh
+
+        mesh = get_default_mesh()
+        if data_shards(mesh) > 1 and len(values) >= MESH_THRESHOLD:
+            from pathway_tpu.parallel.groupby_sharded import sharded_segment_sum
+
+            return sharded_segment_sum(
+                mesh, np.asarray(key_lo), segment_ids, values, num_segments
+            ).astype(values.dtype)
     if (
         jax is not None
         and values.dtype == np.float32

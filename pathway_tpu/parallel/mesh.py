@@ -53,6 +53,28 @@ def make_mesh(
     return Mesh(grid, axis_names=tuple(axis_names))
 
 
+_default_mesh: Optional[Mesh] = None
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    """Configure the mesh the ENGINE runs on (the reference's worker-count config,
+    ``PATHWAY_THREADS``/``PATHWAY_PROCESSES`` → here a device mesh). When set with a
+    ``data`` axis larger than 1, external KNN indexes build mesh-sharded stores and
+    large groupby-reduce batches route through the key-hash exchange."""
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+def data_shards(mesh: Optional[Mesh]) -> int:
+    if mesh is None or "data" not in mesh.axis_names:
+        return 1
+    return mesh.shape["data"]
+
+
 def cpu_virtual_devices(n: int) -> None:
     """Request an n-device virtual CPU platform. Must run before jax initializes; used by
     test conftest / dryrun drivers (mirrors the driver's

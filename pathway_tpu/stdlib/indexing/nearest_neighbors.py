@@ -79,6 +79,21 @@ def _apply_embedder(embedder: Any, column: Any) -> expr.ColumnExpression:
     raise TypeError("embedder must be a pw.UDF or callable producing an expression")
 
 
+def _make_bf_index(dimensions: int, metric_s: str, reserved_space: int) -> Any:
+    """Engine-facing index instance; a configured multi-shard mesh swaps in the
+    row-sharded store with all-gather top-k merge (the reference's per-worker sharded
+    index, ``external_index.rs`` + ``shard.rs``)."""
+    from pathway_tpu.parallel.mesh import data_shards, get_default_mesh
+
+    mesh = get_default_mesh()
+    return BruteForceKnnIndex(
+        dimensions,
+        metric=metric_s,
+        initial_capacity=max(16, reserved_space),
+        mesh=mesh if data_shards(mesh) > 1 else None,
+    )
+
+
 class BruteForceKnn(_KnnInnerIndex):
     """Exact KNN on the TPU (reference ``BruteForceKnn:170`` over
     ``brute_force_knn_integration.rs``)."""
@@ -101,9 +116,7 @@ class BruteForceKnn(_KnnInnerIndex):
             dimensions,
             metric_s,
             embedder,
-            make_index=lambda: BruteForceKnnIndex(
-                dimensions, metric=metric_s, initial_capacity=max(16, reserved_space)
-            ),
+            make_index=lambda: _make_bf_index(dimensions, metric_s, reserved_space),
         )
 
 
@@ -130,9 +143,7 @@ class USearchKnn(_KnnInnerIndex):
             dimensions,
             metric_s,
             embedder,
-            make_index=lambda: BruteForceKnnIndex(
-                dimensions, metric=metric_s, initial_capacity=max(16, reserved_space)
-            ),
+            make_index=lambda: _make_bf_index(dimensions, metric_s, reserved_space),
         )
 
 
