@@ -493,4 +493,407 @@ PyObject* pwtpu_parse_dsv_rows(const char* data, uint64_t len, char delim,
   return out;
 }
 
+// ---------------------------------------------------------------------------
+// KeyIndex: open-addressing hash table, 128-bit key -> dense int64 slot.
+//
+// The native replacement for the engine's Python dict key indexes (StateTable
+// row index, groupby group index, join-side row index). Keys arrive as the raw
+// bytes of a KEY_DTYPE structured column: interleaved little-endian [hi, lo]
+// uint64 pairs. Keys are xxh3 fingerprints already, so `lo` is the hash.
+// Slots are dense ints assigned on insert and recycled through a free stack,
+// so the Python side can keep column arrays indexed by slot.
+
+namespace {
+
+struct KeyIndex {
+  std::vector<uint64_t> khi, klo;
+  std::vector<int8_t> state;  // 0 empty, 1 full, 2 tombstone
+  std::vector<int64_t> slots;
+  uint64_t mask = 0;
+  int64_t live = 0;
+  int64_t filled = 0;  // live + tombstones
+  int64_t next_slot = 0;
+  std::vector<int64_t> free_slots;
+
+  explicit KeyIndex(uint64_t cap_hint) {
+    uint64_t cap = 16;
+    while (cap < cap_hint * 2) cap <<= 1;
+    rebuild(cap);
+  }
+
+  void rebuild(uint64_t cap) {
+    khi.assign(cap, 0);
+    klo.assign(cap, 0);
+    state.assign(cap, 0);
+    slots.assign(cap, -1);
+    mask = cap - 1;
+    filled = live;  // tombstones vanish on rebuild
+  }
+
+  void rehash_if_needed() {
+    uint64_t cap = mask + 1;
+    if (static_cast<uint64_t>(filled) * 4 < cap * 3) return;
+    uint64_t new_cap = cap;
+    while (static_cast<uint64_t>(live) * 4 >= new_cap * 2) new_cap <<= 1;
+    std::vector<uint64_t> ohi, olo;
+    std::vector<int8_t> ost;
+    std::vector<int64_t> osl;
+    ohi.swap(khi);
+    olo.swap(klo);
+    ost.swap(state);
+    osl.swap(slots);
+    rebuild(new_cap);
+    for (uint64_t i = 0; i < ost.size(); ++i) {
+      if (ost[i] != 1) continue;
+      uint64_t pos = olo[i] & mask;
+      while (state[pos] == 1) pos = (pos + 1) & mask;
+      khi[pos] = ohi[i];
+      klo[pos] = olo[i];
+      state[pos] = 1;
+      slots[pos] = osl[i];
+    }
+  }
+
+  // Returns the table position of `key` if present, else the first insertable
+  // position (tombstone or empty).
+  uint64_t find(uint64_t hi, uint64_t lo, bool* found) const {
+    uint64_t pos = lo & mask;
+    int64_t first_tomb = -1;
+    for (;;) {
+      int8_t st = state[pos];
+      if (st == 0) {
+        *found = false;
+        return first_tomb >= 0 ? static_cast<uint64_t>(first_tomb) : pos;
+      }
+      if (st == 1 && klo[pos] == lo && khi[pos] == hi) {
+        *found = true;
+        return pos;
+      }
+      if (st == 2 && first_tomb < 0) first_tomb = static_cast<int64_t>(pos);
+      pos = (pos + 1) & mask;
+    }
+  }
+
+  int64_t upsert(uint64_t hi, uint64_t lo, uint8_t* is_new) {
+    rehash_if_needed();
+    bool found = false;
+    uint64_t pos = find(hi, lo, &found);
+    if (found) {
+      *is_new = 0;
+      return slots[pos];
+    }
+    int64_t slot;
+    if (!free_slots.empty()) {
+      slot = free_slots.back();
+      free_slots.pop_back();
+    } else {
+      slot = next_slot++;
+    }
+    if (state[pos] == 0) ++filled;
+    khi[pos] = hi;
+    klo[pos] = lo;
+    state[pos] = 1;
+    slots[pos] = slot;
+    ++live;
+    *is_new = 1;
+    return slot;
+  }
+
+  int64_t lookup(uint64_t hi, uint64_t lo) const {
+    bool found = false;
+    uint64_t pos = find(hi, lo, &found);
+    return found ? slots[pos] : -1;
+  }
+
+  int64_t remove(uint64_t hi, uint64_t lo) {
+    bool found = false;
+    uint64_t pos = find(hi, lo, &found);
+    if (!found) return -1;
+    int64_t slot = slots[pos];
+    state[pos] = 2;  // tombstone (filled count unchanged)
+    slots[pos] = -1;
+    --live;
+    free_slots.push_back(slot);
+    return slot;
+  }
+};
+
+inline const uint64_t* key_hi_lo(const uint64_t* keys, uint64_t i) {
+  return keys + 2 * i;
+}
+
+}  // namespace
+
+void* pwtpu_idx_new(uint64_t cap_hint) { return new KeyIndex(cap_hint); }
+
+void pwtpu_idx_free(void* h) { delete static_cast<KeyIndex*>(h); }
+
+int64_t pwtpu_idx_len(void* h) { return static_cast<KeyIndex*>(h)->live; }
+
+// One past the largest slot ever assigned: the Python side sizes its column
+// arrays to this bound.
+int64_t pwtpu_idx_slot_bound(void* h) {
+  return static_cast<KeyIndex*>(h)->next_slot;
+}
+
+// keys: interleaved [hi, lo] pairs (raw KEY_DTYPE bytes). Duplicate keys within
+// one batch resolve to the same slot (is_new only on the first occurrence).
+void pwtpu_idx_upsert(void* h, const uint64_t* keys, int64_t n,
+                      int64_t* out_slots, uint8_t* out_is_new) {
+  KeyIndex* idx = static_cast<KeyIndex*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* k = key_hi_lo(keys, i);
+    uint8_t is_new = 0;
+    out_slots[i] = idx->upsert(k[0], k[1], &is_new);
+    if (out_is_new != nullptr) out_is_new[i] = is_new;
+  }
+}
+
+void pwtpu_idx_lookup(void* h, const uint64_t* keys, int64_t n,
+                      int64_t* out_slots) {
+  const KeyIndex* idx = static_cast<const KeyIndex*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* k = key_hi_lo(keys, i);
+    out_slots[i] = idx->lookup(k[0], k[1]);
+  }
+}
+
+// Removed keys free their slot for reuse; absent keys report -1.
+void pwtpu_idx_remove(void* h, const uint64_t* keys, int64_t n,
+                      int64_t* out_slots) {
+  KeyIndex* idx = static_cast<KeyIndex*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* k = key_hi_lo(keys, i);
+    out_slots[i] = idx->remove(k[0], k[1]);
+  }
+}
+
+// Checkpoint-restore path: insert keys with EXPLICIT slot assignments (slot ids
+// index the caller's column arrays and must survive a pickle round-trip exactly),
+// then rebuild the free list from the gaps below next_slot.
+void pwtpu_idx_restore(void* h, const uint64_t* keys, const int64_t* in_slots,
+                       int64_t n, int64_t next_slot) {
+  KeyIndex* idx = static_cast<KeyIndex*>(h);
+  std::vector<bool> used(static_cast<size_t>(next_slot), false);
+  for (int64_t i = 0; i < n; ++i) {
+    idx->rehash_if_needed();
+    const uint64_t* k = key_hi_lo(keys, i);
+    bool found = false;
+    uint64_t pos = idx->find(k[0], k[1], &found);
+    if (!found) {
+      if (idx->state[pos] == 0) ++idx->filled;
+      ++idx->live;
+    }
+    idx->khi[pos] = k[0];
+    idx->klo[pos] = k[1];
+    idx->state[pos] = 1;
+    idx->slots[pos] = in_slots[i];
+    if (in_slots[i] >= 0 && in_slots[i] < next_slot) used[in_slots[i]] = true;
+  }
+  idx->next_slot = next_slot;
+  idx->free_slots.clear();
+  for (int64_t s = next_slot - 1; s >= 0; --s) {
+    if (!used[s]) idx->free_slots.push_back(s);
+  }
+}
+
+// Dump live (key, slot) pairs; buffers must hold pwtpu_idx_len entries.
+void pwtpu_idx_items(void* h, uint64_t* out_keys, int64_t* out_slots) {
+  const KeyIndex* idx = static_cast<const KeyIndex*>(h);
+  uint64_t j = 0;
+  for (uint64_t pos = 0; pos <= idx->mask; ++pos) {
+    if (idx->state[pos] != 1) continue;
+    out_keys[2 * j] = idx->khi[pos];
+    out_keys[2 * j + 1] = idx->klo[pos];
+    out_slots[j] = idx->slots[pos];
+    ++j;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// MultiMap: 128-bit key -> bag of int64 values (join-side jk -> row slots).
+// Same open-addressing scheme; each full entry owns a value vector. Probes
+// answer in CSR form (count pass, then fill pass).
+
+namespace {
+
+struct MultiMap {
+  std::vector<uint64_t> khi, klo;
+  std::vector<int8_t> state;
+  std::vector<std::vector<int64_t>> vals;
+  uint64_t mask = 0;
+  int64_t live = 0;
+  int64_t filled = 0;
+  int64_t total_vals = 0;
+
+  MultiMap() { rebuild(16); }
+
+  void rebuild(uint64_t cap) {
+    khi.assign(cap, 0);
+    klo.assign(cap, 0);
+    state.assign(cap, 0);
+    vals.assign(cap, {});
+    mask = cap - 1;
+    filled = live;
+  }
+
+  void rehash_if_needed() {
+    uint64_t cap = mask + 1;
+    if (static_cast<uint64_t>(filled) * 4 < cap * 3) return;
+    uint64_t new_cap = cap;
+    while (static_cast<uint64_t>(live) * 4 >= new_cap * 2) new_cap <<= 1;
+    std::vector<uint64_t> ohi, olo;
+    std::vector<int8_t> ost;
+    std::vector<std::vector<int64_t>> ovl;
+    ohi.swap(khi);
+    olo.swap(klo);
+    ost.swap(state);
+    ovl.swap(vals);
+    rebuild(new_cap);
+    for (uint64_t i = 0; i < ost.size(); ++i) {
+      if (ost[i] != 1) continue;
+      uint64_t pos = olo[i] & mask;
+      while (state[pos] == 1) pos = (pos + 1) & mask;
+      khi[pos] = ohi[i];
+      klo[pos] = olo[i];
+      state[pos] = 1;
+      vals[pos] = std::move(ovl[i]);
+    }
+  }
+
+  uint64_t find(uint64_t hi, uint64_t lo, bool* found) const {
+    uint64_t pos = lo & mask;
+    int64_t first_tomb = -1;
+    for (;;) {
+      int8_t st = state[pos];
+      if (st == 0) {
+        *found = false;
+        return first_tomb >= 0 ? static_cast<uint64_t>(first_tomb) : pos;
+      }
+      if (st == 1 && klo[pos] == lo && khi[pos] == hi) {
+        *found = true;
+        return pos;
+      }
+      if (st == 2 && first_tomb < 0) first_tomb = static_cast<int64_t>(pos);
+      pos = (pos + 1) & mask;
+    }
+  }
+
+  void insert(uint64_t hi, uint64_t lo, int64_t v) {
+    rehash_if_needed();
+    bool found = false;
+    uint64_t pos = find(hi, lo, &found);
+    if (!found) {
+      if (state[pos] == 0) ++filled;
+      khi[pos] = hi;
+      klo[pos] = lo;
+      state[pos] = 1;
+      vals[pos].clear();
+      ++live;
+    }
+    vals[pos].push_back(v);
+    ++total_vals;
+  }
+
+  // Removes one occurrence of v (swap-remove: bag semantics). Returns true if found.
+  bool remove(uint64_t hi, uint64_t lo, int64_t v) {
+    bool found = false;
+    uint64_t pos = find(hi, lo, &found);
+    if (!found) return false;
+    std::vector<int64_t>& bag = vals[pos];
+    for (size_t i = 0; i < bag.size(); ++i) {
+      if (bag[i] == v) {
+        bag[i] = bag.back();
+        bag.pop_back();
+        --total_vals;
+        if (bag.empty()) {
+          state[pos] = 2;
+          bag.shrink_to_fit();
+          --live;
+        }
+        return true;
+      }
+    }
+    return false;
+  }
+
+  const std::vector<int64_t>* get(uint64_t hi, uint64_t lo) const {
+    bool found = false;
+    uint64_t pos = find(hi, lo, &found);
+    return found ? &vals[pos] : nullptr;
+  }
+};
+
+}  // namespace
+
+void* pwtpu_mm_new() { return new MultiMap(); }
+
+void pwtpu_mm_free(void* h) { delete static_cast<MultiMap*>(h); }
+
+int64_t pwtpu_mm_total(void* h) { return static_cast<MultiMap*>(h)->total_vals; }
+
+void pwtpu_mm_insert(void* h, const uint64_t* keys, const int64_t* values,
+                     int64_t n) {
+  MultiMap* mm = static_cast<MultiMap*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* k = key_hi_lo(keys, i);
+    mm->insert(k[0], k[1], values[i]);
+  }
+}
+
+// out_found (optional): 1 where an occurrence was removed.
+void pwtpu_mm_remove(void* h, const uint64_t* keys, const int64_t* values,
+                     int64_t n, uint8_t* out_found) {
+  MultiMap* mm = static_cast<MultiMap*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* k = key_hi_lo(keys, i);
+    bool ok = mm->remove(k[0], k[1], values[i]);
+    if (out_found != nullptr) out_found[i] = ok ? 1 : 0;
+  }
+}
+
+// Per-probe-row match counts; returns the total (CSR sizing pass).
+int64_t pwtpu_mm_count(void* h, const uint64_t* keys, int64_t n,
+                       int64_t* out_counts) {
+  const MultiMap* mm = static_cast<const MultiMap*>(h);
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* k = key_hi_lo(keys, i);
+    const std::vector<int64_t>* bag = mm->get(k[0], k[1]);
+    int64_t c = bag != nullptr ? static_cast<int64_t>(bag->size()) : 0;
+    out_counts[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+// CSR fill pass: out_values must hold the total from pwtpu_mm_count, laid out
+// row-major in probe order.
+void pwtpu_mm_fill(void* h, const uint64_t* keys, int64_t n,
+                   int64_t* out_values) {
+  const MultiMap* mm = static_cast<const MultiMap*>(h);
+  int64_t w = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* k = key_hi_lo(keys, i);
+    const std::vector<int64_t>* bag = mm->get(k[0], k[1]);
+    if (bag == nullptr) continue;
+    for (int64_t v : *bag) out_values[w++] = v;
+  }
+}
+
+// Dump every (key, value) pair; buffers sized by pwtpu_mm_total.
+void pwtpu_mm_items(void* h, uint64_t* out_keys, int64_t* out_values) {
+  const MultiMap* mm = static_cast<const MultiMap*>(h);
+  int64_t j = 0;
+  for (uint64_t pos = 0; pos <= mm->mask; ++pos) {
+    if (mm->state[pos] != 1) continue;
+    for (int64_t v : mm->vals[pos]) {
+      out_keys[2 * j] = mm->khi[pos];
+      out_keys[2 * j + 1] = mm->klo[pos];
+      out_values[j] = v;
+      ++j;
+    }
+  }
+}
+
 }  // extern "C"

@@ -15,7 +15,7 @@ from typing import Any, Dict, Iterable, Mapping, Sequence
 import numpy as np
 
 from pathway_tpu.internals import dtype as dt
-from pathway_tpu.internals.keys import KEY_DTYPE, Pointer, key_bytes, keys_to_pointers
+from pathway_tpu.internals.keys import KEY_DTYPE, Pointer, keys_to_pointers
 
 
 class Error:
@@ -134,28 +134,40 @@ class Delta:
         """Cancel matching (+1, -1) rows with identical key+values within the batch.
 
         Rows are identified by (key, xxh3-128 content signature); the signature batch
-        rides the native typed hasher (``keys_from_values``), so consolidation is one
-        vectorized pass instead of a per-row token loop (the DD ``consolidate``
-        counterpart at commit granularity)."""
+        rides the native typed hasher (``keys_from_values``) and rows group through the
+        native ``KeyIndex`` in O(n), so consolidation is one vectorized pass instead of
+        a per-row token loop (the DD ``consolidate`` counterpart at commit granularity).
+        A single-signed batch (pure inserts or pure retracts) can never cancel and
+        passes through untouched."""
         if len(self) == 0:
             return self
+        if (self.diffs > 0).all() or (self.diffs < 0).all():
+            return self  # cancellation needs opposite signs
         from pathway_tpu.internals.keys import KEY_DTYPE as _KD
         from pathway_tpu.internals.keys import keys_from_values
 
         sig = keys_from_values(list(self.columns.values()))
-        combo = np.zeros(len(self), dtype=[("k", _KD), ("s", _KD)])
-        combo["k"] = self.keys
+        # mix the row key into the content fingerprint (both already xxh3-uniform):
+        # the combined 128 bits identify (key, values) rows for grouping
+        combo = np.zeros(len(self), dtype=_KD)
         if len(sig):
-            combo["s"] = sig
-        uniq, first_idx, inverse = np.unique(
-            combo, return_index=True, return_inverse=True
-        )
-        if len(uniq) == len(self):
+            combo["hi"] = self.keys["hi"] * np.uint64(0x9E3779B97F4A7C15) + sig["hi"]
+            combo["lo"] = self.keys["lo"] * np.uint64(0xC2B2AE3D27D4EB4F) + sig["lo"]
+        else:
+            combo["hi"], combo["lo"] = self.keys["hi"], self.keys["lo"]
+        from pathway_tpu.engine.index import KeyIndex
+
+        grouper = KeyIndex(len(self))
+        inverse, is_new = grouper.upsert(combo)
+        n_groups = grouper.slot_bound()
+        if n_groups == len(self):
             return self  # all rows distinct: nothing cancels
-        net = np.zeros(len(uniq), dtype=np.int64)
+        net = np.zeros(n_groups, dtype=np.int64)
         np.add.at(net, inverse, self.diffs)
-        order = np.argsort(first_idx, kind="stable")  # first-appearance order
-        keep = order[net[order] != 0]
+        # a fresh index assigns dense slots in first-appearance order, so the rows
+        # flagged is_new ARE the per-slot first occurrences, already slot-ordered
+        first_idx = np.nonzero(is_new)[0]
+        keep = np.nonzero(net != 0)[0]
         idx = first_idx[keep]
         out = self.select(idx)
         out.diffs = net[keep]
@@ -173,29 +185,67 @@ class Delta:
         return out
 
 
+def grow_column(col: np.ndarray, new_cap: int) -> np.ndarray:
+    """Resize a slot-indexed value array, preserving dtype and contents."""
+    out = np.empty(new_cap, dtype=col.dtype)
+    out[: len(col)] = col
+    if col.dtype == object:
+        out[len(col) :] = None
+    return out
+
+
+def adopt_dtype(storage: np.ndarray, incoming: np.ndarray) -> np.ndarray:
+    """Converge a slot column's dtype with an incoming delta column's dtype.
+
+    Columns are typed by what actually flows through them (schema-driven upstream);
+    a dtype conflict across commits demotes the storage to object — correctness
+    over speed for heterogeneous streams."""
+    if storage.dtype == incoming.dtype or incoming.dtype == object:
+        if storage.dtype != object and incoming.dtype == object:
+            return storage.astype(object)
+        return storage
+    if storage.dtype == object:
+        return storage
+    promoted = np.promote_types(storage.dtype, incoming.dtype)
+    if promoted == storage.dtype:
+        return storage
+    try:
+        return storage.astype(promoted)
+    except (TypeError, ValueError):
+        return storage.astype(object)
+
+
 class StateTable:
     """Materialized keyed state: the arrangement replacement.
 
-    Maintains insertion-ordered dense arrays with a hash index key->slot and a free list.
-    ``apply`` ingests a Delta (retractions then insertions).
+    Struct-of-arrays with SCHEMA-DRIVEN dtypes: each value column keeps the dtype of
+    the deltas flowing through it (int64/float64/bool typed arrays; object only for
+    strings/Json/ndarray cells), so downstream kernels gather typed batches without
+    re-boxing. The key->slot map is the native open-addressing ``KeyIndex``
+    (``csrc/pathway_native.cc``), replacing the reference's DD arrangement position
+    lookup — ``apply``/``lookup`` are O(batch) C calls, never per-row Python.
     """
 
     def __init__(self, column_names: Sequence[str]):
         self.column_names = list(column_names)
+        from pathway_tpu.engine.index import KeyIndex
+
+        self._index = KeyIndex()
         self._capacity = 0
         self._keys = empty_keys()
         self._columns: Dict[str, np.ndarray] = {
             name: np.empty(0, dtype=object) for name in self.column_names
         }
         self._valid = np.empty(0, dtype=bool)
-        self._index: Dict[bytes, int] = {}
-        self._free: list[int] = []
 
     def __len__(self) -> int:
         return len(self._index)
 
-    def _grow(self, needed: int) -> None:
-        new_cap = max(16, self._capacity * 2, self._capacity + needed)
+    def _ensure_capacity(self) -> None:
+        bound = self._index.slot_bound()
+        if bound <= self._capacity:
+            return
+        new_cap = max(16, self._capacity * 2, bound)
         keys = np.zeros(new_cap, dtype=KEY_DTYPE)
         keys[: self._capacity] = self._keys
         self._keys = keys
@@ -203,59 +253,62 @@ class StateTable:
         valid[: self._capacity] = self._valid
         self._valid = valid
         for name in self.column_names:
-            col = np.empty(new_cap, dtype=object)
-            col[: self._capacity] = self._columns[name]
-            self._columns[name] = col
-        self._free.extend(range(self._capacity, new_cap))
+            self._columns[name] = grow_column(self._columns[name], new_cap)
         self._capacity = new_cap
 
     def apply(self, delta: Delta) -> None:
         n = len(delta)
         if n == 0:
             return
-        kbs = key_bytes(delta.keys)
         retract = delta.diffs < 0
         ret_rows = np.nonzero(retract)[0]
         if len(ret_rows):
-            slots = np.empty(len(ret_rows), dtype=np.int64)
-            for j, i in enumerate(ret_rows):
-                slot = self._index.pop(kbs[i], None)
-                if slot is None:
-                    raise KeyError(f"retraction of absent key {delta.keys[i]!r}")
-                slots[j] = slot
+            slots = self._index.remove(delta.keys[ret_rows])
+            missing = slots < 0
+            if missing.any():
+                i = int(ret_rows[np.nonzero(missing)[0][0]])
+                raise KeyError(f"retraction of absent key {delta.keys[i]!r}")
             self._valid[slots] = False
             for name in self.column_names:
-                self._columns[name][slots] = None
-            self._free.extend(slots.tolist())
+                col = self._columns[name]
+                if col.dtype == object:
+                    col[slots] = None  # release refs
         ins_rows = np.nonzero(~retract)[0]
         if len(ins_rows):
-            if len(ins_rows) > len(self._free):
-                self._grow(len(ins_rows) - len(self._free))
-            slots = np.empty(len(ins_rows), dtype=np.int64)
-            for j, i in enumerate(ins_rows):
-                kb = kbs[i]
-                if kb in self._index:
-                    raise KeyError(
-                        f"duplicate key {keys_to_pointers(delta.keys[i:i+1])[0]!r}"
-                    )
-                slot = self._free.pop()
-                self._index[kb] = slot
-                slots[j] = slot
+            if self._capacity == 0:
+                # first allocation: column dtypes come from the first delta through
+                # (schema-driven upstream), making the typed fast paths live
+                for name in self.column_names:
+                    self._columns[name] = np.empty(0, dtype=delta.columns[name].dtype)
+            slots, is_new = self._index.upsert(delta.keys[ins_rows])
+            if not is_new.all():
+                i = int(ins_rows[np.nonzero(~is_new)[0][0]])
+                raise KeyError(
+                    f"duplicate key {keys_to_pointers(delta.keys[i:i+1])[0]!r}"
+                )
+            self._ensure_capacity()
             self._keys[slots] = delta.keys[ins_rows]
             self._valid[slots] = True
             for name in self.column_names:
-                self._columns[name][slots] = delta.columns[name][ins_rows]
+                incoming = delta.columns[name]
+                self._columns[name] = col = adopt_dtype(self._columns[name], incoming)
+                try:
+                    col[slots] = incoming[ins_rows]
+                except (TypeError, ValueError):
+                    # incompatible cell values for the typed column: demote to object
+                    self._columns[name] = col = col.astype(object)
+                    col[slots] = incoming[ins_rows]
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Row slots for keys; -1 when absent."""
-        out = np.empty(len(keys), dtype=np.int64)
-        get = self._index.get
-        for i, kb in enumerate(key_bytes(keys)):
-            out[i] = get(kb, -1)
-        return out
+        return self._index.lookup(keys)
 
     def contains(self, keys: np.ndarray) -> np.ndarray:
         return self.lookup(keys) >= 0
+
+    def gather(self, name: str, slots: np.ndarray) -> np.ndarray:
+        """Typed value batch for the given slots (callers mask absent rows)."""
+        return self._columns[name][slots]
 
     def snapshot(self) -> Delta:
         """Current state as an insertion Delta (used for late subscribers / joins)."""
@@ -283,8 +336,8 @@ class StateTable:
         self.apply(Delta(keys, diffs, columns))
 
     def get_row(self, key_b: bytes) -> dict[str, Any] | None:
-        slot = self._index.get(key_b)
-        if slot is None:
+        slot = int(self._index.lookup(np.frombuffer(key_b, dtype=KEY_DTYPE))[0])
+        if slot < 0:
             return None
         return {name: self._columns[name][slot] for name in self.column_names}
 

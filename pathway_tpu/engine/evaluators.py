@@ -103,29 +103,40 @@ class Evaluator:
                 out = np.empty(len(delta), dtype=object)
                 out[:] = keys_to_pointers(delta.keys)
                 return out
-            retracted: Dict[bytes, Any] | None = None
-            if np.any(delta.diffs < 0):
-                ref_delta = self.runner.current_delta_of(ref.table._node)
-                if ref_delta is not None and len(ref_delta):
-                    neg = np.nonzero(ref_delta.diffs < 0)[0]
-                    if len(neg):
-                        col = ref_delta.columns.get(ref.name)
-                        if col is not None:
-                            retracted = {
-                                ref_delta.keys[i].tobytes(): col[i] for i in neg
-                            }
-            out = np.empty(len(delta), dtype=object)
-            for i in range(len(delta)):
-                kb = delta.keys[i].tobytes()
-                if retracted is not None and delta.diffs[i] < 0 and kb in retracted:
-                    out[i] = retracted[kb]
-                    continue
-                row = state.get_row(kb)
+            slots = state.lookup(delta.keys)
+            hit = slots >= 0
+            if hit.all() and len(state):
+                out = state.gather(ref.name, slots)  # fancy indexing already copied
+            else:
                 # a same-universe reference must hit: a miss means the tables' key sets
                 # genuinely differ (e.g. select over a reindexed table referencing the
                 # pre-reindex table) — poison instead of silently yielding None
-                out[i] = ERROR if row is None else row[ref.name]
-            return ee._tidy(out)
+                out = np.empty(len(delta), dtype=object)
+                out[:] = ERROR
+                if hit.any():
+                    out[hit] = state.gather(ref.name, slots[hit])
+            if np.any(delta.diffs < 0):
+                # retraction rows resolve against the *retracted* upstream values when
+                # the referenced table replaced the key this commit (see docstring)
+                ref_delta = self.runner.current_delta_of(ref.table._node)
+                if ref_delta is not None and len(ref_delta):
+                    neg = np.nonzero(ref_delta.diffs < 0)[0]
+                    ref_col = ref_delta.columns.get(ref.name)
+                    if len(neg) and ref_col is not None:
+                        from pathway_tpu.engine.index import KeyIndex
+
+                        ret_idx = KeyIndex(len(neg))
+                        ret_slots, _ = ret_idx.upsert(ref_delta.keys[neg])
+                        slot_values = np.empty(ret_idx.slot_bound(), dtype=ref_col.dtype)
+                        slot_values[ret_slots] = ref_col[neg]
+                        mine = np.nonzero(delta.diffs < 0)[0]
+                        found = ret_idx.lookup(delta.keys[mine])
+                        use = found >= 0
+                        if use.any():
+                            if out.dtype != object and out.dtype != slot_values.dtype:
+                                out = out.astype(object)
+                            out[mine[use]] = slot_values[found[use]]
+            return ee._tidy(out) if out.dtype == object else out
 
         return resolver
 

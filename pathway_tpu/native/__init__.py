@@ -150,6 +150,44 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.py_object,
     ]
     lib.pwtpu_parse_dsv_rows.restype = ctypes.py_object
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.pwtpu_idx_new.argtypes = [ctypes.c_uint64]
+    lib.pwtpu_idx_new.restype = ctypes.c_void_p
+    lib.pwtpu_idx_free.argtypes = [ctypes.c_void_p]
+    lib.pwtpu_idx_free.restype = None
+    lib.pwtpu_idx_len.argtypes = [ctypes.c_void_p]
+    lib.pwtpu_idx_len.restype = ctypes.c_int64
+    lib.pwtpu_idx_slot_bound.argtypes = [ctypes.c_void_p]
+    lib.pwtpu_idx_slot_bound.restype = ctypes.c_int64
+    lib.pwtpu_idx_upsert.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i64p, u8p]
+    lib.pwtpu_idx_upsert.restype = None
+    lib.pwtpu_idx_lookup.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i64p]
+    lib.pwtpu_idx_lookup.restype = None
+    lib.pwtpu_idx_remove.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i64p]
+    lib.pwtpu_idx_remove.restype = None
+    lib.pwtpu_idx_items.argtypes = [ctypes.c_void_p, u64p, i64p]
+    lib.pwtpu_idx_items.restype = None
+    lib.pwtpu_idx_restore.argtypes = [
+        ctypes.c_void_p, u64p, i64p, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.pwtpu_idx_restore.restype = None
+    lib.pwtpu_mm_new.argtypes = []
+    lib.pwtpu_mm_new.restype = ctypes.c_void_p
+    lib.pwtpu_mm_free.argtypes = [ctypes.c_void_p]
+    lib.pwtpu_mm_free.restype = None
+    lib.pwtpu_mm_total.argtypes = [ctypes.c_void_p]
+    lib.pwtpu_mm_total.restype = ctypes.c_int64
+    lib.pwtpu_mm_insert.argtypes = [ctypes.c_void_p, u64p, i64p, ctypes.c_int64]
+    lib.pwtpu_mm_insert.restype = None
+    lib.pwtpu_mm_remove.argtypes = [ctypes.c_void_p, u64p, i64p, ctypes.c_int64, u8p]
+    lib.pwtpu_mm_remove.restype = None
+    lib.pwtpu_mm_count.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i64p]
+    lib.pwtpu_mm_count.restype = ctypes.c_int64
+    lib.pwtpu_mm_fill.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i64p]
+    lib.pwtpu_mm_fill.restype = None
+    lib.pwtpu_mm_items.argtypes = [ctypes.c_void_p, u64p, i64p]
+    lib.pwtpu_mm_items.restype = None
     _lib = lib
     return _lib
 
