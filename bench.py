@@ -205,7 +205,15 @@ def bench_streaming_window() -> dict:
 
 def bench_engine() -> dict:
     """Streaming wordcount + incremental join vs vectorized-numpy CPU proxies
-    maintaining identical per-commit outputs (VERDICT round-2 item 1)."""
+    maintaining identical per-commit results (VERDICT round-2 item 1).
+
+    Fairness contract, both sides: data preparation (row lists / numpy arrays,
+    sorted build sides) happens OFF the clock; the timed region is per-commit
+    incremental processing + delivery of the update batches. The engine delivers
+    through the vectorized ``pw.io.subscribe(on_batch=...)`` sink (columnar arrays,
+    the TPU-native delivery path); the proxies consume by updating their own
+    result state. Join keys are string entity ids (the representative ETL join);
+    the int-key variant is reported as a secondary metric."""
     import pathway_tpu as pw
     from pathway_tpu.internals import parse_graph as pg
     from pathway_tpu.engine.runner import GraphRunner
@@ -236,42 +244,134 @@ def bench_engine() -> dict:
     ]
     tbl = pw.debug.table_from_rows(pw.schema_builder({"word": str}), rows, is_stream=True)
     out = tbl.groupby(pw.this.word).reduce(pw.this.word, cnt=pw.reducers.count())
-    pw.io.subscribe(out, lambda key, row, time, is_addition: None)
+    delivered = [0]
+    pw.io.subscribe(
+        out, on_batch=lambda keys, diffs, columns, time: delivered.__setitem__(
+            0, delivered[0] + len(keys)
+        )
+    )
     t0 = time.perf_counter()
     GraphRunner(pg.G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
     engine_wc_s = time.perf_counter() - t0
 
-    # join: 200k probe rows against a 20k-row build side, streamed in 10 commits
+    # join: 200k probe rows against a 20k-row build side, streamed in 10 commits.
+    # Keys are string ids; the proxy probes a pre-sorted build side via searchsorted
+    # (numpy's fastest honest string lookup), the engine runs its full incremental
+    # hash join (both sides arranged, retraction-capable).
     nj = 200_000
     build_n = 20_000
-    probe_k = rng.integers(0, build_n, nj)
-    build_names = np.array([f"name{i}" for i in range(build_n)])
-    t0 = time.perf_counter()
     per_j = nj // 10
-    for c in range(10):
-        keys = probe_k[c * per_j : (c + 1) * per_j]
-        pos = np.searchsorted(np.arange(build_n), keys)
-        _ = build_names[pos]  # emitted join rows
-    proxy_join_s = time.perf_counter() - t0
+    probe_pos = rng.integers(0, build_n, nj)
+    build_keys = np.array([f"user_{i:08d}" for i in range(build_n)])
+    build_names = np.array([f"name{i}" for i in range(build_n)])
+    probe_keys = build_keys[probe_pos]
 
-    pg.G.clear()
-    lrows = [(int(k), 2 * (i // per_j), 1) for i, k in enumerate(probe_k.tolist())]
-    lt = pw.debug.table_from_rows(pw.schema_builder({"k": int}), lrows, is_stream=True)
-    rt = pw.debug.table_from_rows(
-        pw.schema_builder({"k2": int, "name": str}),
-        [(i, f"name{i}") for i in range(build_n)],
+    def proxy_join(build_k: np.ndarray, probe_k: np.ndarray) -> float:
+        order = np.argsort(build_k)
+        sb, sn = build_k[order], build_names[order]
+        t0 = time.perf_counter()
+        for c in range(10):
+            keys = probe_k[c * per_j : (c + 1) * per_j]
+            pos = np.searchsorted(sb, keys)
+            _ = keys, sn[pos]  # emitted join rows (key, name)
+        return time.perf_counter() - t0
+
+    def engine_join(schema_k: type, build_vals: list, probe_vals: list) -> float:
+        pg.G.clear()
+        lrows = [(k, 2 * (i // per_j), 1) for i, k in enumerate(probe_vals)]
+        lt = pw.debug.table_from_rows(
+            pw.schema_builder({"k": schema_k}), lrows, is_stream=True
+        )
+        rt = pw.debug.table_from_rows(
+            pw.schema_builder({"k2": schema_k, "name": str}),
+            [(k, f"name{i}") for i, k in enumerate(build_vals)],
+        )
+        j = lt.join(rt, lt.k == rt.k2).select(lt.k, rt.name)
+        pw.io.subscribe(j, on_batch=lambda keys, diffs, columns, time: None)
+        t0 = time.perf_counter()
+        GraphRunner(pg.G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
+        return time.perf_counter() - t0
+
+    proxy_join_s = proxy_join(build_keys, probe_keys)
+    engine_join_s = engine_join(str, build_keys.tolist(), probe_keys.tolist())
+    proxy_join_int_s = proxy_join(np.arange(build_n), probe_pos)
+    engine_join_int_s = engine_join(
+        int, list(range(build_n)), [int(k) for k in probe_pos]
     )
-    j = lt.join(rt, lt.k == rt.k2).select(lt.k, rt.name)
-    pw.io.subscribe(j, lambda key, row, time, is_addition: None)
-    t0 = time.perf_counter()
-    GraphRunner(pg.G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
-    engine_join_s = time.perf_counter() - t0
+
+    # -- incremental join under build-side churn ---------------------------------
+    # After every second probe commit, 2k build rows change their name; every
+    # previously-arrived probe row joining a changed key must emit a retract+insert
+    # pair (the defining obligation of an INCREMENTAL join). The proxy does the same
+    # with the best vectorized numpy available: sorted-build searchsorted for probe
+    # lookups, np.isin over the accumulated probe history for retro updates.
+    churn_rounds = [(2 * r + 1, rng.integers(0, build_n, 2_000)) for r in range(5)]
+
+    def proxy_churn() -> float:
+        order = np.argsort(build_keys)
+        sb = build_keys[order]
+        names_cur = build_names[order].copy()
+        hist: list = []
+        churn = {t: pos for t, pos in churn_rounds}
+        t0 = time.perf_counter()
+        for c in range(10):
+            keys = probe_keys[c * per_j : (c + 1) * per_j]
+            pos = np.searchsorted(sb, keys)
+            _ = keys, names_cur[pos]  # emitted join rows
+            hist.append(keys)
+            if c in churn:
+                changed_pos = np.unique(churn[c])
+                changed_keys = build_keys[changed_pos]
+                sc = np.sort(changed_keys)
+                h = np.concatenate(hist)
+                hit = h[np.isin(h, sc)]
+                hp = np.searchsorted(sb, hit)
+                old = names_cur[hp]  # retractions carry old values
+                bp = np.searchsorted(sb, changed_keys)
+                names_cur[bp] = np.char.add(build_names[changed_pos], f"_v{c}")
+                new = names_cur[hp]  # re-inserts carry new values
+                _ = hit, old, new  # emitted retract+insert update pairs
+        return time.perf_counter() - t0
+
+    def engine_churn() -> float:
+        pg.G.clear()
+        lrows = [(k, 4 * (i // per_j), 1) for i, k in enumerate(probe_keys.tolist())]
+        lt = pw.debug.table_from_rows(
+            pw.schema_builder({"k": str}), lrows, is_stream=True
+        )
+        rrows: list = [
+            (k, f"name{i}", 0, 1) for i, k in enumerate(build_keys.tolist())
+        ]
+        current = {k: f"name{i}" for i, k in enumerate(build_keys.tolist())}
+        for c, pos in churn_rounds:
+            t = 4 * c + 2  # between probe commits c and c+1
+            for p in np.unique(pos).tolist():
+                k = build_keys[p]
+                rrows.append((k, current[k], t, -1))
+                current[k] = f"name{p}_v{c}"
+                rrows.append((k, current[k], t, 1))
+        rt = pw.debug.table_from_rows(
+            pw.schema_builder({"k2": str, "name": str}), rrows, is_stream=True
+        )
+        j = lt.join(rt, lt.k == rt.k2).select(lt.k, rt.name)
+        pw.io.subscribe(j, on_batch=lambda keys, diffs, columns, time: None)
+        t0 = time.perf_counter()
+        GraphRunner(pg.G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
+        return time.perf_counter() - t0
+
+    proxy_churn_s = proxy_churn()
+    engine_churn_s = engine_churn()
 
     return {
         "wordcount_rows_per_s": round(n / engine_wc_s, 1),
         "wordcount_vs_numpy": round(proxy_wc_s / engine_wc_s, 3),
+        "wordcount_updates_delivered": delivered[0],
         "join_rows_per_s": round(nj / engine_join_s, 1),
         "join_vs_numpy": round(proxy_join_s / engine_join_s, 3),
+        "join_int_rows_per_s": round(nj / engine_join_int_s, 1),
+        "join_int_vs_numpy": round(proxy_join_int_s / engine_join_int_s, 3),
+        "join_churn_rows_per_s": round(nj / engine_churn_s, 1),
+        "join_churn_vs_numpy": round(proxy_churn_s / engine_churn_s, 3),
     }
 
 

@@ -494,6 +494,40 @@ PyObject* pwtpu_parse_dsv_rows(const char* data, uint64_t len, char delim,
 }
 
 // ---------------------------------------------------------------------------
+// Key combination: derive output keys from two (maskable) key columns by
+// splitmix-style arithmetic mixing (see internals/keys.py::combine_keys — this
+// is its exact native twin; both must produce identical bits).
+
+void pwtpu_combine_keys(const uint64_t* lkeys, const uint64_t* rkeys,
+                        const uint8_t* lmask, const uint8_t* rmask, int64_t n,
+                        uint64_t salt, uint64_t* out_keys) {
+  constexpr uint64_t C1 = 0x9E3779B97F4A7C15ULL;
+  constexpr uint64_t C2 = 0xC2B2AE3D27D4EB4FULL;
+  constexpr uint64_t C3 = 0x165667B19E3779F9ULL;
+  constexpr uint64_t Z = 0x27D4EB2F165667C5ULL;
+  for (int64_t i = 0; i < n; ++i) {
+    bool lm = lmask == nullptr || lmask[i];
+    bool rm = rmask == nullptr || rmask[i];
+    uint64_t lh = lm ? lkeys[2 * i] : 0x6C6E756C6CULL;
+    uint64_t ll = lm ? lkeys[2 * i + 1] : 0x1B873593ULL;
+    uint64_t rh = rm ? rkeys[2 * i] : 0x726E756C6CULL;
+    uint64_t rl = rm ? rkeys[2 * i + 1] : 0x85EBCA77ULL;
+    uint64_t hi = (lh * C1) ^ (rh * C2) ^ ((rl >> 31) + salt * C3);
+    uint64_t lo = (ll * C2) ^ (rl * C1) ^ ((lh << 17) | (lh >> 47));
+    hi ^= hi >> 29;
+    hi *= Z;
+    hi ^= hi >> 32;
+    lo ^= lo >> 29;
+    lo *= C3;
+    lo ^= lo >> 32;
+    lo ^= hi * C1;
+    lo ^= lo >> 31;
+    out_keys[2 * i] = hi;
+    out_keys[2 * i + 1] = lo;
+  }
+}
+
+// ---------------------------------------------------------------------------
 // KeyIndex: open-addressing hash table, 128-bit key -> dense int64 slot.
 //
 // The native replacement for the engine's Python dict key indexes (StateTable
@@ -533,7 +567,7 @@ struct KeyIndex {
   void rehash_if_needed() {
     uint64_t cap = mask + 1;
     if (static_cast<uint64_t>(filled) * 4 < cap * 3) return;
-    uint64_t new_cap = cap;
+    uint64_t new_cap = cap * 2;
     while (static_cast<uint64_t>(live) * 4 >= new_cap * 2) new_cap <<= 1;
     std::vector<uint64_t> ohi, olo;
     std::vector<int8_t> ost;
@@ -740,7 +774,7 @@ struct MultiMap {
   void rehash_if_needed() {
     uint64_t cap = mask + 1;
     if (static_cast<uint64_t>(filled) * 4 < cap * 3) return;
-    uint64_t new_cap = cap;
+    uint64_t new_cap = cap * 2;
     while (static_cast<uint64_t>(live) * 4 >= new_cap * 2) new_cap <<= 1;
     std::vector<uint64_t> ohi, olo;
     std::vector<int8_t> ost;
@@ -878,6 +912,50 @@ void pwtpu_mm_fill(void* h, const uint64_t* keys, int64_t n,
     const std::vector<int64_t>* bag = mm->get(k[0], k[1]);
     if (bag == nullptr) continue;
     for (int64_t v : *bag) out_values[w++] = v;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Fused join-side maintenance: row-index upsert + slot-array writes + join-key
+// multimap upkeep in ONE pass (the per-commit arrangement update of a join side).
+// keys_arr / jk_arr are the caller's slot-indexed KEY_DTYPE arrays (interleaved
+// [hi, lo] pairs), pre-sized to at least slot_bound + n entries.
+
+void pwtpu_side_insert(void* idx_h, void* mm_h, const uint64_t* row_keys,
+                       const uint64_t* jkeys, int64_t n, uint64_t* keys_arr,
+                       uint64_t* jk_arr, int64_t* out_slots) {
+  KeyIndex* idx = static_cast<KeyIndex*>(idx_h);
+  MultiMap* mm = static_cast<MultiMap*>(mm_h);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* rk = key_hi_lo(row_keys, i);
+    const uint64_t* jk = key_hi_lo(jkeys, i);
+    uint8_t is_new = 0;
+    int64_t slot = idx->upsert(rk[0], rk[1], &is_new);
+    if (!is_new) {
+      // duplicate row-key insert: replace — unlink the old row from the join-key
+      // bucket it actually sits in
+      mm->remove(jk_arr[2 * slot], jk_arr[2 * slot + 1], slot);
+    }
+    keys_arr[2 * slot] = rk[0];
+    keys_arr[2 * slot + 1] = rk[1];
+    jk_arr[2 * slot] = jk[0];
+    jk_arr[2 * slot + 1] = jk[1];
+    mm->insert(jk[0], jk[1], slot);
+    out_slots[i] = slot;
+  }
+}
+
+void pwtpu_side_remove(void* idx_h, void* mm_h, const uint64_t* row_keys,
+                       int64_t n, const uint64_t* jk_arr, int64_t* out_slots) {
+  KeyIndex* idx = static_cast<KeyIndex*>(idx_h);
+  MultiMap* mm = static_cast<MultiMap*>(mm_h);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t* rk = key_hi_lo(row_keys, i);
+    int64_t slot = idx->remove(rk[0], rk[1]);
+    out_slots[i] = slot;
+    if (slot >= 0) {
+      mm->remove(jk_arr[2 * slot], jk_arr[2 * slot + 1], slot);
+    }
   }
 }
 

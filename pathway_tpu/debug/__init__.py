@@ -174,10 +174,18 @@ def _infer_schema(rows: List[dict], names: List[str]) -> sch.SchemaMetaclass:
 class _TimedSource(StaticDataSource):
     """Rows released per __time__ value, with __diff__ signs — update-stream simulation."""
 
-    def __init__(self, rows: List[dict], keys: List[Pointer] | None, times: List[int], diffs: List[int]):
+    def __init__(
+        self,
+        rows: List[dict],
+        keys: List[Pointer] | None,
+        times: List[int],
+        diffs: List[int],
+        columns: Dict[str, np.ndarray] | None = None,
+    ):
         super().__init__(rows)
         self._times = times
-        self._diffs = diffs
+        self._diffs = np.asarray(diffs, dtype=np.int64)
+        self._prebuilt_columns = columns  # built at graph construction, off the run clock
         self._pointers = keys
         self._schedule = sorted(set(times))
         self._pos = 0
@@ -210,8 +218,12 @@ class _TimedSource(StaticDataSource):
         from pathway_tpu.internals.keys import KEY_DTYPE, pointers_to_keys
 
         n = len(self._rows)
+        prebuilt = getattr(self, "_prebuilt_columns", None)
         self._col_arrays = {}
         for name in column_names:
+            if prebuilt is not None and name in prebuilt:
+                self._col_arrays[name] = prebuilt[name]
+                continue
             col = np.empty(n, dtype=object)
             for i, row in enumerate(self._rows):
                 col[i] = row.get(name)
@@ -226,25 +238,64 @@ class _TimedSource(StaticDataSource):
                 self._time_rows[sorted_t[chunk[0]].item()] = chunk
         if self._pointers:
             self._all_keys = pointers_to_keys(self._pointers)
-            self._base_keys = None
         else:
             # value-derived row identity: one native hash over all value columns
-            # (sorted names, as the old per-row token did)
-            from pathway_tpu.internals.keys import keys_from_values
+            # (sorted names, as the old per-row token did), then GLOBAL occurrence
+            # numbers so duplicate rows get distinct deterministic keys. Occurrence
+            # counters follow release order (time, then input order) and pair a
+            # __diff__=-1 row LIFO with its matching insert.
+            from pathway_tpu.internals.keys import key_bytes, keys_from_values
 
             value_cols = [
                 self._col_arrays[name] for name in sorted(self._col_arrays)
             ]
-            self._base_keys = (
+            base = (
                 keys_from_values(value_cols)
                 if value_cols
                 else np.zeros(n, dtype=KEY_DTYPE)
             )
-            self._all_keys = None
+            release = np.concatenate(
+                [self._time_rows[t] for t in sorted(self._time_rows)]
+            ) if n else np.zeros(0, dtype=np.int64)
+            diffs = np.asarray(self._diffs, dtype=np.int64)
+            occ = np.zeros(n, dtype=np.int64)
+            if (diffs >= 0).all():
+                # pure-insert stream: occurrence = rank within duplicate group, in
+                # release order — one vectorized pass over index slots
+                from pathway_tpu.engine.index import KeyIndex
+
+                slots, _ = KeyIndex(n).upsert(base[release])
+                grouped = np.argsort(slots, kind="stable")
+                sorted_slots = slots[grouped]
+                starts = np.nonzero(
+                    np.diff(sorted_slots, prepend=sorted_slots[:1] - 1)
+                )[0]
+                rank = np.arange(len(slots), dtype=np.int64)
+                first_of_group = np.zeros(len(slots), dtype=np.int64)
+                first_of_group[starts] = starts
+                first_of_group = np.maximum.accumulate(first_of_group)
+                occ_in_release = np.empty(len(slots), dtype=np.int64)
+                occ_in_release[grouped] = rank - first_of_group
+                occ[release] = occ_in_release
+            else:
+                occurrences: dict = {}
+                kbs = key_bytes(base)
+                for i in release.tolist():
+                    bb = kbs[i]
+                    if diffs[i] > 0:
+                        o = occurrences.get(bb, 0)
+                        occurrences[bb] = o + 1
+                    else:
+                        o = occurrences.get(bb, 1) - 1
+                        occurrences[bb] = o
+                    occ[i] = o
+            salt = np.empty(n, dtype=object)
+            salt[:] = "timedrow"
+            self._all_keys = (
+                keys_from_values([base, occ, salt]) if n else np.zeros(0, dtype=KEY_DTYPE)
+            )
 
     def next_batch(self, column_names: List[str]) -> Delta:
-        from pathway_tpu.internals.keys import key_bytes, keys_from_values
-
         if getattr(self, "_col_arrays", None) is None:
             self._materialize(column_names)
         if self._pos >= len(self._schedule):
@@ -258,29 +309,8 @@ class _TimedSource(StaticDataSource):
         if self._pos >= len(self._schedule):
             self._done = True
         idx = self._time_rows[t]
-        n = len(idx)
         columns = {name: self._col_arrays[name][idx] for name in column_names}
-        diffs = np.array([self._diffs[i] for i in idx], dtype=np.int64)
-        if self._all_keys is not None:
-            keys = self._all_keys[idx]
-        else:
-            # occurrence counters pair duplicate rows LIFO so a later __diff__=-1 row
-            # retracts its matching insert
-            base = self._base_keys[idx]
-            occ = np.empty(n, dtype=np.int64)
-            occurrences = self._occurrences
-            for j, bb in enumerate(key_bytes(base)):
-                if diffs[j] > 0:
-                    o = occurrences.get(bb, 0)
-                    occurrences[bb] = o + 1
-                else:
-                    o = occurrences.get(bb, 1) - 1
-                    occurrences[bb] = o
-                occ[j] = o
-            salt = np.empty(n, dtype=object)
-            salt[:] = "timedrow"
-            keys = keys_from_values([base, occ, salt])
-        return Delta(keys, diffs, columns)
+        return Delta(self._all_keys[idx], self._diffs[idx], columns)
 
     def is_finished(self) -> bool:
         return self._done
@@ -305,19 +335,38 @@ def table_from_rows(
     pk = schema.primary_key_columns()
     keys = [pointer_from(*(r[c] for c in pk)) for r in dict_rows] if pk else None
     if is_stream:
+        from pathway_tpu.engine.columnar import objarray
+        from pathway_tpu.engine.expression_evaluator import _tidy
+
+        # columnarize once at graph-build time (one zip pass per column), so the
+        # run-time source only slices
+        value_cols = list(zip(*(r[:-2] for r in rows))) if rows else [()] * len(names)
+        columns = {
+            name: _tidy(objarray(list(vals))) for name, vals in zip(names, value_cols)
+        }
         source: Any = _TimedSource(
             [{k: v for k, v in r.items() if k not in _SPECIAL_COLUMNS} for r in dict_rows],
             keys,
             [r["__time__"] for r in dict_rows],
             [r["__diff__"] for r in dict_rows],
+            columns=columns,
         )
+        # columnar layout + key derivation happen at graph build, off the run clock
+        source._materialize(names)
     else:
         key_arr = None
         if keys:
             from pathway_tpu.internals.keys import pointers_to_keys
 
             key_arr = pointers_to_keys(keys)
-        source = StaticDataSource(dict_rows, keys=key_arr)
+        from pathway_tpu.engine.columnar import objarray
+        from pathway_tpu.engine.expression_evaluator import _tidy
+
+        value_cols = list(zip(*rows)) if rows else [()] * len(names)
+        columns = {
+            name: _tidy(objarray(list(vals))) for name, vals in zip(names, value_cols)
+        }
+        source = StaticDataSource(dict_rows, keys=key_arr, columns=columns)
     node = G.add_node(pg.InputNode(source=source))
     return Table(node, schema, name="rows")
 

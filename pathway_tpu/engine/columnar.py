@@ -215,6 +215,18 @@ def adopt_dtype(storage: np.ndarray, incoming: np.ndarray) -> np.ndarray:
         return storage.astype(object)
 
 
+def set_cells(storage: np.ndarray, slots: Any, values: np.ndarray) -> np.ndarray:
+    """Write ``values`` into ``storage[slots]``, converging dtypes; returns storage
+    (possibly re-typed — callers must re-assign)."""
+    storage = adopt_dtype(storage, np.asarray(values))
+    try:
+        storage[slots] = values
+    except (TypeError, ValueError):
+        storage = storage.astype(object)
+        storage[slots] = values
+    return storage
+
+
 class StateTable:
     """Materialized keyed state: the arrangement replacement.
 

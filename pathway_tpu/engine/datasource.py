@@ -60,11 +60,20 @@ class DataSource:
 class StaticDataSource(DataSource):
     """All rows present at time 0 (batch mode)."""
 
-    def __init__(self, rows: List[tuple], keys: np.ndarray | None = None, column_names: List[str] | None = None):
-        # rows: list of dicts column->value OR tuples following column_names
+    def __init__(
+        self,
+        rows: List[tuple],
+        keys: np.ndarray | None = None,
+        column_names: List[str] | None = None,
+        columns: Dict[str, np.ndarray] | None = None,
+    ):
+        # rows: list of dicts column->value OR tuples following column_names;
+        # columns: pre-columnarized arrays built at graph construction (off the
+        # run clock), taking precedence over rows
         self._rows = rows
         self._keys = keys
         self._column_names = column_names
+        self._columns = columns
         self._done = False
 
     def on_start(self) -> None:
@@ -92,6 +101,9 @@ class StaticDataSource(DataSource):
         n = len(self._rows)
         columns: Dict[str, np.ndarray] = {}
         for name in column_names:
+            if self._columns is not None and name in self._columns:
+                columns[name] = self._columns[name]
+                continue
             col = np.empty(n, dtype=object)
             for i, row in enumerate(self._rows):
                 col[i] = row[name] if isinstance(row, dict) else row[self._column_names.index(name)]

@@ -24,6 +24,7 @@ from pathway_tpu.internals.keys import (
     Pointer,
     broadcast_key,
     key_bytes,
+    combine_keys,
     keys_from_values,
     keys_to_pointers,
     pointer_from,
@@ -220,32 +221,45 @@ class ConcatEvaluator(Evaluator):
         return Delta.concat(parts, self.output_columns)
 
 
-def _rows_equal(a: Optional[tuple], b: Optional[tuple]) -> bool:
-    if a is None or b is None:
-        return a is b
-    for va, vb in zip(a, b):
-        if va is vb:
-            continue
-        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
-            if not (
-                isinstance(va, np.ndarray)
-                and isinstance(vb, np.ndarray)
-                and np.array_equal(va, vb)
-            ):
-                return False
-        elif not va == vb:
+def _col_neq(old: np.ndarray, new: np.ndarray) -> np.ndarray:
+    """Elementwise inequality tolerant of object cells (ndarray values, exceptions).
+
+    NaN compares unequal to itself, matching the previous per-row tuple compare —
+    a group whose aggregate stays NaN re-emits, which is harmless."""
+    try:
+        res = np.asarray(old != new)
+        if res.dtype == np.bool_ and res.shape == old.shape:
+            return res
+        # object != produced non-scalar cells (ndarray values): per-cell fallback
+    except (TypeError, ValueError):
+        pass
+
+    def cell_neq(a: Any, b: Any) -> bool:
+        if a is b:
             return False
-    return True
+        if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+            return not (
+                isinstance(a, np.ndarray)
+                and isinstance(b, np.ndarray)
+                and np.array_equal(a, b)
+            )
+        try:
+            return not (a == b)
+        except Exception:
+            return True
+
+    return np.frompyfunc(cell_neq, 2, 1)(old, new).astype(bool)
 
 
 class GroupbyEvaluator(Evaluator):
-    """Incremental groupby-reduce (reference ``reduce.rs`` + DD reduce).
+    """Incremental groupby-reduce (reference ``reduce.rs`` + DD reduce), fully columnar.
 
-    The whole commit batch is processed columnar: group keys derive from one vectorized
-    hash (``keys_from_values``, native xxh3), rows map to dense segment ids via
-    ``np.unique``, semigroup reducers (count/sum/avg) update through segment kernels
-    (``pathway_tpu.ops.segment``), multiset reducers batch through ``Counter.update``,
-    and output expressions evaluate once over all touched groups."""
+    Group state is struct-of-arrays indexed by dense slots from the native ``KeyIndex``
+    (group key -> slot): signed row counts, grouping values, one ``ColumnarState`` per
+    reducer leaf (``internals/reducers.py``), and the last-emitted output row per group
+    for change detection. A commit is a handful of vectorized passes — hash, upsert,
+    segment-reduce, gather — with per-group Python only inside non-semigroup reducer
+    fallbacks (the reference's recompute-style reducers)."""
 
     # reducer_leaves is graph config: checkpoints must not replace it — identity (id())
     # keys the leaf-value mapping
@@ -253,11 +267,33 @@ class GroupbyEvaluator(Evaluator):
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
-        self.groups: Dict[bytes, Dict[str, Any]] = {}
-        # per output column that is a reducer tree: list of ReducerExpressions inside
+        from pathway_tpu.engine.index import KeyIndex
+
+        self.gindex = KeyIndex()
+        self._capacity = 0
+        self.gkeys = np.zeros(0, dtype=KEY_DTYPE)
+        self.counts = np.zeros(0, dtype=np.int64)
+        self.last_valid = np.zeros(0, dtype=bool)
+        self.gvals: Dict[str, np.ndarray] = {
+            name: np.empty(0, dtype=object) for name in node.config["grouping_names"]
+        }
+        self.last_cols: Dict[str, np.ndarray] = {
+            name: np.empty(0, dtype=object) for name in self.output_columns
+        }
         self.reducer_leaves: List[expr.ReducerExpression] = []
         self._collect_reducers(node.config["out_exprs"])
+        self.leaf_states = [leaf._reducer.make_state() for leaf in self.reducer_leaves]
         self.seq = 0
+
+    def load_state_dict(self, state: Dict[str, bytes]) -> None:
+        super().load_state_dict(state)
+        if "groups" in self.__dict__:
+            # dict-of-groups checkpoints predate the columnar state; restoring them
+            # silently-empty would corrupt aggregates — fail loudly instead
+            raise RuntimeError(
+                "checkpoint was written by an incompatible (pre-columnar) build; "
+                "clear the persistence directory and re-run"
+            )
 
     def _collect_reducers(self, out_exprs: Dict[str, expr.ColumnExpression]) -> None:
         seen: set[int] = set()
@@ -274,22 +310,38 @@ class GroupbyEvaluator(Evaluator):
         for e in out_exprs.values():
             walk(e)
 
-    def _rows_for_groups(self, groups: List[Dict[str, Any]]) -> List[tuple]:
-        """Output rows (tuples in ``output_columns`` order) for the given groups: the
-        out-expression tree evaluated once, vectorized over all groups, with reducer
-        leaves bound to accumulator values."""
-        if not groups:
-            return []
-        leaf_value_arrays: Dict[int, np.ndarray] = {}
-        for li, leaf in enumerate(self.reducer_leaves):
-            leaf_value_arrays[id(leaf)] = objarray(
-                [g["accs"][li].value() for g in groups]
-            )
-        grouping_names = self.node.config["grouping_names"]
-        gval_arrays = {
-            name: objarray([g["gvals"][gi] for g in groups])
-            for gi, name in enumerate(grouping_names)
+    def _ensure_capacity(self) -> None:
+        bound = self.gindex.slot_bound()
+        if bound <= self._capacity:
+            return
+        cap = max(16, 2 * self._capacity, bound)
+        gkeys = np.zeros(cap, dtype=KEY_DTYPE)
+        gkeys[: self._capacity] = self.gkeys
+        self.gkeys = gkeys
+        self.counts = np.concatenate(
+            [self.counts, np.zeros(cap - len(self.counts), dtype=np.int64)]
+        )
+        valid = np.zeros(cap, dtype=bool)
+        valid[: self._capacity] = self.last_valid
+        self.last_valid = valid
+        from pathway_tpu.engine.columnar import grow_column
+
+        for name in self.gvals:
+            self.gvals[name] = grow_column(self.gvals[name], cap)
+        for name in self.last_cols:
+            self.last_cols[name] = grow_column(self.last_cols[name], cap)
+        for st in self.leaf_states:
+            st.ensure(cap)
+        self._capacity = cap
+
+    def _eval_out(self, slots: np.ndarray) -> Dict[str, np.ndarray]:
+        """Output expressions over the given group slots, vectorized, with reducer
+        leaves bound to their columnar aggregates."""
+        leaf_value_arrays = {
+            id(leaf): st.values(slots)
+            for leaf, st in zip(self.reducer_leaves, self.leaf_states)
         }
+        gval_arrays = {name: self.gvals[name][slots] for name in self.gvals}
 
         class _GroupEval(ee.ExpressionEvaluator):
             def _eval_ReducerExpression(self, re: expr.ReducerExpression) -> np.ndarray:
@@ -298,21 +350,9 @@ class GroupbyEvaluator(Evaluator):
             def _eval_ColumnReference(self, ref: expr.ColumnReference) -> np.ndarray:
                 return gval_arrays[ref.name]
 
-        evaluator = _GroupEval(ee.EvalContext(len(groups), lambda ref: None))
+        evaluator = _GroupEval(ee.EvalContext(len(slots), lambda ref: None))
         out_exprs = self.node.config["out_exprs"]
-        out_cols = [list(evaluator.eval(out_exprs[name])) for name in self.output_columns]
-        return list(zip(*out_cols)) if out_cols else [() for _ in groups]
-
-    def load_state_dict(self, state: Dict[str, bytes]) -> None:
-        super().load_state_dict(state)
-        # checkpoints from builds predating the tuple-row cache lack "row" (or hold
-        # the older dict form)
-        for g in self.groups.values():
-            if isinstance(g.get("row"), dict):
-                g["row"] = tuple(g["row"].get(name) for name in self.output_columns)
-        missing = [g for g in self.groups.values() if "row" not in g]
-        for g, row in zip(missing, self._rows_for_groups(missing)):
-            g["row"] = row
+        return {name: evaluator.eval(out_exprs[name]) for name in self.output_columns}
 
     def _group_keys(self, grouping_vals: List[np.ndarray], n: int, set_id: bool) -> np.ndarray:
         if not grouping_vals:
@@ -360,100 +400,145 @@ class GroupbyEvaluator(Evaluator):
             leaf_args.append(arrays)
         self.seq += n
 
-        # dense segment ids per row
         gkeys = self._group_keys(grouping_vals, n, set_id)
-        uniq, first_idx, inverse = np.unique(
-            gkeys, return_index=True, return_inverse=True
-        )
-        m = len(uniq)
-        uniq_kb = key_bytes(uniq)
+        slots, is_new = self.gindex.upsert(gkeys)
+        self._ensure_capacity()
+        new_slots = slots[is_new]
+        if len(new_slots):
+            # recycled slots start pristine
+            self.counts[new_slots] = 0
+            self.last_valid[new_slots] = False
+            self.gkeys[new_slots] = gkeys[is_new]
+            for st in self.leaf_states:
+                st.reset(new_slots)
+            from pathway_tpu.engine.columnar import set_cells
 
-        # ensure groups exist; snapshot last-emitted rows
-        touched: List[Dict[str, Any]] = []
-        for j in range(m):
-            group = self.groups.get(uniq_kb[j])
-            if group is None:
-                i0 = int(first_idx[j])
-                group = {
-                    "count": 0,
-                    "gvals": tuple(g[i0] for g in grouping_vals),
-                    "accs": [leaf._reducer.make() for leaf in self.reducer_leaves],
-                    "row": None,
-                }
-                self.groups[uniq_kb[j]] = group
-            touched.append(group)
-        old_rows = [g.get("row") for g in touched]
+            for gi, name in enumerate(self.gvals):
+                self.gvals[name] = set_cells(
+                    self.gvals[name], new_slots, np.asarray(grouping_vals[gi])[is_new]
+                )
 
-        # apply the batch to every accumulator
-        from pathway_tpu.ops.segment import segment_count, segment_slices
+        from pathway_tpu.ops.segment import segment_count
 
+        # dense batch segmentation: an O(n + slot_bound) bitmap pass when the batch
+        # is comparable to the live slot space; an O(n log n) sort when a small
+        # commit touches a huge accumulated group space (bitmap would scan it all)
+        bound = self.gindex.slot_bound()
+        if bound <= 4 * n + 1024:
+            seen = np.zeros(bound, dtype=bool)
+            seen[slots] = True
+            uniq_slots = np.nonzero(seen)[0]
+            pos_of_slot = np.empty(bound, dtype=np.int64)
+            pos_of_slot[uniq_slots] = np.arange(len(uniq_slots), dtype=np.int64)
+            inverse = pos_of_slot[slots]
+        else:
+            uniq_slots, inverse = np.unique(slots, return_inverse=True)
+        m = len(uniq_slots)
         cnt_delta = segment_count(inverse, m, weights=diffs)
-        slices = None
-        for li, (leaf, arrays) in enumerate(zip(self.reducer_leaves, leaf_args)):
-            accs = [g["accs"][li] for g in touched]
-            if leaf._reducer.batch_update(
-                accs, arrays, diffs, inverse, m, cnt_delta, key_lo=gkeys["lo"]
-            ):
-                continue
-            if slices is None:
-                slices = segment_slices(inverse, m)
-            order, starts, ends = slices
-            any_retract = bool(np.any(diffs < 0))
-            for j, acc in enumerate(accs):
-                rows = order[starts[j] : ends[j]]
-                if len(rows) == 0:
-                    continue
-                if not any_retract:
-                    acc.insert_many(zip(*(arr[rows] for arr in arrays)))
-                else:
-                    # mixed commit: preserve original row order (retract/insert interleave)
-                    for i in rows:
-                        vals = tuple(arr[i] for arr in arrays)
-                        if diffs[i] > 0:
-                            acc.insert(vals)
-                        else:
-                            acc.retract(vals)
+        counts_after = self.counts[uniq_slots] + cnt_delta
 
-        alive: List[int] = []
-        for j, g in enumerate(touched):
-            g["count"] += int(cnt_delta[j])
-            if g["count"] == 0:
-                del self.groups[uniq_kb[j]]
-            else:
-                alive.append(j)
+        for st, arrays in zip(self.leaf_states, leaf_args):
+            st.update(
+                slots, uniq_slots, inverse, arrays, diffs, cnt_delta, counts_after,
+                key_lo=gkeys["lo"],
+            )
+        self.counts[uniq_slots] = counts_after
 
-        # new output rows for alive groups — one vectorized expression pass
-        new_rows: List[Optional[dict]] = [None] * m
-        for a, row in zip(alive, self._rows_for_groups([touched[j] for j in alive])):
-            new_rows[a] = row
+        # -- emission: retract old rows, insert new rows, per changed group ----
+        alive_mask = counts_after > 0
+        alive_slots = uniq_slots[alive_mask]
+        dead_slots = uniq_slots[~alive_mask]
 
-        # emit (retract old, insert new) for changed groups
-        out_key_idx: List[int] = []
-        out_diffs: List[int] = []
-        out_rows: List[tuple] = []
-        for j in range(m):
-            old, new = old_rows[j], new_rows[j]
-            if _rows_equal(old, new):
-                continue
-            if old is not None:
-                out_key_idx.append(j)
-                out_diffs.append(-1)
-                out_rows.append(old)
-            if new is not None:
-                out_key_idx.append(j)
-                out_diffs.append(1)
-                out_rows.append(new)
-            if uniq_kb[j] in self.groups:
-                self.groups[uniq_kb[j]]["row"] = new
-        if not out_key_idx:
+        new_cols = self._eval_out(alive_slots) if len(alive_slots) else {}
+        had_row_alive = self.last_valid[alive_slots]
+        changed = ~had_row_alive  # groups without a cached row always emit
+        if had_row_alive.any():
+            idx = np.nonzero(had_row_alive)[0]
+            neq = np.zeros(len(idx), dtype=bool)
+            for name in self.output_columns:
+                old = self.last_cols[name][alive_slots[idx]]
+                neq |= _col_neq(old, new_cols[name][idx])
+            changed[idx] |= neq
+
+        # retracts: dead groups with a cached row + changed alive groups with one
+        r_uniq = np.zeros(m, dtype=bool)
+        r_uniq[~alive_mask] = self.last_valid[dead_slots]
+        alive_pos = np.nonzero(alive_mask)[0]
+        r_uniq[alive_pos] = had_row_alive & changed
+        i_uniq = np.zeros(m, dtype=bool)
+        i_uniq[alive_pos] = changed
+
+        if not r_uniq.any() and not i_uniq.any():
+            if len(dead_slots):
+                self._bury(dead_slots)
             return Delta.empty(self.output_columns)
-        keys_arr = uniq[np.array(out_key_idx, dtype=np.int64)]
-        cols_t = list(zip(*out_rows))
-        columns = {
-            name: ee._tidy(objarray(list(vals)))
-            for name, vals in zip(self.output_columns, cols_t)
-        }
-        return Delta(keys_arr, np.array(out_diffs, dtype=np.int64), columns)
+
+        # interleave so each group's retract immediately precedes its insert
+        r_idx = np.nonzero(r_uniq)[0]
+        i_idx = np.nonzero(i_uniq)[0]
+        seqd = np.sort(np.concatenate([r_idx * 2, i_idx * 2 + 1]))
+        is_ins = (seqd % 2) == 1
+        group_pos = seqd // 2
+        ev_slots = uniq_slots[group_pos]
+        out_keys = self.gkeys[ev_slots]
+        out_diffs = np.where(is_ins, 1, -1).astype(np.int64)
+
+        # map uniq position -> position in alive_slots (for gathering new values)
+        alive_rel = np.full(m, -1, dtype=np.int64)
+        alive_rel[alive_pos] = np.arange(len(alive_slots))
+        ins_rel = alive_rel[group_pos[is_ins]]
+
+        from pathway_tpu.engine.columnar import set_cells
+
+        columns: Dict[str, np.ndarray] = {}
+        for name in self.output_columns:
+            old_part = self.last_cols[name][ev_slots[~is_ins]]
+            new_part = new_cols[name][ins_rel] if len(ins_rel) else np.empty(0, dtype=object)
+            if not is_ins.any():
+                columns[name] = old_part
+            elif not (~is_ins).any():
+                columns[name] = new_part
+            else:
+                out = None
+                if old_part.dtype == new_part.dtype and old_part.dtype != object:
+                    out = np.empty(len(is_ins), dtype=old_part.dtype)
+                else:
+                    out = np.empty(len(is_ins), dtype=object)
+                try:
+                    out[~is_ins] = old_part
+                    out[is_ins] = new_part
+                except (TypeError, ValueError):
+                    out = np.empty(len(is_ins), dtype=object)
+                    out[~is_ins] = old_part
+                    out[is_ins] = new_part
+                columns[name] = out
+
+        # update the last-emitted cache
+        changed_slots = alive_slots[changed]
+        if len(changed_slots):
+            for name in self.output_columns:
+                self.last_cols[name] = set_cells(
+                    self.last_cols[name], changed_slots, new_cols[name][changed]
+                )
+            self.last_valid[changed_slots] = True
+        if len(dead_slots):
+            self._bury(dead_slots)
+
+        return Delta(out_keys, out_diffs, columns)
+
+    def _bury(self, dead_slots: np.ndarray) -> None:
+        """A group's multiset emptied: drop it from the index (slot recycles) and
+        release cached object references."""
+        self.last_valid[dead_slots] = False
+        self.gindex.remove(self.gkeys[dead_slots])
+        for name in self.last_cols:
+            col = self.last_cols[name]
+            if col.dtype == object:
+                col[dead_slots] = None
+        for name in self.gvals:
+            col = self.gvals[name]
+            if col.dtype == object:
+                col[dead_slots] = None
 
 
 class DeduplicateEvaluator(Evaluator):
@@ -511,85 +596,131 @@ class DeduplicateEvaluator(Evaluator):
 
 
 class _JoinSide:
-    """Columnar arrangement for one join side: slot-based value arrays plus a
-    join-key hash index. The DD-arrangement stand-in for the join's build state —
-    rows live in struct-of-arrays, so event emission gathers with fancy indexing
-    instead of building per-row dicts (reference keeps these in Rust arrangements,
-    ``dataflow.rs`` join over arranged collections)."""
+    """Columnar arrangement for one join side on native structures: a ``KeyIndex``
+    (row key -> slot), a ``MultiMap`` (join key -> row slots), and slot-indexed value
+    arrays. The DD-arrangement stand-in for the join's build state (reference
+    ``dataflow.rs`` join over arranged collections) — inserts, removals, and probes
+    are O(batch) native calls."""
 
     def __init__(self, names: Iterable[str]):
+        from pathway_tpu.engine.index import KeyIndex, MultiMap
+
         self.names = list(names)
-        self.cap = 0
-        self.keys = np.empty(0, dtype=KEY_DTYPE)
-        self.jk = np.empty(0, dtype=KEY_DTYPE)
+        self.row_index = KeyIndex()
+        self.jkmap = MultiMap()
+        self._capacity = 0
+        self.keys = np.zeros(0, dtype=KEY_DTYPE)
+        self.jk = np.zeros(0, dtype=KEY_DTYPE)
         self.cols: Dict[str, np.ndarray] = {c: np.empty(0, dtype=object) for c in self.names}
-        self.by_jk: Dict[bytes, Dict[bytes, int]] = {}
-        self.by_kb: Dict[bytes, int] = {}
-        self.free: List[int] = []
 
-    def _grow(self, needed: int) -> None:
-        new_cap = max(16, self.cap * 2, self.cap + needed)
+    def _ensure_capacity(self, bound: int | None = None) -> None:
+        if bound is None:
+            bound = self.row_index.slot_bound()
+        if bound <= self._capacity:
+            return
+        from pathway_tpu.engine.columnar import grow_column
 
-        def grown(a: np.ndarray, dtype: Any) -> np.ndarray:
-            out = np.empty(new_cap, dtype=dtype)
-            out[: self.cap] = a
-            return out
-
-        self.keys = grown(self.keys, KEY_DTYPE)
-        self.jk = grown(self.jk, KEY_DTYPE)
+        cap = max(16, 2 * self._capacity, bound)
+        keys = np.empty(cap, dtype=KEY_DTYPE)
+        keys[: self._capacity] = self.keys
+        self.keys = keys
+        jk = np.empty(cap, dtype=KEY_DTYPE)
+        jk[: self._capacity] = self.jk
+        self.jk = jk
         for c in self.names:
-            self.cols[c] = grown(self.cols[c], object)
-        self.free.extend(range(self.cap, new_cap))
-        self.cap = new_cap
+            self.cols[c] = grow_column(self.cols[c], cap)
+        self._capacity = cap
 
-    def alloc(self, k: int) -> np.ndarray:
-        if k > len(self.free):
-            self._grow(k - len(self.free))
-        return np.array([self.free.pop() for _ in range(k)], dtype=np.int64)
+    def insert_batch(
+        self, row_keys: np.ndarray, jkeys: np.ndarray, values: Dict[str, np.ndarray]
+    ) -> np.ndarray:
+        from pathway_tpu.engine.columnar import set_cells
+        from pathway_tpu.engine.index import _NativeKeyIndex, _NativeMultiMap
 
-    def register(self, jkb: bytes, kb: bytes, slot: int) -> None:
-        old = self.by_kb.get(kb)
-        if old is not None:
-            # duplicate key insert: replace (mirrors dict-overwrite semantics).
-            # The old row may sit in a DIFFERENT join-key bucket — find it via its
-            # stored jk, not the incoming one.
-            old_jkb = self.jk[old].tobytes()
-            old_bucket = self.by_jk.get(old_jkb)
-            if old_bucket is not None:
-                old_bucket.pop(kb, None)
-                if not old_bucket:
-                    del self.by_jk[old_jkb]
-            self.free.append(old)
-        bucket = self.by_jk.get(jkb)
-        if bucket is None:
-            bucket = self.by_jk[jkb] = {}
-        bucket[kb] = slot
-        self.by_kb[kb] = slot
+        n = len(row_keys)
+        if isinstance(self.row_index, _NativeKeyIndex) and isinstance(
+            self.jkmap, _NativeMultiMap
+        ):
+            # fused native pass: upsert + duplicate-replace + slot writes + jk-map
+            import ctypes
 
-    def deregister(self, jkb: bytes, kb: bytes) -> int | None:
-        slot = self.by_kb.pop(kb, None)
-        if slot is None:
-            return None
-        bucket = self.by_jk.get(jkb)
-        if bucket is not None:
-            bucket.pop(kb, None)
-            if not bucket:
-                del self.by_jk[jkb]
-        return slot
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            self._ensure_capacity(self.row_index.slot_bound() + n)
+            rk = np.ascontiguousarray(row_keys)
+            jkc = np.ascontiguousarray(jkeys)
+            slots = np.empty(n, dtype=np.int64)
+            self.row_index._lib.pwtpu_side_insert(
+                self.row_index._h, self.jkmap._h,
+                rk.ctypes.data_as(u64p), jkc.ctypes.data_as(u64p), n,
+                self.keys.ctypes.data_as(u64p), self.jk.ctypes.data_as(u64p),
+                slots.ctypes.data_as(i64p),
+            )
+        else:
+            # pure-Python fallback: sequential, mirroring the fused native pass
+            # exactly (within-batch duplicate row keys replace the earlier row,
+            # including its join-key bucket entry)
+            self._ensure_capacity(self.row_index.slot_bound() + n)
+            slots = np.empty(n, dtype=np.int64)
+            one = np.empty(1, dtype=np.int64)
+            for i in range(n):
+                s_arr, new_arr = self.row_index.upsert(row_keys[i : i + 1])
+                s = int(s_arr[0])
+                if not new_arr[0]:
+                    one[0] = s
+                    self.jkmap.remove(self.jk[s : s + 1], one)
+                self.keys[s] = row_keys[i]
+                self.jk[s] = jkeys[i]
+                one[0] = s
+                self.jkmap.insert(jkeys[i : i + 1], one)
+                slots[i] = s
+        for c in self.names:
+            self.cols[c] = set_cells(self.cols[c], slots, values[c])
+        return slots
 
-    def release(self, slots: Iterable[int]) -> None:
-        for slot in slots:
+    def remove_batch(self, row_keys: np.ndarray) -> np.ndarray:
+        """Slots removed per key (-1 when the key was absent)."""
+        from pathway_tpu.engine.index import _NativeKeyIndex, _NativeMultiMap
+
+        n = len(row_keys)
+        if isinstance(self.row_index, _NativeKeyIndex) and isinstance(
+            self.jkmap, _NativeMultiMap
+        ):
+            import ctypes
+
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            rk = np.ascontiguousarray(row_keys)
+            slots = np.empty(n, dtype=np.int64)
+            self.row_index._lib.pwtpu_side_remove(
+                self.row_index._h, self.jkmap._h,
+                rk.ctypes.data_as(u64p), n,
+                self.jk.ctypes.data_as(u64p), slots.ctypes.data_as(i64p),
+            )
+        else:
+            slots = self.row_index.remove(row_keys)
+            present = np.nonzero(slots >= 0)[0]
+            if len(present):
+                self.jkmap.remove(self.jk[slots[present]], slots[present])
+        present = np.nonzero(slots >= 0)[0]
+        if len(present):
+            live = slots[present]
             for c in self.names:
-                self.cols[c][slot] = None
-            self.free.append(slot)
+                col = self.cols[c]
+                if col.dtype == object:
+                    col[live] = None
+        return slots
 
 
 class JoinEvaluator(Evaluator):
     """Symmetric incremental hash join (reference DD join replacement).
 
-    Hot path is columnar: join keys hash in one vectorized pass, the probe loop
-    tracks integer slots only, and all output expressions (plus output-key
-    derivation) evaluate once over the whole event batch."""
+    Hot path is fully columnar: per commit, each side's join keys hash in one
+    vectorized pass, the other side's matches come back as one CSR probe from the
+    native multimap, and emission gathers own-side values straight from the delta
+    (retraction rows carry their retracted values) and other-side values from slot
+    arrays. Outer-join null-row bookkeeping runs per distinct join key, not per row.
+    """
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
@@ -602,20 +733,11 @@ class JoinEvaluator(Evaluator):
 
     def load_state_dict(self, state: Dict[str, bytes]) -> None:
         super().load_state_dict(state)
-        # migrate checkpoints from the dict-of-dicts build (left_map/right_map)
-        for attr, side_name in (("left_map", "left"), ("right_map", "right")):
-            legacy = self.__dict__.pop(attr, None)
-            if not legacy:
-                continue
-            side: _JoinSide = getattr(self, side_name)
-            for jkb, rows in legacy.items():
-                for kb, (ptr, row) in rows.items():
-                    slot = int(side.alloc(1)[0])
-                    side.keys[slot] = np.frombuffer(kb, dtype=KEY_DTYPE)[0]
-                    side.jk[slot] = np.frombuffer(jkb, dtype=KEY_DTYPE)[0]
-                    for c in side.names:
-                        side.cols[c][slot] = row.get(c)
-                    side.register(jkb, kb, slot)
+        if "left_map" in self.__dict__ or "right_map" in self.__dict__:
+            raise RuntimeError(
+                "checkpoint was written by an incompatible (pre-columnar) build; "
+                "clear the persistence directory and re-run"
+            )
 
     def _join_keys(self, side: str, delta: Delta) -> np.ndarray:
         table = self.node.inputs[0 if side == "left" else 1]
@@ -629,144 +751,212 @@ class JoinEvaluator(Evaluator):
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         left_delta, right_delta = input_deltas
-        JK = self.JoinKind
-        # events as parallel lists of (diff, left_slot, right_slot); -1 = null side
-        ev_d: List[int] = []
-        ev_l: List[int] = []
-        ev_r: List[int] = []
-        freed: List[Tuple[_JoinSide, int]] = []
-
-        def run_side(delta: Delta, side_name: str) -> None:
+        parts: List[Delta] = []
+        for delta, side_name in ((left_delta, "left"), (right_delta, "right")):
             if len(delta) == 0:
-                return
-            jkeys = self._join_keys(side_name, delta)
-            is_left = side_name == "left"
-            own = self.left if is_left else self.right
-            other = self.right if is_left else self.left
-            own_null = self.kind in ((JK.LEFT, JK.OUTER) if is_left else (JK.RIGHT, JK.OUTER))
-            other_null = self.kind in ((JK.RIGHT, JK.OUTER) if is_left else (JK.LEFT, JK.OUTER))
+                continue
+            part = self._run_side(delta, side_name)
+            if part is not None and len(part):
+                parts.append(part)
+        if not parts:
+            return Delta.empty(self.output_columns)
+        out = Delta.concat(parts, self.output_columns)
+        return out.consolidated()
 
-            diffs = delta.diffs
-            ins_rows = np.nonzero(diffs > 0)[0]
-            # batch-store insert rows: values land in state arrays before the probe
-            # loop, so events reference slots uniformly
-            ins_slots = own.alloc(len(ins_rows))
-            if len(ins_rows):
-                own.keys[ins_slots] = delta.keys[ins_rows]
-                own.jk[ins_slots] = jkeys[ins_rows]
-                for c in own.names:
-                    own.cols[c][ins_slots] = delta.columns[c][ins_rows]
-            slot_of_row = np.full(len(delta), -1, dtype=np.int64)
-            slot_of_row[ins_rows] = ins_slots
+    def _run_side(self, delta: Delta, side_name: str) -> Delta | None:
+        JK = self.JoinKind
+        is_left = side_name == "left"
+        own = self.left if is_left else self.right
+        other = self.right if is_left else self.left
+        own_null = self.kind in ((JK.LEFT, JK.OUTER) if is_left else (JK.RIGHT, JK.OUTER))
+        other_null = self.kind in ((JK.RIGHT, JK.OUTER) if is_left else (JK.LEFT, JK.OUTER))
 
-            jkb_list = key_bytes(jkeys)
-            kb_list = key_bytes(delta.keys)
+        n = len(delta)
+        diffs = delta.diffs
+        jkeys = self._join_keys(side_name, delta)
 
-            def emit(d: int, own_slot: int, other_slot: int) -> None:
-                ev_d.append(d)
-                if is_left:
-                    ev_l.append(own_slot)
-                    ev_r.append(other_slot)
-                else:
-                    ev_l.append(other_slot)
-                    ev_r.append(own_slot)
+        # one CSR probe against the other side (static during this side's pass)
+        offsets, match_slots = other.jkmap.probe(jkeys)
+        counts = np.diff(offsets)
 
-            for i in range(len(delta)):
-                jkb, kb, d = jkb_list[i], kb_list[i], int(diffs[i])
-                if d > 0:
-                    slot = int(slot_of_row[i])
-                else:
-                    slot = own.by_kb.get(kb, -1)
-                matches = other.by_jk.get(jkb)
-                own_before = len(own.by_jk.get(jkb, ()))
-                if matches:
-                    for oslot in matches.values():
-                        emit(d, slot, oslot)
-                elif own_null:
-                    emit(d, slot, -1)
-                if other_null and matches:
-                    if d > 0 and own_before == 0:
-                        for oslot in matches.values():
-                            emit(-1, -1, oslot)
-                    elif d < 0 and own_before == 1:
-                        for oslot in matches.values():
-                            emit(1, -1, oslot)
-                if d > 0:
-                    own.register(jkb, kb, slot)
-                else:
-                    gone = own.deregister(jkb, kb)
-                    if gone is not None:
-                        freed.append((own, gone))  # release after emission gathers
+        # matched events: row i of the delta x each matching other-side slot
+        ev_row = np.repeat(np.arange(n, dtype=np.int64), counts)
+        ev_other = match_slots
+        ev_d = np.repeat(diffs, counts)
 
-        run_side(left_delta, "left")
-        run_side(right_delta, "right")
+        null_rows = np.zeros(0, dtype=np.int64)
+        null_d = np.zeros(0, dtype=np.int64)
+        flip_slots = np.zeros(0, dtype=np.int64)
+        flip_d = np.zeros(0, dtype=np.int64)
+        if own_null:
+            # unmatched rows of a LEFT/OUTER side emit with the other side null
+            unmatched = np.nonzero(counts == 0)[0]
+            null_rows = unmatched
+            null_d = diffs[unmatched]
+        if other_null and len(match_slots):
+            # other-side rows flip between "null row" and "matched": when this side's
+            # distinct join key goes 0 -> >0 rows, retract the other side's null rows;
+            # on >0 -> 0, re-emit them. Tracked per distinct join key.
+            from pathway_tpu.engine.index import KeyIndex
 
-        try:
-            if not ev_d:
-                return Delta.empty(self.output_columns)
-            return self._emit(
-                np.array(ev_d, dtype=np.int64),
-                np.array(ev_l, dtype=np.int64),
-                np.array(ev_r, dtype=np.int64),
-            ).consolidated()
-        finally:
-            # slots freed only after _emit gathered their values
-            for side, slot in freed:
-                side.release([slot])
+            uidx = KeyIndex(n)
+            uslot, first = uidx.upsert(jkeys)
+            n_keys = uidx.slot_bound()
+            base = np.zeros(n_keys, dtype=np.int64)
+            own_counts, _ = own.jkmap.counts(jkeys[first])
+            base[uslot[first]] = own_counts
+            net = np.zeros(n_keys, dtype=np.int64)
+            np.add.at(net, uslot, diffs)
+            flips: List[tuple] = []
+            went_up = np.nonzero((base == 0) & (net > 0))[0]
+            went_down = np.nonzero((base > 0) & (base + net == 0))[0]
+            if len(went_up) or len(went_down):
+                first_rows = np.nonzero(first)[0]
+                row_of_uslot = np.zeros(n_keys, dtype=np.int64)
+                row_of_uslot[uslot[first_rows]] = first_rows
+                for uj, d in [(j, -1) for j in went_up] + [(j, 1) for j in went_down]:
+                    r = int(row_of_uslot[uj])
+                    s, e = offsets[r], offsets[r + 1]
+                    flips.append((match_slots[s:e], d))
+            if flips:
+                flip_slots = np.concatenate([f[0] for f in flips])
+                flip_d = np.concatenate(
+                    [np.full(len(f[0]), f[1], dtype=np.int64) for f in flips]
+                )
 
-    def _emit(self, ev_d: np.ndarray, ev_l: np.ndarray, ev_r: np.ndarray) -> Delta:
+        # mutate own-side state AFTER all probes/gathers that read it
+        ret_rows = np.nonzero(diffs < 0)[0]
+        if len(ret_rows):
+            own.remove_batch(delta.keys[ret_rows])
+        ins_rows = np.nonzero(diffs > 0)[0]
+        if len(ins_rows):
+            own.insert_batch(
+                delta.keys[ins_rows],
+                jkeys[ins_rows],
+                {c: delta.columns[c][ins_rows] for c in own.names},
+            )
+
+        total = len(ev_row) + len(null_rows) + len(flip_slots)
+        if total == 0:
+            return None
+        return self._emit_side(
+            delta, side_name, other,
+            ev_d, ev_row, ev_other,
+            null_d, null_rows,
+            flip_d, flip_slots,
+        )
+
+    def _emit_side(
+        self,
+        delta: Delta,
+        side_name: str,
+        other: _JoinSide,
+        ev_d: np.ndarray,
+        ev_row: np.ndarray,
+        ev_other: np.ndarray,
+        null_d: np.ndarray,
+        null_rows: np.ndarray,
+        flip_d: np.ndarray,
+        flip_slots: np.ndarray,
+    ) -> Delta:
+        """Assemble one side-pass's output: matched events, own-null rows, and
+        other-side null-row flips, in that order."""
+        is_left = side_name == "left"
         left_table, right_table = self.node.inputs
-        exprs = self.node.config["exprs"]
-        id_expr = self.node.config.get("id_expr")
-        n_ev = len(ev_d)
-        lmask = ev_l >= 0
-        rmask = ev_r >= 0
-        cache: Dict[Tuple[int, str], np.ndarray] = {}
+        n_ev = len(ev_d) + len(null_d) + len(flip_d)
+        n_m, n_nu = len(ev_d), len(null_d)
 
-        def gather(side: _JoinSide, slots: np.ndarray, mask: np.ndarray, name: str) -> np.ndarray:
-            key = (id(side), name)
-            hit = cache.get(key)
-            if hit is not None:
-                return hit
-            out = np.empty(n_ev, dtype=object)
-            out[~mask] = None
-            if name == "id":
-                idx = np.nonzero(mask)[0]
-                ptrs = keys_to_pointers(side.keys[slots[idx]])
-                for a, p in zip(idx, ptrs):
+        # per-event row index into the delta (own side) / slot into other side; -1 null
+        own_rows = np.concatenate(
+            [ev_row, null_rows, np.full(len(flip_d), -1, dtype=np.int64)]
+        )
+        other_slots = np.concatenate(
+            [ev_other, np.full(len(null_d), -1, dtype=np.int64), flip_slots]
+        )
+        out_d = np.concatenate([ev_d, null_d, flip_d])
+        own_mask = own_rows >= 0
+        other_mask = other_slots >= 0
+
+        cache: Dict[str, np.ndarray] = {}
+
+        def own_col(name: str) -> np.ndarray:
+            key = "own:" + name
+            if key not in cache:
+                src = delta.columns[name]
+                if own_mask.all():
+                    out = src[own_rows]
+                else:
+                    out = np.empty(n_ev, dtype=object)
+                    out[own_mask] = src[own_rows[own_mask]]
+                    out[~own_mask] = None
+                cache[key] = out
+            return cache[key]
+
+        def other_col(name: str) -> np.ndarray:
+            key = "other:" + name
+            if key not in cache:
+                src = other.cols[name]
+                if other_mask.all():
+                    out = src[other_slots]
+                else:
+                    out = np.empty(n_ev, dtype=object)
+                    out[other_mask] = src[other_slots[other_mask]]
+                    out[~other_mask] = None
+                cache[key] = out
+            return cache[key]
+
+        def own_ids() -> np.ndarray:
+            key = "own:id"
+            if key not in cache:
+                out = np.empty(n_ev, dtype=object)
+                rows = np.nonzero(own_mask)[0]
+                ptrs = keys_to_pointers(delta.keys[own_rows[rows]])
+                for a, p in zip(rows, ptrs):
                     out[a] = p
-            else:
-                out[mask] = side.cols[name][slots[mask]]
-            cache[key] = out
-            return out
+                out[~own_mask] = None
+                cache[key] = out
+            return cache[key]
+
+        def other_ids() -> np.ndarray:
+            key = "other:id"
+            if key not in cache:
+                out = np.empty(n_ev, dtype=object)
+                rows = np.nonzero(other_mask)[0]
+                ptrs = keys_to_pointers(other.keys[other_slots[rows]])
+                for a, p in zip(rows, ptrs):
+                    out[a] = p
+                out[~other_mask] = None
+                cache[key] = out
+            return cache[key]
 
         def resolver(ref: expr.ColumnReference) -> np.ndarray:
-            if ref.table is left_table:
-                return ee._tidy(gather(self.left, ev_l, lmask, ref.name))
-            if ref.table is right_table:
-                return ee._tidy(gather(self.right, ev_r, rmask, ref.name))
-            raise ValueError(f"join select references foreign table: {ref!r}")
+            own_side = (ref.table is left_table) == is_left
+            if ref.table is not left_table and ref.table is not right_table:
+                raise ValueError(f"join select references foreign table: {ref!r}")
+            if ref.name == "id":
+                return own_ids() if own_side else other_ids()
+            return own_col(ref.name) if own_side else other_col(ref.name)
 
-        columns = {
-            name: ee.evaluate(e, n_ev, resolver) for name, e in exprs.items()
-        }
+        exprs = self.node.config["exprs"]
+        columns = {name: ee.evaluate(e, n_ev, resolver) for name, e in exprs.items()}
 
-        # output keys: id_expr rows (left present) take the evaluated pointer;
-        # the rest hash (left_key, right_key, "join") in one vectorized pass
-        lkeys = np.zeros(n_ev, dtype=KEY_DTYPE)
-        lkeys[lmask] = self.left.keys[ev_l[lmask]]
-        rkeys = np.zeros(n_ev, dtype=KEY_DTYPE)
-        rkeys[rmask] = self.right.keys[ev_r[rmask]]
-        join_salt = np.empty(n_ev, dtype=object)
-        join_salt[:] = "join"
-        keys = keys_from_values([lkeys, rkeys, join_salt], masks=[lmask, rmask, None])
-        if id_expr is not None and np.any(lmask):
+        # output keys: hash (left_key, right_key, "join"); id_expr overrides where
+        # the left side is present
+        own_keys = np.zeros(n_ev, dtype=KEY_DTYPE)
+        own_keys[own_mask] = delta.keys[own_rows[own_mask]]
+        oth_keys = np.zeros(n_ev, dtype=KEY_DTYPE)
+        oth_keys[other_mask] = other.keys[other_slots[other_mask]]
+        lkeys, lmask = (own_keys, own_mask) if is_left else (oth_keys, other_mask)
+        rkeys, rmask = (oth_keys, other_mask) if is_left else (own_keys, own_mask)
+        keys = combine_keys(lkeys, rkeys, lmask, rmask)
+        id_expr = self.node.config.get("id_expr")
+        if id_expr is not None and lmask.any():
             id_vals = ee.evaluate(id_expr, n_ev, resolver)
             for i in np.nonzero(lmask)[0]:
                 p = id_vals[i]
                 if isinstance(p, Pointer):
                     keys[i]["hi"], keys[i]["lo"] = p.hi, p.lo
-        return Delta(keys, ev_d, columns)
+        return Delta(keys, out_d, columns)
 
 
 class UpdateRowsEvaluator(Evaluator):
@@ -1606,6 +1796,7 @@ class OutputEvaluator(Evaluator):
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.callback = node.config.get("callback")
+        self.batch_callback = node.config.get("batch_callback")
         self.on_end = node.config.get("on_end")
         self.input_columns = node.inputs[0].column_names()
 
@@ -1616,6 +1807,14 @@ class OutputEvaluator(Evaluator):
             and not getattr(self.runner, "replay_outputs", True)
         ):
             return Delta.empty([])  # journal replay with silent sinks
+        if self.batch_callback is not None and len(delta):
+            # vectorized delivery: one call per commit, raw columnar arrays
+            self.batch_callback(
+                delta.keys,
+                delta.diffs,
+                {c: delta.columns[c] for c in self.input_columns},
+                self.runner.current_time,
+            )
         if self.callback is not None and len(delta):
             ptrs = keys_to_pointers(delta.keys)
             time = self.runner.current_time

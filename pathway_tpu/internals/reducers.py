@@ -19,7 +19,10 @@ from pathway_tpu.internals import expression as expr
 
 
 class Reducer:
-    """Descriptor of an aggregation; instantiated per group by the engine."""
+    """Descriptor of an aggregation; the engine keeps ONE columnar state per reducer
+    leaf (``make_state``), holding every group's accumulation in slot-indexed arrays —
+    the reference's per-group reducer impls (``reduce.rs:41,56``) flattened into
+    struct-of-arrays so a whole commit updates in vectorized segment kernels."""
 
     name = "reducer"
     semigroup = False  # True when retract is O(1) (subtractable)
@@ -28,28 +31,220 @@ class Reducer:
     def make(self) -> "Accumulator":
         raise NotImplementedError
 
+    def make_state(self) -> "ColumnarState":
+        return _ObjectState(self)
+
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.ANY
 
     def __call__(self, *args: Any, **kwargs: Any) -> expr.ReducerExpression:
         return expr.ReducerExpression(self, *args, **kwargs)
 
-    def batch_update(
+
+class ColumnarState:
+    """Slot-indexed accumulator storage for one reducer leaf across ALL groups.
+
+    ``update`` applies one commit's rows: ``slots[i]`` is row i's group slot,
+    ``uniq_slots``/``inverse`` the batch's dense segmentation (``inverse[i]`` indexes
+    ``uniq_slots``), ``diffs`` the +1/-1 multiplicities. ``key_lo`` carries the group
+    keys' low bits so float segment sums can ride the mesh exchange
+    (``ops/segment.py``)."""
+
+    def ensure(self, capacity: int) -> None:
+        raise NotImplementedError
+
+    def reset(self, slots: np.ndarray) -> None:
+        """Recycled slots start fresh (a new group reused a dead group's slot)."""
+        raise NotImplementedError
+
+    def update(
         self,
-        accs: list["Accumulator"],
+        slots: np.ndarray,
+        uniq_slots: np.ndarray,
+        inverse: np.ndarray,
         arrays: list[np.ndarray],
         diffs: np.ndarray,
-        inverse: np.ndarray,
-        m: int,
-        counts: np.ndarray | None = None,
+        cnt_delta: np.ndarray,
+        counts_after: np.ndarray,
         key_lo: np.ndarray | None = None,
-    ) -> bool:
-        """Vectorized whole-delta update: apply every row to ``accs[inverse[i]]`` at
-        once (``pathway_tpu.ops.segment`` kernels). ``counts`` is the caller's
-        precomputed per-segment signed row count; ``key_lo`` enables the mesh-exchange
-        path for float batches. Return False to fall back to the per-group generic
-        path."""
-        return False
+    ) -> None:
+        raise NotImplementedError
+
+    def values(self, slots: np.ndarray) -> np.ndarray:
+        """Current aggregate per requested slot (vectorized gather)."""
+        raise NotImplementedError
+
+
+def _grow(arr: np.ndarray, capacity: int, fill: Any = 0) -> np.ndarray:
+    if len(arr) >= capacity:
+        return arr
+    out = np.empty(max(capacity, 2 * len(arr), 16), dtype=arr.dtype)
+    out[: len(arr)] = arr
+    out[len(arr) :] = fill
+    return out
+
+
+class _CountState(ColumnarState):
+    def __init__(self) -> None:
+        self.vals = np.zeros(0, dtype=np.int64)
+
+    def ensure(self, capacity: int) -> None:
+        self.vals = _grow(self.vals, capacity)
+
+    def reset(self, slots: np.ndarray) -> None:
+        self.vals[slots] = 0
+
+    def update(self, slots, uniq_slots, inverse, arrays, diffs, cnt_delta, counts_after, key_lo=None) -> None:
+        self.vals[uniq_slots] += cnt_delta
+
+    def values(self, slots: np.ndarray) -> np.ndarray:
+        return self.vals[slots]
+
+
+class _SumState(ColumnarState):
+    """Typed segment-summed totals; object/exotic dtypes fall back to a Python pass.
+
+    ``zero_on_empty``: emptied groups snap back to exact 0 (float drift guard), the
+    _SumAcc semantics."""
+
+    def __init__(self, zero_on_empty: bool) -> None:
+        self.vals: np.ndarray = np.zeros(0, dtype=np.int64)
+        self.dtype_locked = False
+        self.zero_on_empty = zero_on_empty
+
+    def ensure(self, capacity: int) -> None:
+        self.vals = _grow(self.vals, capacity)
+
+    def reset(self, slots: np.ndarray) -> None:
+        self.vals[slots] = None if self.vals.dtype == object else 0
+
+    def _lock_dtype(self, incoming: np.ndarray) -> None:
+        if self.dtype_locked:
+            if incoming.dtype != self.vals.dtype and incoming.dtype != object:
+                promoted = np.promote_types(self.vals.dtype, incoming.dtype)
+                if promoted != self.vals.dtype:
+                    self.vals = self.vals.astype(promoted)
+            return
+        self.dtype_locked = True
+        if incoming.dtype == object or incoming.dtype.kind not in "bif":
+            self.vals = self.vals.astype(object)
+            self.vals[:] = None  # None = untouched; first insert assigns directly
+        elif incoming.dtype.kind == "f":
+            self.vals = self.vals.astype(incoming.dtype)
+
+    def update(self, slots, uniq_slots, inverse, arrays, diffs, cnt_delta, counts_after, key_lo=None) -> None:
+        vals = np.asarray(arrays[0])
+        self._lock_dtype(vals)
+        from pathway_tpu.ops.segment import segment_sum
+
+        if self.vals.dtype == object or vals.dtype == object or vals.dtype.kind not in "bif":
+            if self.vals.dtype != object:
+                self.vals = self.vals.astype(object)
+            for i in range(len(vals)):
+                s = slots[i]
+                contrib = vals[i]
+                cur = self.vals[s]
+                if diffs[i] > 0:
+                    self.vals[s] = contrib if cur is None else cur + contrib
+                else:
+                    self.vals[s] = cur - contrib
+        else:
+            weights = diffs if vals.dtype.kind != "f" else diffs.astype(vals.dtype)
+            sums = segment_sum(vals * weights, inverse, len(uniq_slots), key_lo=key_lo)
+            self.vals[uniq_slots] += sums.astype(self.vals.dtype, copy=False)
+        if self.zero_on_empty:
+            emptied = uniq_slots[counts_after == 0]
+            if len(emptied):
+                # emptied groups snap to the pristine state (float-drift guard)
+                self.vals[emptied] = None if self.vals.dtype == object else 0
+
+    def values(self, slots: np.ndarray) -> np.ndarray:
+        return self.vals[slots]
+
+
+class _AvgState(_SumState):
+    """sum/count; counts mirror the group's signed row count."""
+
+    def __init__(self) -> None:
+        super().__init__(zero_on_empty=False)
+        self.counts = np.zeros(0, dtype=np.int64)
+
+    def ensure(self, capacity: int) -> None:
+        super().ensure(capacity)
+        self.counts = _grow(self.counts, capacity)
+
+    def reset(self, slots: np.ndarray) -> None:
+        super().reset(slots)
+        self.counts[slots] = 0
+
+    def update(self, slots, uniq_slots, inverse, arrays, diffs, cnt_delta, counts_after, key_lo=None) -> None:
+        super().update(slots, uniq_slots, inverse, arrays, diffs, cnt_delta, counts_after, key_lo)
+        self.counts[uniq_slots] += cnt_delta
+
+    def values(self, slots: np.ndarray) -> np.ndarray:
+        sums = self.vals[slots]
+        counts = self.counts[slots]
+        if sums.dtype == object:
+            out = np.empty(len(slots), dtype=object)
+            for i in range(len(slots)):
+                out[i] = sums[i] / counts[i] if counts[i] else None
+            return out
+        safe = np.where(counts == 0, 1, counts)
+        out = sums / safe
+        if (counts == 0).any():
+            out = out.astype(object)
+            out[counts == 0] = None
+        return out
+
+
+class _ObjectState(ColumnarState):
+    """Generic fallback: one Accumulator object per group slot (the recompute-style
+    reducers: min/max/unique/tuple/...)."""
+
+    def __init__(self, reducer: "Reducer") -> None:
+        self.reducer = reducer
+        self.accs = np.empty(0, dtype=object)
+
+    def ensure(self, capacity: int) -> None:
+        if len(self.accs) >= capacity:
+            return
+        old = self.accs
+        self.accs = np.empty(max(capacity, 2 * len(old), 16), dtype=object)
+        self.accs[: len(old)] = old
+
+    def reset(self, slots: np.ndarray) -> None:
+        for s in slots.tolist():
+            self.accs[s] = None
+
+    def update(self, slots, uniq_slots, inverse, arrays, diffs, cnt_delta, counts_after, key_lo=None) -> None:
+        from pathway_tpu.ops.segment import segment_slices
+
+        order, starts, ends = segment_slices(inverse, len(uniq_slots))
+        any_retract = bool(np.any(diffs < 0))
+        for j, s in enumerate(uniq_slots.tolist()):
+            rows = order[starts[j] : ends[j]]
+            if len(rows) == 0:
+                continue
+            acc = self.accs[s]
+            if acc is None:
+                acc = self.accs[s] = self.reducer.make()
+            if not any_retract:
+                acc.insert_many(zip(*(arr[rows] for arr in arrays)))
+            else:
+                # mixed commit: preserve original row order (retract/insert interleave)
+                for i in rows:
+                    vals = tuple(arr[i] for arr in arrays)
+                    if diffs[i] > 0:
+                        acc.insert(vals)
+                    else:
+                        acc.retract(vals)
+
+    def values(self, slots: np.ndarray) -> np.ndarray:
+        out = np.empty(len(slots), dtype=object)
+        for i, s in enumerate(slots.tolist()):
+            acc = self.accs[s]
+            out[i] = acc.value() if acc is not None else None
+        return out
 
 
 class Accumulator:
@@ -95,17 +290,11 @@ class CountReducer(Reducer):
     def make(self) -> Accumulator:
         return _CountAcc()
 
+    def make_state(self) -> ColumnarState:
+        return _CountState()
+
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.INT
-
-    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None, key_lo=None) -> bool:
-        if counts is None:
-            from pathway_tpu.ops.segment import segment_count
-
-            counts = segment_count(inverse, m, weights=diffs)
-        for j, acc in enumerate(accs):
-            acc.n += int(counts[j])
-        return True
 
 
 class _SumAcc(Accumulator):
@@ -130,27 +319,6 @@ class _SumAcc(Accumulator):
         return self.total
 
 
-def _batch_sum_into(accs, arrays, diffs, inverse, m, counts, key_lo, *, zero_on_empty: bool) -> bool:
-    """Shared segment-sum path for _SumAcc/_AvgAcc-shaped accumulators."""
-    vals = np.asarray(arrays[0])
-    if vals.dtype == object or vals.dtype.kind not in "bif":
-        return False
-    from pathway_tpu.ops.segment import segment_count, segment_sum
-
-    # keep float32 batches float32 so the XLA device path stays reachable
-    weights = diffs if vals.dtype.kind != "f" else diffs.astype(vals.dtype)
-    sums = segment_sum(vals * weights, inverse, m, key_lo=key_lo)
-    if counts is None:
-        counts = segment_count(inverse, m, weights=diffs)
-    for j, acc in enumerate(accs):
-        acc.n += int(counts[j])
-        if zero_on_empty and acc.n == 0:
-            acc.total = 0
-        else:
-            acc.total = acc.total + sums[j].item()
-    return True
-
-
 class SumReducer(Reducer):
     name = "sum"
     semigroup = True
@@ -158,8 +326,8 @@ class SumReducer(Reducer):
     def make(self) -> Accumulator:
         return _SumAcc()
 
-    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None, key_lo=None) -> bool:
-        return _batch_sum_into(accs, arrays, diffs, inverse, m, counts, key_lo, zero_on_empty=True)
+    def make_state(self) -> ColumnarState:
+        return _SumState(zero_on_empty=True)
 
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         base = arg_dtypes[0].strip_optional()
@@ -494,8 +662,8 @@ class AvgReducer(Reducer):
     def make(self) -> Accumulator:
         return _AvgAcc()
 
-    def batch_update(self, accs, arrays, diffs, inverse, m, counts=None, key_lo=None) -> bool:
-        return _batch_sum_into(accs, arrays, diffs, inverse, m, counts, key_lo, zero_on_empty=False)
+    def make_state(self) -> ColumnarState:
+        return _AvgState()
 
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.FLOAT

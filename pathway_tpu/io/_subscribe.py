@@ -1,4 +1,11 @@
-"""``pw.io.subscribe`` — per-row change callbacks (parity: reference ``io/subscribe``)."""
+"""``pw.io.subscribe`` — change callbacks (parity: reference ``io/subscribe``).
+
+Two delivery modes: per-row ``on_change(key, row, time, is_addition)`` (the reference
+API), and the TPU-first vectorized ``on_batch(keys, diffs, columns, time)`` which hands
+the subscriber one commit's update batch as columnar numpy arrays (keys: KEY_DTYPE
+structured array; diffs: +1/-1 int64; columns: dict name -> value array) without
+materializing per-row Python objects.
+"""
 
 from __future__ import annotations
 
@@ -10,14 +17,28 @@ from pathway_tpu.internals.parse_graph import G
 
 def subscribe(
     table: Any,
-    on_change: Callable[..., None],
+    on_change: Callable[..., None] | None = None,
     on_end: Callable[[], None] | None = None,
     on_time_end: Callable[[int], None] | None = None,
     name: str | None = None,
+    *,
+    on_batch: Callable[..., None] | None = None,
 ) -> None:
-    """Call ``on_change(key, row, time, is_addition)`` for every row update of ``table``."""
+    """Call ``on_change(key, row, time, is_addition)`` for every row update of
+    ``table``, and/or ``on_batch(keys, diffs, columns, time)`` once per commit."""
+    if on_change is None and on_batch is None:
+        raise ValueError("subscribe needs on_change and/or on_batch")
 
-    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
-        on_change(key=key, row=row, time=time, is_addition=is_addition)
+    callback = None
+    if on_change is not None:
+        def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+            on_change(key=key, row=row, time=time, is_addition=is_addition)
 
-    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=on_end))
+    G.add_node(
+        pg.OutputNode(
+            inputs=[table],
+            callback=callback,
+            batch_callback=on_batch,
+            on_end=on_end,
+        )
+    )

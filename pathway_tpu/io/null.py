@@ -1,4 +1,8 @@
-"""Null sink (parity: reference ``io/null``)."""
+"""Null sink (parity: reference ``io/null`` — ``data_storage.rs:1395`` NullWriter).
+
+The output delta is fully computed and delivered to the sink boundary, then dropped
+without materializing per-row Python objects.
+"""
 
 from __future__ import annotations
 
@@ -9,7 +13,7 @@ from pathway_tpu.internals.parse_graph import G
 
 
 def write(table: Any, name: str | None = None) -> None:
-    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
+    def batch_callback(keys: Any, diffs: Any, columns: dict, time: int) -> None:
         pass
 
-    G.add_node(pg.OutputNode(inputs=[table], callback=callback))
+    G.add_node(pg.OutputNode(inputs=[table], batch_callback=batch_callback))

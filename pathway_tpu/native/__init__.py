@@ -152,6 +152,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.pwtpu_parse_dsv_rows.restype = ctypes.py_object
     i64p = ctypes.POINTER(ctypes.c_int64)
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.pwtpu_combine_keys.argtypes = [
+        u64p, u64p,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64, ctypes.c_uint64, u64p,
+    ]
+    lib.pwtpu_combine_keys.restype = None
     lib.pwtpu_idx_new.argtypes = [ctypes.c_uint64]
     lib.pwtpu_idx_new.restype = ctypes.c_void_p
     lib.pwtpu_idx_free.argtypes = [ctypes.c_void_p]
@@ -188,6 +194,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.pwtpu_mm_fill.restype = None
     lib.pwtpu_mm_items.argtypes = [ctypes.c_void_p, u64p, i64p]
     lib.pwtpu_mm_items.restype = None
+    lib.pwtpu_side_insert.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, u64p, u64p, ctypes.c_int64,
+        u64p, u64p, i64p,
+    ]
+    lib.pwtpu_side_insert.restype = None
+    lib.pwtpu_side_remove.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, u64p, ctypes.c_int64, u64p, i64p,
+    ]
+    lib.pwtpu_side_remove.restype = None
     _lib = lib
     return _lib
 

@@ -57,9 +57,14 @@ def _next_pow2(n: int) -> int:
 # the key-hash exchange (mutable for tests/dryruns to force the collective path).
 MESH_THRESHOLD = 1 << 15
 
-# The mesh path computes in float32 (TPUs have no f64). float64 batches stay on host
-# unless a deployment opts in to the cast — an explicit precision/scale trade.
-MESH_ALLOW_F32_CAST = False
+# Opt-out for the float64 two-float-split mesh policy (set False to force f64 sums
+# onto the exact host reduction even when a mesh is configured).
+MESH_F64_SPLIT = True
+
+# Magnitudes above this risk float32 partial-sum overflow on the mesh (f32 max is
+# ~3.4e38; a 2^15-row batch of equal-sign values needs ~2^15 headroom) — such
+# batches stay on the exact host path.
+_F32_SAFE_MAX = 1e33
 
 
 def segment_sum(
@@ -70,28 +75,38 @@ def segment_sum(
 ) -> np.ndarray:
     """Sum ``values`` into ``num_segments`` buckets given per-row segment ids.
 
-    Exactness contract: integer inputs reduce in int64 on host; float64 reduces on host
-    (TPU would downcast to f32). float32 batches above the device threshold ride XLA.
-    With a default mesh configured (``parallel.set_default_mesh``) and ``key_lo`` given,
-    large float batches route through the mesh exchange (``groupby_sharded``).
+    Exactness contract: integer inputs reduce in int64 on host; small float batches
+    reduce on host. float32 batches above the device threshold ride XLA. With a
+    default mesh configured (``parallel.set_default_mesh``) and ``key_lo`` given,
+    large float batches route through the mesh exchange (``groupby_sharded``) —
+    float64 via a COMPENSATED TWO-FLOAT SPLIT (TPUs have no f64): each value splits
+    into a float32 high part and a float32 residual, both ride the same exchange,
+    and the halves recombine in float64 on host. Input-representation error is
+    eliminated; accumulation error is that of two f32 segment sums (~1e-7 relative
+    per summand), the documented engine policy for mesh-routed f64 reductions.
     """
     values = np.asarray(values)
     segment_ids = np.asarray(segment_ids, dtype=np.int64)
     jax = _jax()
-    if (
-        jax is not None
-        and key_lo is not None
-        and (values.dtype == np.float32 or (values.dtype.kind == "f" and MESH_ALLOW_F32_CAST))
-    ):
+    if jax is not None and key_lo is not None and values.dtype.kind == "f":
         from pathway_tpu.parallel.mesh import data_shards, get_default_mesh
 
         mesh = get_default_mesh()
         if data_shards(mesh) > 1 and len(values) >= MESH_THRESHOLD:
             from pathway_tpu.parallel.groupby_sharded import sharded_segment_sum
 
-            return sharded_segment_sum(
-                mesh, np.asarray(key_lo), segment_ids, values, num_segments
-            ).astype(values.dtype)
+            key_lo = np.asarray(key_lo)
+            if values.dtype == np.float32:
+                return sharded_segment_sum(
+                    mesh, key_lo, segment_ids, values, num_segments
+                ).astype(values.dtype)
+            if MESH_F64_SPLIT and np.max(np.abs(values), initial=0.0) < _F32_SAFE_MAX:
+                hi = values.astype(np.float32)
+                lo = (values - hi.astype(np.float64)).astype(np.float32)
+                s_hi = sharded_segment_sum(mesh, key_lo, segment_ids, hi, num_segments)
+                s_lo = sharded_segment_sum(mesh, key_lo, segment_ids, lo, num_segments)
+                return s_hi.astype(np.float64) + s_lo.astype(np.float64)
+            # overflow-risky or opted-out f64: exact host reduction
     if (
         jax is not None
         and values.dtype == np.float32
