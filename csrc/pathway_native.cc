@@ -567,7 +567,10 @@ struct KeyIndex {
   void rehash_if_needed() {
     uint64_t cap = mask + 1;
     if (static_cast<uint64_t>(filled) * 4 < cap * 3) return;
-    uint64_t new_cap = cap * 2;
+    // tombstone-dominated tables rebuild at the SAME size (purge, not grow) so
+    // insert/remove churn with constant live keys keeps memory bounded; only a
+    // genuinely full table doubles
+    uint64_t new_cap = cap;
     while (static_cast<uint64_t>(live) * 4 >= new_cap * 2) new_cap <<= 1;
     std::vector<uint64_t> ohi, olo;
     std::vector<int8_t> ost;
@@ -774,7 +777,10 @@ struct MultiMap {
   void rehash_if_needed() {
     uint64_t cap = mask + 1;
     if (static_cast<uint64_t>(filled) * 4 < cap * 3) return;
-    uint64_t new_cap = cap * 2;
+    // tombstone-dominated tables rebuild at the SAME size (purge, not grow) so
+    // insert/remove churn with constant live keys keeps memory bounded; only a
+    // genuinely full table doubles
+    uint64_t new_cap = cap;
     while (static_cast<uint64_t>(live) * 4 >= new_cap * 2) new_cap <<= 1;
     std::vector<uint64_t> ohi, olo;
     std::vector<int8_t> ost;

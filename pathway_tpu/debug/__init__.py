@@ -189,7 +189,6 @@ class _TimedSource(StaticDataSource):
         self._pointers = keys
         self._schedule = sorted(set(times))
         self._pos = 0
-        self._occurrences: dict = {}
         self._col_arrays: Dict[str, np.ndarray] | None = None
         # All timed sources of one graph share a global clock: each commit releases the
         # rows of the earliest pending __time__ across the whole graph, so interleaved
@@ -202,7 +201,6 @@ class _TimedSource(StaticDataSource):
     def on_start(self) -> None:
         self._pos = 0
         self._done = False
-        self._occurrences = {}
         self._clock._polled = set()
         self._clock._round_min = None
 

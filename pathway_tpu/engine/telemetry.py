@@ -21,10 +21,10 @@ from typing import Any, Iterator
 
 def _tracer() -> Any:
     try:
-        if (
-            "opentelemetry.trace" not in sys.modules
-            and not os.environ.get("PATHWAY_TELEMETRY")
-        ):
+        requested = os.environ.get("PATHWAY_TELEMETRY", "").lower() not in (
+            "", "0", "false", "no", "off",
+        )
+        if "opentelemetry.trace" not in sys.modules and not requested:
             return None  # no SDK configured and not requested: stay no-op, import-free
         from opentelemetry import trace
 

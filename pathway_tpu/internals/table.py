@@ -313,7 +313,14 @@ class Table(Joinable):
 
     def having(self, *indexers: expr.ColumnReference) -> "Table":
         """Restrict to rows whose pointer exists in the indexer's table."""
-        node = G.add_node(pg.HavingNode(inputs=[self], indexers=list(indexers)))
+        # the indexer tables are real dataflow inputs: their deltas drive the
+        # membership counts (HavingEvaluator reads input_deltas[1:])
+        node = G.add_node(
+            pg.HavingNode(
+                inputs=[self, *(ix.table for ix in indexers)],
+                indexers=list(indexers),
+            )
+        )
         result = Table(node, self._schema, name="having")
         universe_solver.register_subset(result._universe, self._universe)
         return result
@@ -622,6 +629,26 @@ class Table(Joinable):
         from pathway_tpu.stdlib.temporal import window_join as _f
 
         return _f(self, other, self_time, other_time, window, *on, **kw)
+
+    def window_join_inner(self, other: "Table", self_time: Any, other_time: Any, window: Any, *on: Any):
+        from pathway_tpu.stdlib.temporal import window_join_inner as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_left(self, other: "Table", self_time: Any, other_time: Any, window: Any, *on: Any):
+        from pathway_tpu.stdlib.temporal import window_join_left as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_right(self, other: "Table", self_time: Any, other_time: Any, window: Any, *on: Any):
+        from pathway_tpu.stdlib.temporal import window_join_right as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_outer(self, other: "Table", self_time: Any, other_time: Any, window: Any, *on: Any):
+        from pathway_tpu.stdlib.temporal import window_join_outer as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
 
     def diff(self, timestamp: Any, *values: Any, instance: Any = None) -> "Table":
         from pathway_tpu.stdlib.ordered import diff as _diff

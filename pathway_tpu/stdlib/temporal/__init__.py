@@ -27,6 +27,7 @@ from pathway_tpu.stdlib.temporal._interval_join import (
 )
 from pathway_tpu.stdlib.temporal._asof_join import (
     AsofDirection,
+    Direction,
     asof_join,
     asof_join_inner,
     asof_join_left,
@@ -55,6 +56,7 @@ from pathway_tpu.stdlib.temporal.time_utils import inactivity_detection, utc_now
 
 __all__ = [
     "AsofDirection",
+    "Direction",
     "Behavior",
     "CommonBehavior",
     "ExactlyOnceBehavior",

@@ -49,56 +49,110 @@ class AsofJoinResult:
         self.direction = direction
         self.defaults = defaults or {}
 
-    def select(self, *args: Any, **kwargs: Any) -> Table:
-        left, right = self.left, self.right
+    def _split_on(self) -> tuple[list, list]:
+        import operator
+
         left_on: list[expr.ColumnExpression] = []
         right_on: list[expr.ColumnExpression] = []
         for cond in self.on:
-            cond = thisclass.substitute(cond, {thisclass.left: left, thisclass.right: right})
-            import operator
-
+            cond = thisclass.substitute(
+                cond, {thisclass.left: self.left, thisclass.right: self.right}
+            )
             assert (
                 isinstance(cond, expr.ColumnBinaryOpExpression)
                 and cond._operator is operator.eq
             ), "asof_join conditions must be equalities"
             a, b = cond._left, cond._right
-            if any(r.table is left for r in a._column_refs):
+            if any(r.table is self.left for r in a._column_refs):
                 left_on.append(a)
                 right_on.append(b)
             else:
                 left_on.append(b)
                 right_on.append(a)
+        return left_on, right_on
 
-        rt = right.with_columns(_pw_t=self.right_time)
-        # aggregate sorted (time, id) tuples per right key
-        rt2 = rt.with_columns(_pw_pair=expr.make_tuple(rt._pw_t, rt.id))
-        if right_on:
-            rkey = rt2.pointer_from(*[_rebind_to(e, right, rt2) for e in right_on])
-            keyed = rt2.with_columns(_pw_key=rkey)
-            agg = keyed.groupby(keyed._pw_key).reduce(
+    def select(self, *args: Any, **kwargs: Any) -> Table:
+        """Reference asof semantics (``_asof_join.py:479-1000``): every record of a
+        participating side yields one output row, matched against the OTHER side's
+        record selected by ``direction`` (backward = latest not-after). LEFT drives
+        from the left records, RIGHT from the right, OUTER from both; ``pw.this``
+        additionally exposes ``instance`` (join-key value), ``side`` (False =
+        left-driven) and ``t`` (the driving record's time)."""
+        out_exprs: Dict[str, Any] = {}
+        for arg in args:
+            out_exprs[_name_of(arg)] = arg
+        out_exprs.update(kwargs)
+
+        left_on, right_on = self._split_on()
+        parts: list[Table] = []
+        if self.kind in (JoinKind.INNER, JoinKind.LEFT, JoinKind.OUTER):
+            parts.append(self._side_part(False, left_on, right_on, out_exprs))
+        if self.kind in (JoinKind.RIGHT, JoinKind.OUTER):
+            parts.append(self._side_part(True, left_on, right_on, out_exprs))
+        if len(parts) == 1:
+            return parts[0]
+        return parts[0].concat_reindex(*parts[1:])
+
+    def _side_part(
+        self, flipped: bool, left_on: list, right_on: list, out_exprs: Dict[str, Any]
+    ) -> Table:
+        if not flipped:
+            driver, other = self.left, self.right
+            driver_time, other_time = self.left_time, self.right_time
+            driver_on, other_on = left_on, right_on
+        else:
+            driver, other = self.right, self.left
+            driver_time, other_time = self.right_time, self.left_time
+            driver_on, other_on = right_on, left_on
+
+        ot = other.with_columns(_pw_t=other_time)
+        ot2 = ot.with_columns(_pw_pair=expr.make_tuple(ot._pw_t, ot.id))
+        if other_on:
+            # group by the RAW key expressions: the group's output key is then
+            # keys_from_values(values) == pointer_from(values), exactly what the
+            # driver side derives for its ix lookup
+            key_cols = {
+                f"_pw_k{i}": _rebind_to(e, other, ot2) for i, e in enumerate(other_on)
+            }
+            keyed = ot2.with_columns(**key_cols)
+            agg = keyed.groupby(*[keyed[n] for n in key_cols]).reduce(
                 _pw_pairs=reducers.sorted_tuple(keyed._pw_pair)
             )
         else:
-            agg = rt2.groupby().reduce(_pw_pairs=reducers.sorted_tuple(rt2._pw_pair))
+            agg = ot2.groupby().reduce(_pw_pairs=reducers.sorted_tuple(ot2._pw_pair))
 
-        lt = left.with_columns(_pw_t=self.left_time)
-        if right_on:
-            lkey = lt.pointer_from(*[_rebind_to(e, left, lt) for e in left_on])
+        dt = driver.with_columns(_pw_t=driver_time)
+        if driver_on:
+            dkey = dt.pointer_from(*[_rebind_to(e, driver, dt) for e in driver_on])
         else:
-            lkey = lt.pointer_from()
-        pairs = agg.ix(lkey, optional=True)._pw_pairs
+            dkey = dt.pointer_from()
+        pairs = agg.ix(dkey, optional=True)._pw_pairs
 
         direction = self.direction
 
         def pick(mytime: Any, pairs_tuple: Any) -> Any:
+            # Tie-break follows the reference's merge order: at equal times, LEFT
+            # events precede RIGHT events. A left-driven row therefore sees
+            # same-time right rows as "after" it (backward excludes them, forward
+            # includes them); a right-driven row sees same-time left rows as
+            # "before" (backward inclusive, forward exclusive).
             if not pairs_tuple:
                 return None
             times = [p[0] for p in pairs_tuple]
+            inclusive_back = flipped  # right-driven: at-or-before
             if direction == AsofDirection.BACKWARD:
-                i = bisect.bisect_right(times, mytime) - 1
+                i = (
+                    bisect.bisect_right(times, mytime)
+                    if inclusive_back
+                    else bisect.bisect_left(times, mytime)
+                ) - 1
                 return pairs_tuple[i][1] if i >= 0 else None
             if direction == AsofDirection.FORWARD:
-                i = bisect.bisect_left(times, mytime)
+                i = (
+                    bisect.bisect_left(times, mytime)
+                    if not flipped  # left-driven: at-or-after
+                    else bisect.bisect_right(times, mytime)
+                )
                 return pairs_tuple[i][1] if i < len(pairs_tuple) else None
             # nearest
             i = bisect.bisect_left(times, mytime)
@@ -110,22 +164,33 @@ class AsofJoinResult:
                         best = (d, pairs_tuple[j][1])
             return best[1] if best else None
 
-        match_ptr = expr.apply_with_type(pick, Any, lt._pw_t, pairs)
-        with_match = lt.with_columns(_pw_match=match_ptr)
-        if self.kind in (JoinKind.INNER,):
+        match_ptr = expr.apply_with_type(pick, Any, dt._pw_t, pairs)
+        with_match = dt.with_columns(_pw_match=match_ptr)
+        if self.kind == JoinKind.INNER:
             with_match = with_match.filter(with_match._pw_match.is_not_none())
-        rmatch = right.ix(with_match._pw_match, optional=True)
+        omatch = other.ix(with_match._pw_match, optional=True)
 
-        out_exprs: Dict[str, Any] = {}
-        for arg in args:
-            out_exprs[_name_of(arg)] = arg
-        out_exprs.update(kwargs)
+        specials: Dict[str, Any] = {
+            "side": expr.ColumnConstExpression(flipped),
+            "t": with_match._pw_t,
+        }
+        if driver_on:
+            inst = [_rebind_to(e, driver, with_match) for e in driver_on]
+            specials["instance"] = inst[0] if len(inst) == 1 else expr.make_tuple(*inst)
+        else:
+            specials["instance"] = expr.ColumnConstExpression(None)
+
         resolved = {}
         for name, e in out_exprs.items():
+            # pw.this.instance/side/t resolve to the asof result's virtual columns
+            e = _resolve_specials(e, specials)
             e = thisclass.substitute(
-                e, {thisclass.left: left, thisclass.right: right, thisclass.this: left}
+                e,
+                {thisclass.left: self.left, thisclass.right: self.right, thisclass.this: driver},
             )
-            resolved[name] = _rebind_pair(e, left, with_match, right, rmatch, self.defaults)
+            resolved[name] = _rebind_asof(
+                e, driver, with_match, other, omatch, self.defaults, specials
+            )
         return with_match.select(**resolved)
 
 
@@ -156,17 +221,60 @@ def _rebind_to(e: Any, old: Table, new: Table) -> Any:
     return e
 
 
-def _rebind_pair(
-    e: Any, left: Table, new_left: Table, right: Table, rmatch: Table, defaults: Dict
+def _resolve_specials(e: Any, specials: Dict[str, Any]) -> Any:
+    if isinstance(e, thisclass.ThisColumnReference) and e._kind is thisclass.this:
+        # instance/side/t are the asof result's virtual columns and win over
+        # same-named driver columns (pw.this.t is the merge time even when the
+        # driver has a column "t" — reference test_asof_left_forward)
+        if e.name in specials:
+            return specials[e.name]
+        return e
+    if isinstance(e, expr.ColumnExpression) and not isinstance(e, expr.ColumnReference):
+        import copy
+
+        clone = copy.copy(e)
+        for attr, value in list(vars(e).items()):
+            if isinstance(value, expr.ColumnExpression):
+                setattr(clone, attr, _resolve_specials(value, specials))
+            elif isinstance(value, tuple) and any(
+                isinstance(v, expr.ColumnExpression) for v in value
+            ):
+                setattr(
+                    clone,
+                    attr,
+                    tuple(
+                        _resolve_specials(v, specials)
+                        if isinstance(v, expr.ColumnExpression)
+                        else v
+                        for v in value
+                    ),
+                )
+        return clone
+    return e
+
+
+def _rebind_asof(
+    e: Any,
+    driver: Table,
+    new_driver: Table,
+    other: Table,
+    omatch: Table,
+    defaults: Dict,
+    specials: Dict[str, Any],
 ) -> Any:
+    """Rebind a select expression for one asof side-pass: driver refs hit the driving
+    rows (``pw.this`` specials ``instance``/``side``/``t`` included), other-side refs
+    hit the matched row with the configured default coalesced over a missing match."""
     if isinstance(e, expr.ColumnReference):
-        if e.table is left:
-            return new_left[e.name]
-        if e.table is right:
-            base = rmatch[e.name]
-            if e.name in defaults or e in defaults:
-                default = defaults.get(e.name, defaults.get(e))
-                return expr.coalesce(base, default)
+        if e.table is driver:
+            if e.name in specials and e.name not in driver.column_names():
+                return specials[e.name]
+            return new_driver[e.name]
+        if e.table is other:
+            base = omatch[e.name]
+            key = (id(other), e.name)
+            if key in defaults:
+                return expr.coalesce(base, defaults[key])
             return base
         return e
     if isinstance(e, expr.ColumnExpression):
@@ -175,13 +283,17 @@ def _rebind_pair(
         clone = copy.copy(e)
         for attr, value in list(vars(e).items()):
             if isinstance(value, expr.ColumnExpression):
-                setattr(clone, attr, _rebind_pair(value, left, new_left, right, rmatch, defaults))
+                setattr(
+                    clone,
+                    attr,
+                    _rebind_asof(value, driver, new_driver, other, omatch, defaults, specials),
+                )
             elif isinstance(value, tuple) and any(isinstance(v, expr.ColumnExpression) for v in value):
                 setattr(
                     clone,
                     attr,
                     tuple(
-                        _rebind_pair(v, left, new_left, right, rmatch, defaults)
+                        _rebind_asof(v, driver, new_driver, other, omatch, defaults, specials)
                         if isinstance(v, expr.ColumnExpression)
                         else v
                         for v in value
@@ -202,10 +314,19 @@ def asof_join(
     direction: AsofDirection = AsofDirection.BACKWARD,
     behavior: Any = None,
 ) -> AsofJoinResult:
-    defaults_by_name = {}
+    defaults_by_ref: Dict[Any, Any] = {}
     if defaults:
+        from pathway_tpu.internals import thisclass
+
         for k, v in defaults.items():
-            defaults_by_name[k.name if hasattr(k, "name") else k] = v
+            # keyed by (owning table, column name): both sides may default the same
+            # column name (reference ``defaults={t1.val: 0, t2.val: 0}``);
+            # pw.left/pw.right keys substitute to their concrete tables first
+            k = thisclass.substitute(k, {thisclass.left: self, thisclass.right: other})
+            if isinstance(k, expr.ColumnReference):
+                defaults_by_ref[(id(k.table), k.name)] = v
+            else:
+                defaults_by_ref[(id(other), k)] = v
     return AsofJoinResult(
         self,
         other,
@@ -214,7 +335,7 @@ def asof_join(
         on,
         how,
         direction,
-        defaults_by_name,
+        defaults_by_ref,
     )
 
 

@@ -1,0 +1,489 @@
+"""Temporal-join semantics matrix (reference ``tests/temporal/test_interval_joins.py``,
+``test_window_joins.py``, ``test_asof_joins.py``): randomized brute-force oracles across
+join modes x bounds x sharding x dtype, plus hand-pinned reference cases (asof full with
+two-sided defaults, session window joins over concatenated sides)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.joins import JoinKind
+
+from .utils import T, assert_table_equality_wo_index, capture_rows
+
+
+def _rows_multiset(rows: list[dict], names: list[str]) -> list[tuple]:
+    from .utils import _norm
+
+    return sorted((tuple(_norm(r[c]) for c in names) for r in rows), key=repr)
+
+
+MODES = [JoinKind.INNER, JoinKind.LEFT, JoinKind.RIGHT, JoinKind.OUTER]
+
+
+def _expected_pairs(
+    lts: list, rts: list, lo, hi, lkeys=None, rkeys=None
+) -> list[tuple]:
+    """Brute-force interval-join oracle over (time, key) rows."""
+    out = []
+    matched_l: set = set()
+    matched_r: set = set()
+    for i, lt in enumerate(lts):
+        for j, rt in enumerate(rts):
+            if lkeys is not None and lkeys[i] != rkeys[j]:
+                continue
+            if lo <= rt - lt <= hi:
+                out.append((lt, rt))
+                matched_l.add(i)
+                matched_r.add(j)
+    return out, matched_l, matched_r
+
+
+def _run_interval_case(seed: int, mode: JoinKind, lo, hi, sharded: bool, floats: bool):
+    rng = np.random.default_rng(seed)
+    nl, nr = 17, 13
+    if floats:
+        lts = np.round(rng.uniform(0, 10, nl), 2).tolist()
+        rts = np.round(rng.uniform(0, 10, nr), 2).tolist()
+    else:
+        lts = rng.integers(0, 12, nl).tolist()
+        rts = rng.integers(0, 12, nr).tolist()
+    lkeys = rng.integers(0, 3, nl).tolist() if sharded else None
+    rkeys = rng.integers(0, 3, nr).tolist() if sharded else None
+
+    pg.G.clear()
+    if sharded:
+        left = pw.debug.table_from_rows(
+            pw.schema_builder({"t": float if floats else int, "k": int}),
+            list(zip(lts, lkeys)),
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_builder({"t2": float if floats else int, "k2": int}),
+            list(zip(rts, rkeys)),
+        )
+        res = left.interval_join(
+            right, left.t, right.t2, pw.temporal.interval(lo, hi), left.k == right.k2,
+            how=mode,
+        ).select(lt=left.t, rt=right.t2)
+    else:
+        left = pw.debug.table_from_rows(
+            pw.schema_builder({"t": float if floats else int}), [(t,) for t in lts]
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_builder({"t2": float if floats else int}), [(t,) for t in rts]
+        )
+        res = left.interval_join(
+            right, left.t, right.t2, pw.temporal.interval(lo, hi), how=mode
+        ).select(lt=left.t, rt=right.t2)
+    got = _rows_multiset(capture_rows(res), ["lt", "rt"])
+
+    pairs, matched_l, matched_r = _expected_pairs(lts, rts, lo, hi, lkeys, rkeys)
+    want = list(pairs)
+    if mode in (JoinKind.LEFT, JoinKind.OUTER):
+        want += [(lts[i], None) for i in range(nl) if i not in matched_l]
+    if mode in (JoinKind.RIGHT, JoinKind.OUTER):
+        want += [(None, rts[j]) for j in range(nr) if j not in matched_r]
+    assert got == sorted(want, key=repr), (
+        f"seed={seed} mode={mode} lo={lo} hi={hi} sharded={sharded} floats={floats}\n"
+        f"got  {got}\nwant {sorted(want, key=repr)}"
+    )
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("bounds", [(-2, 2), (0, 3), (-3, -1), (1, 4), (0, 0)])
+def test_interval_join_modes_bounds(mode, bounds):
+    _run_interval_case(1, mode, bounds[0], bounds[1], sharded=False, floats=False)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("mode", MODES)
+def test_interval_join_sharded_oracle(seed, mode):
+    _run_interval_case(seed, mode, -2, 1, sharded=True, floats=False)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("mode", [JoinKind.INNER, JoinKind.OUTER])
+def test_interval_join_float_oracle(seed, mode):
+    _run_interval_case(seed, mode, -0.5, 0.75, sharded=False, floats=True)
+
+
+def test_interval_join_non_overlapping_outer():
+    pg.G.clear()
+    left = pw.debug.table_from_rows(pw.schema_builder({"t": int}), [(0,), (1,)])
+    right = pw.debug.table_from_rows(pw.schema_builder({"t2": int}), [(100,), (200,)])
+    res = left.interval_join_outer(
+        right, left.t, right.t2, pw.temporal.interval(-1, 1)
+    ).select(lt=left.t, rt=right.t2)
+    got = _rows_multiset(capture_rows(res), ["lt", "rt"])
+    assert got == sorted(
+        [(0, None), (1, None), (None, 100), (None, 200)], key=repr
+    )
+
+
+def test_interval_join_expressions_and_select():
+    """Output expressions combining both sides (reference
+    test_interval_inner_join_expressions)."""
+    pg.G.clear()
+    left = pw.debug.table_from_rows(
+        pw.schema_builder({"t": int, "a": int}), [(1, 10), (4, 40), (7, 70)]
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_builder({"t2": int, "b": int}), [(2, 1), (5, 2), (11, 3)]
+    )
+    res = left.interval_join_inner(
+        right, left.t, right.t2, pw.temporal.interval(0, 2)
+    ).select(s=left.a + right.b, d=right.t2 - left.t)
+    got = _rows_multiset(capture_rows(res), ["s", "d"])
+    assert got == sorted([(11, 1), (42, 1)], key=repr)
+
+
+# -- window joins ----------------------------------------------------------------
+
+
+def _window_of(t, duration, hop):
+    """All (start, end) windows containing t for a sliding(hop, duration) window."""
+    import math
+
+    out = []
+    b = math.floor(t / hop)
+    # scan a safe range of window starts
+    for k in range(b - int(duration / hop) - 2, b + 2):
+        start = k * hop
+        if start <= t < start + duration:
+            out.append((start, start + duration))
+    return out
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("win", [("tumbling", 3, 3), ("sliding", 4, 2)])
+def test_window_join_oracle(mode, win):
+    _kind, duration, hop = win
+    rng = np.random.default_rng(5)
+    lts = rng.integers(0, 15, 14).tolist()
+    rts = rng.integers(0, 15, 11).tolist()
+    pg.G.clear()
+    left = pw.debug.table_from_rows(pw.schema_builder({"t": int}), [(t,) for t in lts])
+    right = pw.debug.table_from_rows(pw.schema_builder({"t2": int}), [(t,) for t in rts])
+    w = (
+        pw.temporal.tumbling(duration=duration)
+        if _kind == "tumbling"
+        else pw.temporal.sliding(hop=hop, duration=duration)
+    )
+    res = left.window_join(right, left.t, right.t2, w, how=mode).select(
+        lt=left.t, rt=right.t2
+    )
+    got = _rows_multiset(capture_rows(res), ["lt", "rt"])
+
+    # oracle: each (row, window) pair is an entity; join within (window)
+    lwin = [(t, wnd) for t in lts for wnd in _window_of(t, duration, hop)]
+    rwin = [(t, wnd) for t in rts for wnd in _window_of(t, duration, hop)]
+    pairs = []
+    matched_l, matched_r = set(), set()
+    for i, (lt, wl) in enumerate(lwin):
+        for j, (rt, wr) in enumerate(rwin):
+            if wl == wr:
+                pairs.append((lt, rt))
+                matched_l.add(i)
+                matched_r.add(j)
+    want = list(pairs)
+    if mode in (JoinKind.LEFT, JoinKind.OUTER):
+        want += [(lwin[i][0], None) for i in range(len(lwin)) if i not in matched_l]
+    if mode in (JoinKind.RIGHT, JoinKind.OUTER):
+        want += [(None, rwin[j][0]) for j in range(len(rwin)) if j not in matched_r]
+    assert got == sorted(want, key=repr), f"mode={mode} win={win}"
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("use_predicate", [False, True])
+def test_session_window_join_concatenated_sides(mode, use_predicate):
+    """Sessions form over BOTH sides' times: left 1,2 and right 3 chain into one
+    session with max_gap=2 even though neither side alone spans it (reference
+    ``_window_join.py:174-179``)."""
+    pg.G.clear()
+    left = pw.debug.table_from_rows(pw.schema_builder({"t": int}), [(1,), (2,), (10,)])
+    right = pw.debug.table_from_rows(pw.schema_builder({"t2": int}), [(3,), (20,)])
+    w = (
+        pw.temporal.session(predicate=lambda a, b: abs(a - b) <= 2)
+        if use_predicate
+        else pw.temporal.session(max_gap=2)
+    )
+    res = left.window_join(right, left.t, right.t2, w, how=mode).select(
+        lt=left.t, rt=right.t2
+    )
+    got = _rows_multiset(capture_rows(res), ["lt", "rt"])
+    # session 1: {1,2,3}; session 2: {10}; session 3: {20}
+    want = [(1, 3), (2, 3)]
+    if mode in (JoinKind.LEFT, JoinKind.OUTER):
+        want += [(10, None)]
+    if mode in (JoinKind.RIGHT, JoinKind.OUTER):
+        want += [(None, 20)]
+    assert got == sorted(want, key=repr), f"mode={mode}"
+
+
+def test_session_window_join_sharded():
+    pg.G.clear()
+    left = pw.debug.table_from_rows(
+        pw.schema_builder({"t": int, "k": int}), [(1, 0), (2, 1), (3, 0)]
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_builder({"t2": int, "k2": int}), [(2, 0), (3, 1), (9, 0)]
+    )
+    res = left.window_join_inner(
+        right, left.t, right.t2, pw.temporal.session(max_gap=1), left.k == right.k2
+    ).select(lt=left.t, rt=right.t2, k=left.k)
+    got = _rows_multiset(capture_rows(res), ["lt", "rt", "k"])
+    # k=0: union times {1,3}+{2} chain into session {1,2,3} -> left{1,3} x right{2};
+    # k=1: {2}+{3} -> left{2} x right{3}
+    assert got == sorted([(1, 2, 0), (3, 2, 0), (2, 3, 1)], key=repr)
+
+
+def test_window_join_window_columns():
+    pg.G.clear()
+    left = pw.debug.table_from_rows(pw.schema_builder({"t": int}), [(1,), (5,)])
+    right = pw.debug.table_from_rows(pw.schema_builder({"t2": int}), [(2,)])
+    res = left.window_join_left(
+        right, left.t, right.t2, pw.temporal.tumbling(duration=4)
+    ).select(lt=left.t, ws=pw.this._pw_window_start)
+    got = _rows_multiset(capture_rows(res), ["lt", "ws"])
+    assert got == sorted([(1, 0), (5, 4)], key=repr)
+
+
+# -- asof joins ------------------------------------------------------------------
+
+
+def test_asof_full_two_sided_defaults():
+    """The reference's canonical OUTER asof case (test_asof_full): every record of
+    both sides emits once, matched backward against the other side, with per-side
+    defaults and pw.this.instance/side/t exposed."""
+    pg.G.clear()
+    t1 = T(
+        """
+            | K | val |  t
+        1   | 0 | 1   |  1
+        2   | 0 | 2   |  4
+        3   | 0 | 3   |  5
+        4   | 0 | 4   |  6
+        5   | 0 | 5   |  7
+        6   | 0 | 6   |  11
+        7   | 0 | 7   |  12
+        8   | 1 | 8   |  5
+        9   | 1 | 9   |  7
+    """
+    )
+    t2 = T(
+        """
+             | K | val | t
+        21   | 1 | 7  | 2
+        22   | 1 | 3  | 8
+        23   | 0 | 0  | 2
+        24   | 0 | 6  | 3
+        25   | 0 | 2  | 7
+        26   | 0 | 3  | 8
+        27   | 0 | 9  | 9
+        28   | 0 | 7  | 13
+        29   | 0 | 4  | 14
+        """
+    )
+    res = t1.asof_join(
+        t2,
+        t1.t,
+        t2.t,
+        t1.K == t2.K,
+        how=JoinKind.OUTER,
+        defaults={t1.val: 0, t2.val: 0},
+    ).select(
+        pw.this.instance,
+        pw.this.side,
+        pw.this.t,
+        val_v1=t1.val,
+        val_v2=t2.val,
+        sum=t1.val + t2.val,
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+instance | side  | t  | val_v1 | val_v2 | sum
+0        | False | 1  | 1      | 0      | 1
+0        | False | 4  | 2      | 6      | 8
+0        | False | 5  | 3      | 6      | 9
+0        | False | 6  | 4      | 6      | 10
+0        | False | 7  | 5      | 6      | 11
+0        | False | 11 | 6      | 9      | 15
+0        | False | 12 | 7      | 9      | 16
+0        | True  | 2  | 1      | 0      | 1
+0        | True  | 3  | 1      | 6      | 7
+0        | True  | 7  | 5      | 2      | 7
+0        | True  | 8  | 5      | 3      | 8
+0        | True  | 9  | 5      | 9      | 14
+0        | True  | 13 | 7      | 7      | 14
+0        | True  | 14 | 7      | 4      | 11
+1        | False | 5  | 8      | 7      | 15
+1        | False | 7  | 9      | 7      | 16
+1        | True  | 2  | 0      | 7      | 7
+1        | True  | 8  | 9      | 3      | 12
+"""
+        ),
+    )
+
+
+def test_asof_left_with_defaults():
+    pg.G.clear()
+    t1 = T(
+        """
+        | t | v
+      1 | 1 | a
+      2 | 5 | b
+      3 | 9 | c
+    """
+    )
+    t2 = T(
+        """
+        | t | val
+      1 | 3 | 30
+      2 | 7 | 70
+    """
+    )
+    res = t1.asof_join_left(t2, t1.t, t2.t, defaults={t2.val: -1}).select(
+        v=t1.v, rv=t2.val
+    )
+    got = _rows_multiset(capture_rows(res), ["v", "rv"])
+    assert got == sorted([("a", -1), ("b", 30), ("c", 70)], key=repr)
+
+
+def test_asof_right_mode():
+    pg.G.clear()
+    t1 = T(
+        """
+        | t | v
+      1 | 2 | x
+      2 | 6 | y
+    """
+    )
+    t2 = T(
+        """
+        | t | w
+      1 | 1 | p
+      2 | 4 | q
+      3 | 9 | r
+    """
+    )
+    res = t1.asof_join(t2, t1.t, t2.t, how=JoinKind.RIGHT).select(
+        w=t2.w, lv=t1.v, t=pw.this.t
+    )
+    got = _rows_multiset(capture_rows(res), ["w", "lv", "t"])
+    # each right row picks latest left at-or-before: 1->None, 4->x, 9->y
+    assert got == sorted([("p", None, 1), ("q", "x", 4), ("r", "y", 9)], key=repr)
+
+
+@pytest.mark.parametrize(
+    "direction,expect",
+    [
+        (None, [("a", None), ("b", 30), ("c", 70)]),  # BACKWARD: strictly-before
+        ("forward", [("a", 30), ("b", 70), ("c", None)]),  # FORWARD: at-or-after
+        ("nearest", [("a", 30), ("b", 30), ("c", 70)]),
+    ],
+)
+def test_asof_directions(direction, expect):
+    pg.G.clear()
+    t1 = T(
+        """
+        | t | v
+      1 | 1 | a
+      2 | 5 | b
+      3 | 9 | c
+    """
+    )
+    t2 = T(
+        """
+        | t | val
+      1 | 3 | 30
+      2 | 7 | 70
+    """
+    )
+    kwargs = {}
+    if direction == "forward":
+        kwargs["direction"] = pw.temporal.Direction.FORWARD
+    elif direction == "nearest":
+        kwargs["direction"] = pw.temporal.Direction.NEAREST
+    res = t1.asof_join_left(t2, t1.t, t2.t, **kwargs).select(v=t1.v, rv=t2.val)
+    got = _rows_multiset(capture_rows(res), ["v", "rv"])
+    assert got == sorted(expect, key=repr)
+
+
+def test_asof_nearest_tie_and_exact():
+    pg.G.clear()
+    t1 = T(
+        """
+        | t
+      1 | 5
+    """
+    )
+    t2 = T(
+        """
+        | t | val
+      1 | 3 | 1
+      2 | 5 | 2
+      3 | 8 | 3
+    """
+    )
+    res = t1.asof_join_left(
+        t2, t1.t, t2.t, direction=pw.temporal.Direction.NEAREST
+    ).select(rv=t2.val)
+    assert _rows_multiset(capture_rows(res), ["rv"]) == [(2,)]
+
+
+def test_asof_multiple_keys():
+    pg.G.clear()
+    t1 = T(
+        """
+        | a | b | t | v
+      1 | 0 | 0 | 5 | l1
+      2 | 0 | 1 | 5 | l2
+      3 | 1 | 0 | 5 | l3
+    """
+    )
+    t2 = T(
+        """
+        | a | b | t | w
+      1 | 0 | 0 | 3 | r1
+      2 | 0 | 1 | 4 | r2
+      3 | 1 | 1 | 2 | r3
+    """
+    )
+    res = t1.asof_join_left(t2, t1.t, t2.t, t1.a == t2.a, t1.b == t2.b).select(
+        v=t1.v, w=t2.w
+    )
+    got = _rows_multiset(capture_rows(res), ["v", "w"])
+    assert got == sorted([("l1", "r1"), ("l2", "r2"), ("l3", None)], key=repr)
+
+
+# -- behavior x interval-join interaction ----------------------------------------
+
+
+def test_interval_join_with_behavior_cutoff_streaming():
+    """Late rows beyond the cutoff are ignored by the join (common_behavior on
+    interval_join, reference ``_interval_join.py`` behavior plumbing)."""
+    pg.G.clear()
+    left = pw.debug.table_from_rows(
+        pw.schema_builder({"t": int}),
+        [(1, 0, 1), (2, 0, 1), (20, 2, 1), (3, 4, 1)],  # t=3 arrives after time 20 seen
+        is_stream=True,
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_builder({"t2": int}), [(1,), (2,), (3,), (20,)]
+    )
+    res = left.interval_join_inner(
+        right,
+        left.t,
+        right.t2,
+        pw.temporal.interval(0, 0),
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).select(lt=left.t, rt=right.t2)
+    got = _rows_multiset(capture_rows(res), ["lt", "rt"])
+    # the late t=3 row is past the cutoff (max seen 20, cutoff 2) and is dropped
+    assert (3, 3) not in got
+    assert (1, 1) in got and (2, 2) in got and (20, 20) in got
