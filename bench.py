@@ -93,16 +93,36 @@ def bench_knn() -> dict:
 
 
 def bench_embedder() -> dict:
-    """BASELINE #2: SentenceTransformer batch-embed throughput on the TPU."""
+    """BASELINE #2: SentenceTransformer batch-embed throughput on the TPU.
+
+    Steady-state measurement: fixed 1024-doc chunks (the serving batch size), with
+    the SAME shape warmed up first so one-time XLA compilation is excluded — the
+    engine reuses a compiled shape for every production batch. Reports the
+    host-side (tokenize) vs device-side split."""
     from pathway_tpu.models.encoder import JaxSentenceEncoder
 
     enc = JaxSentenceEncoder("sentence-transformers/all-MiniLM-L6-v2")
+    bs = 1024
     texts = [f"document number {i} about topic {i % 37} and theme {i % 11}" for i in range(4096)]
-    enc.encode(texts[:1024])  # warmup / compile
+    enc.encode(texts[:bs])  # warmup / compile at the production shape
+    # token count + host-tokenize share measured separately (untimed pre-pass)
+    n_tokens = 0
+    tok_s = 0.0
+    for start in range(0, len(texts), bs):
+        t1 = time.perf_counter()
+        _ids, mask = enc._tokenize(texts[start : start + bs])
+        tok_s += time.perf_counter() - t1
+        n_tokens += int(mask.sum())
     t0 = time.perf_counter()
-    enc.encode(texts)
+    for start in range(0, len(texts), bs):
+        enc.encode(texts[start : start + bs])
     dt = time.perf_counter() - t0
-    return {"embed_docs_per_s": round(len(texts) / dt, 1), "embed_dim": enc.dim}
+    return {
+        "embed_docs_per_s": round(len(texts) / dt, 1),
+        "embed_tokens_per_s": round(n_tokens / dt, 1),
+        "embed_host_tokenize_ms_per_batch": round(tok_s / (len(texts) / bs) * 1000, 2),
+        "embed_dim": enc.dim,
+    }
 
 
 def bench_vector_store(port: int = 18715) -> dict:
@@ -161,10 +181,31 @@ def bench_vector_store(port: int = 18715) -> dict:
         t1 = time.perf_counter()
         post("/v1/retrieve", {"query": f"term{i} term{i+40} term{i+80}", "k": 3})
         lat.append(time.perf_counter() - t1)
+
+    # latency floor diagnostic: one device round-trip (a trivial jit + fetch).
+    # On a tunneled TPU (axon) every RPC costs ~65 ms regardless of compute; the
+    # serving path is engineered down to ONE round-trip (device-resident query
+    # embeddings chained into the search kernel), so p50 ~= rtt + engine overhead.
+    # On locally-attached TPU hardware the same path runs in single-digit ms.
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.ones((8, 8))
+    np.asarray(f(x))
+    rtts = []
+    for _ in range(10):
+        t1 = time.perf_counter()
+        np.asarray(f(x))
+        rtts.append(time.perf_counter() - t1)
+    rtt_ms = float(np.median(rtts)) * 1000.0
+    p50_ms = float(np.median(lat)) * 1000.0
     return {
         "vs_ingest_docs_per_s": round(n_docs / ingest_s, 1),
-        "vs_query_p50_ms": round(float(np.median(lat)) * 1000.0, 2),
+        "vs_query_p50_ms": round(p50_ms, 2),
         "vs_query_p95_ms": round(float(np.percentile(lat, 95)) * 1000.0, 2),
+        "device_roundtrip_p50_ms": round(rtt_ms, 2),
+        "vs_query_p50_minus_rtt_ms": round(p50_ms - rtt_ms, 2),
     }
 
 

@@ -122,10 +122,39 @@ class StreamingDataSource(DataSource):
     """Queue-fed source; a producer thread pushes (key, row, diff) events.
 
     Mirrors the reference's per-connector input thread + mpsc channel + poller drain
-    (``connectors/mod.rs:461-529``).
+    (``connectors/mod.rs:461-529``). Draining is NON-blocking — the commit loop wakes
+    on a per-runner event when any producer pushes, so end-to-end latency is wake-up +
+    one commit rather than a serial per-source poll window — while ``autocommit_ms``
+    keeps its reference meaning as the commit-tick interval: a source releases its
+    queued events at most once per window, so steady streams still coalesce into
+    window-sized batches instead of commit-per-event.
     """
 
     _MAX_EVENTS_PER_COMMIT = 100_000  # reference drains <=100k entries/iteration
+
+    # one process-wide wake signal plus per-runner events: a producer push wakes
+    # EVERY registered commit loop (each clears only its own event, so concurrent
+    # runners never consume each other's wakeups)
+    WAKE = threading.Event()
+    _RUNNER_EVENTS: "list[threading.Event]" = []
+    _REG_LOCK = threading.Lock()
+
+    @classmethod
+    def register_runner(cls, event: "threading.Event") -> None:
+        with cls._REG_LOCK:
+            cls._RUNNER_EVENTS.append(event)
+
+    @classmethod
+    def unregister_runner(cls, event: "threading.Event") -> None:
+        with cls._REG_LOCK:
+            if event in cls._RUNNER_EVENTS:
+                cls._RUNNER_EVENTS.remove(event)
+
+    @classmethod
+    def _wake_all(cls) -> None:
+        cls.WAKE.set()
+        for ev in list(cls._RUNNER_EVENTS):
+            ev.set()
 
     def __init__(self, subject: Any = None, autocommit_ms: float | None = None):
         self.events: "queue.Queue[tuple]" = queue.Queue()
@@ -151,12 +180,14 @@ class StreamingDataSource(DataSource):
 
     def push(self, values: dict, key: Pointer | None = None, diff: int = 1) -> None:
         self.events.put(("data", key, values, diff))
+        StreamingDataSource._wake_all()
 
     def push_begin(self, token: Any, fingerprint: Any) -> None:
         """Producer marks the start of a replayable segment (e.g. one file): ``token``
         identifies it, ``fingerprint`` changes iff a re-push of the segment would produce
         a different event sequence."""
         self.events.put(("begin", token, fingerprint))
+        StreamingDataSource._wake_all()
 
     def push_state(self, state_delta: Any) -> None:
         """Producer checkpoints the just-finished segment in-band (after its events).
@@ -164,14 +195,17 @@ class StreamingDataSource(DataSource):
         back through ``subject.restore``. Ends the current engine batch so journal
         frames align with segment boundaries."""
         self.events.put(("state", state_delta))
+        StreamingDataSource._wake_all()
 
     def push_barrier(self) -> None:
         """Producer signals one full scan pass: any still-unmatched crash-straddled
         segment is gone — its journaled tail events get retracted."""
         self.events.put(("barrier",))
+        StreamingDataSource._wake_all()
 
     def close(self) -> None:
         self.events.put(("eof",))
+        StreamingDataSource._wake_all()
 
     # engine API ------------------------------------------------------------
 
@@ -191,11 +225,19 @@ class StreamingDataSource(DataSource):
     def next_batch(self, column_names: List[str]) -> Delta:
         rows: List[tuple] = []
         self._frame_state_deltas = []
-        deadline = time_mod.monotonic() + (self._autocommit_ms or 10) / 1000.0
+        now = time_mod.monotonic()
+        if (
+            now < getattr(self, "_next_commit_at", 0.0)
+            and not self._finished.is_set()
+            and self.events.qsize() < self._MAX_EVENTS_PER_COMMIT
+        ):
+            # inside the autocommit window: let events coalesce (the reference's
+            # commit tick); eof and overfull queues release immediately
+            return Delta.empty(column_names)
+        deadline = now + (self._autocommit_ms or 10) / 1000.0
         while len(rows) < self._MAX_EVENTS_PER_COMMIT:
-            timeout = deadline - time_mod.monotonic()
             try:
-                event = self.events.get(timeout=max(timeout, 0.001))
+                event = self.events.get_nowait()
             except queue.Empty:
                 break
             if event[0] == "eof":
@@ -257,6 +299,10 @@ class StreamingDataSource(DataSource):
                 break
         if not rows:
             return Delta.empty(column_names)
+        # a released batch opens the next coalescing window: the FIRST event after
+        # an idle period commits immediately (serving latency), sustained streams
+        # batch at the autocommit tick (reference commit_duration semantics)
+        self._next_commit_at = time_mod.monotonic() + (self._autocommit_ms or 10) / 1000.0
         n = len(rows)
         keys = np.empty(n, dtype=KEY_DTYPE)
         for i, (key, values, diff) in enumerate(rows):

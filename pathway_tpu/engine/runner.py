@@ -473,10 +473,25 @@ class GraphRunner:
         # fallback sink for operators with no local log; nested iterate runners run on
         # this thread and inherit it, while their inner node objects route precisely
         runtime["global_source"] = getattr(self.graph, "_error_log_source", None)
+        from pathway_tpu.engine.datasource import StreamingDataSource
+
+        # idle pacing: wake on producer pushes (latency = wake + one commit), with the
+        # smallest configured autocommit interval as the staleness cap. The wake event
+        # is per-runner so concurrent loops never consume each other's signals.
+        idle_wait = 0.010
+        for node, _ in self._sources:
+            ms = getattr(node.config["source"], "_autocommit_ms", None)
+            if ms:
+                idle_wait = min(idle_wait, ms / 1000.0)
+        import threading as _threading
+
+        wake = _threading.Event()
+        StreamingDataSource.register_runner(wake)
         commits = 0
         try:
             with span("graph_runner.run"):
                 while True:
+                    wake.clear()
                     any_output = self.step()
                     commits += 1
                     if max_commits is not None and commits >= max_commits:
@@ -484,8 +499,9 @@ class GraphRunner:
                     if self.sources_finished() and not any_output and not self.has_pending():
                         break
                     if not any_output and not self.sources_finished():
-                        time_mod.sleep(0.001)
+                        wake.wait(timeout=idle_wait)
         finally:
+            StreamingDataSource.unregister_runner(wake)
             runtime.update(prev_runtime)
             if max_commits is None:
                 self.finish()

@@ -168,7 +168,10 @@ def rest_connector(
     route: str = "/",
     schema: sch.SchemaMetaclass | None = None,
     methods: Sequence[str] = ("POST",),
-    autocommit_duration_ms: int | None = 50,
+    # serving path: a small commit tick keeps request latency at wake+commit while
+    # still coalescing request bursts (the engine releases the first event after an
+    # idle period immediately — see StreamingDataSource.next_batch)
+    autocommit_duration_ms: int | None = 5,
     keep_queries: bool | None = None,
     delete_completed_queries: bool = False,
     request_validator: Any = None,

@@ -158,18 +158,23 @@ class DenseKNNStore(SlotIngestMixin):
     def search_batch(self, queries: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Returns (scores (q,k), slots (q,k), valid_mask (q,k)); slots map via key_of."""
         self._flush()
-        queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
+        if isinstance(queries, jax.Array):
+            # device-resident queries (e.g. straight from the embedder) chain into
+            # the search without a host round-trip
+            queries = queries.astype(jnp.float32).reshape(-1, self.dim)
+        else:
+            queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
         k_eff = max(1, min(k, self.capacity))
         top_scores, top_idx = _search_kernel(
             self._data.astype(jnp.float32),
             self._valid,
             self._norms,
-            jnp.asarray(queries),
+            queries if isinstance(queries, jax.Array) else jnp.asarray(queries),
             k_eff,
             self.metric,
         )
-        scores = np.asarray(top_scores)
-        idx = np.asarray(top_idx)
+        # one batched host fetch (a tunneled device pays per-RPC latency, not size)
+        scores, idx = jax.device_get((top_scores, top_idx))
         valid = np.isfinite(scores)
         return scores, idx, valid
 
@@ -231,7 +236,11 @@ class BruteForceKnnIndex:
         )
         overfetch = max(limits) if not has_filter else max(max(limits) * 4, 16)
         overfetch = min(overfetch, max(len(self.store), 1))
-        q = np.stack([_as_vector(v) for v in query_vectors])
+        vecs = [_as_vector(v) for v in query_vectors]
+        if any(isinstance(v, jax.Array) for v in vecs):
+            q: Any = jnp.stack([jnp.asarray(v, dtype=jnp.float32) for v in vecs])
+        else:
+            q = np.stack(vecs)
         scores, idx, valid = self.store.search_batch(q, overfetch)
         from pathway_tpu.stdlib.indexing.filters import matches_filter
 
@@ -345,7 +354,10 @@ def _score_candidates(matrix: jax.Array, query: jax.Array, metric: str) -> jax.A
     return scores
 
 
-def _as_vector(value: Any) -> np.ndarray:
+def _as_vector(value: Any) -> Any:
+    if isinstance(value, jax.Array):
+        # device-resident: normalize shape/dtype lazily, stays on device
+        return value.astype(jnp.float32).reshape(-1)
     if isinstance(value, np.ndarray):
         return value.astype(np.float32).reshape(-1)
     if isinstance(value, (tuple, list)):

@@ -154,7 +154,11 @@ class ShardedKNNStore(SlotIngestMixin):
         self, queries: np.ndarray, k: int
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         self._flush()
-        queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
+        if isinstance(queries, jax.Array):
+            # device-resident queries feed the sharded kernel without a host bounce
+            queries = queries.astype(jnp.float32).reshape(-1, self.dim)
+        else:
+            queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
         cap_local = self.capacity // self.n_shards
         k_eff = max(1, min(k, cap_local))
         fn = shard_map(

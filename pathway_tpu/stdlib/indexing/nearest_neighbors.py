@@ -58,8 +58,16 @@ class _KnnInnerIndex(InnerIndex):
     def make_instance_factory(self) -> Callable[[], Any]:
         return self._make_index
 
+    # Indexes whose search kernel consumes device-resident query vectors override
+    # this to True: query embeddings then stay on device and chain into the search
+    # with one total round-trip. Host-side indexes (LSH) keep numpy cells.
+    _device_queries = False
+
     def preprocess_query(self, query_column: expr.ColumnReference) -> expr.ColumnExpression:
         if self.embedder is not None:
+            device = getattr(self.embedder, "device_expression", None)
+            if self._device_queries and device is not None:
+                return device(query_column)
             return _apply_embedder(self.embedder, query_column)
         return query_column
 
@@ -98,6 +106,8 @@ class BruteForceKnn(_KnnInnerIndex):
     """Exact KNN on the TPU (reference ``BruteForceKnn:170`` over
     ``brute_force_knn_integration.rs``)."""
 
+    _device_queries = True  # dense store consumes device query batches directly
+
     def __init__(
         self,
         data_column: expr.ColumnReference,
@@ -122,6 +132,8 @@ class BruteForceKnn(_KnnInnerIndex):
 
 class USearchKnn(_KnnInnerIndex):
     """API parity with the reference's HNSW index; served exactly on TPU (see module doc)."""
+
+    _device_queries = True  # same dense-store kernel as BruteForceKnn
 
     def __init__(
         self,

@@ -87,6 +87,25 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             max_batch_size=self.batch_size,
         )
 
+    def device_expression(self, *args: Any, **kwargs: Any) -> expr.ColumnExpression:
+        """Query-path variant: embedding cells are DEVICE-resident jax slices so
+        downstream device kernels (KNN search) chain without a host round-trip."""
+        encoder = self.encoder
+
+        def embed_batch(texts: List[str]) -> List[Any]:
+            vectors = encoder.encode_device([str(t) for t in texts])
+            return [vectors[i] for i in range(len(texts))]
+
+        return expr.BatchApplyExpression(
+            embed_batch,
+            np.ndarray,
+            False,
+            True,
+            args,
+            kwargs,
+            max_batch_size=self.batch_size,
+        )
+
     def get_embedding_dimension(self, **kwargs: Any) -> int:
         return self.encoder.dim
 
