@@ -4,10 +4,14 @@ Parity: reference ``python/pathway/cli.py`` — ``spawn`` (multi-process launche
 ``PATHWAY_*`` env vars, ``:53-110``), ``spawn-from-env`` (``:284``), record/``replay``
 (``:166,252``). Run as ``python -m pathway_tpu.cli <command>``.
 
-Processes launched by ``spawn -n N`` are partitioned-ingest replicas: each is told its
-``PATHWAY_PROCESS_ID``/``PATHWAY_PROCESSES`` and connectors shard their source partitions
-accordingly (the reference's ``parallel_readers``). On-device scale-out uses the JAX mesh
-(``pathway_tpu.parallel``), not OS processes.
+Processes launched by ``spawn -n N`` form a cluster: each is told its
+``PATHWAY_PROCESS_ID``/``PATHWAY_PROCESSES``/``PATHWAY_FIRST_PORT``, connectors shard
+their source partitions (the reference's ``parallel_readers``), and key-partitioned
+operators (groupby, join) hash-route every commit's rows to their key's owner process
+over the full-mesh TCP exchange (``parallel/cluster.py`` — the reference's
+``CommunicationConfig::Cluster``), so global aggregates are exact and each key is
+owned by exactly one process. On-device scale-out uses the JAX mesh
+(``pathway_tpu.parallel``) within each process.
 """
 
 from __future__ import annotations

@@ -371,6 +371,19 @@ class GroupbyEvaluator(Evaluator):
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
+        set_id = self.node.config.get("set_id", False)
+        cluster = getattr(self.runner, "_cluster", None)
+        if cluster is not None:
+            # hash-route rows to their group key's owner process (all-to-all
+            # barrier; participates even with no local rows — peers block on our
+            # partitions). Reference: DD reduce's exchange over the Cluster
+            # allocator, shard.rs routing.
+            n0 = len(delta)
+            resolver0 = self._resolver_for(self.node.inputs[0], delta)
+            gvals0 = [ee.evaluate(g, n0, resolver0) for g in self.node.config["grouping"]]
+            gkeys0 = self._group_keys(gvals0, n0, set_id)
+            tag = f"{self.runner.current_time}:{self.node.id}:g".encode()
+            delta = cluster.exchange_delta(tag, delta, gkeys0)
         if len(delta) == 0:
             return Delta.empty(self.output_columns)
         table = self.node.inputs[0]
@@ -381,7 +394,6 @@ class GroupbyEvaluator(Evaluator):
         grouping_vals = [
             ee.evaluate(g, n, resolver) for g in self.node.config["grouping"]
         ]
-        set_id = self.node.config.get("set_id", False)
 
         # reducer argument values per leaf (vectorized)
         leaf_args: List[List[np.ndarray]] = []
@@ -751,9 +763,10 @@ class JoinEvaluator(Evaluator):
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         left_delta, right_delta = input_deltas
+        cluster = getattr(self.runner, "_cluster", None)
         parts: List[Delta] = []
         for delta, side_name in ((left_delta, "left"), (right_delta, "right")):
-            if len(delta) == 0:
+            if len(delta) == 0 and cluster is None:
                 continue
             part = self._run_side(delta, side_name)
             if part is not None and len(part):
@@ -770,6 +783,16 @@ class JoinEvaluator(Evaluator):
         other = self.right if is_left else self.left
         own_null = self.kind in ((JK.LEFT, JK.OUTER) if is_left else (JK.RIGHT, JK.OUTER))
         other_null = self.kind in ((JK.RIGHT, JK.OUTER) if is_left else (JK.LEFT, JK.OUTER))
+
+        cluster = getattr(self.runner, "_cluster", None)
+        if cluster is not None:
+            # both sides hash-route by JOIN key, so every join key's rows meet on
+            # one owner process (all-to-all barrier; runs even with no local rows)
+            jkeys0 = self._join_keys(side_name, delta)
+            tag = f"{self.runner.current_time}:{self.node.id}:{side_name}".encode()
+            delta = cluster.exchange_delta(tag, delta, jkeys0)
+        if len(delta) == 0:
+            return None
 
         n = len(delta)
         diffs = delta.diffs
@@ -1819,7 +1842,15 @@ class OutputEvaluator(Evaluator):
             ptrs = keys_to_pointers(delta.keys)
             time = self.runner.current_time
             names = self.input_columns
-            cols = [list(delta.columns[c]) for c in names]  # one C pass per column
+            # tolist() on numeric columns yields native Python scalars (reference
+            # callbacks receive py values, not numpy scalars); datetime64 columns
+            # must NOT tolist (ns precision degrades to raw int nanoseconds)
+            cols = [
+                delta.columns[c].tolist()
+                if delta.columns[c].dtype.kind in "ifb"
+                else list(delta.columns[c])
+                for c in names
+            ]
             additions = (delta.diffs > 0).tolist()
             callback = self.callback
             for ptr, is_add, *vals in zip(ptrs, additions, *cols):

@@ -43,6 +43,7 @@ class GraphRunner:
         self._substep_deltas: Dict[int, Delta] = {}
         self._materialized: set = set()
         self._materialize_all = False  # nested iterate runners read states directly
+        self._cluster: Any = None  # multi-process exchange (parallel/cluster.py)
 
     def state_of(self, node: pg.Node) -> StateTable:
         if node.id not in self._materialized:
@@ -94,11 +95,62 @@ class GraphRunner:
         Lets evaluators resolve retraction rows against retracted upstream values."""
         return self._substep_deltas.get(node.id)
 
+    # Operators whose per-key state cannot be hash-co-partitioned by the cluster
+    # exchange yet: running them multi-process would silently return per-process
+    # partial answers, so they fail loudly instead (VERDICT r2 item 3).
+    _CLUSTER_UNSUPPORTED = {
+        "ix", "sort", "deduplicate", "buffer", "forget", "freeze",
+        "external_index", "asof_now", "iterate", "iterate_result",
+        "update_rows", "update_cells", "intersect", "difference", "restrict",
+        "having", "with_universe_of", "row_transformer",
+    }
+
     def setup(self, monitoring_level: Any = None, persistence_config: Any = None) -> None:
         # hot-path modules load now, not inside the first timed commit
         from pathway_tpu.engine import index as _index  # noqa: F401
         from pathway_tpu.ops import segment as _segment  # noqa: F401
         from pathway_tpu.engine.evaluators import EVALUATORS
+        from pathway_tpu.parallel.cluster import get_cluster
+
+        self._cluster = None if self._materialize_all else get_cluster()
+        if self._cluster is not None:
+            bad = sorted(
+                {n.kind for n in self.graph.nodes if n.kind in self._CLUSTER_UNSUPPORTED}
+            )
+            if bad:
+                raise NotImplementedError(
+                    f"operators {bad} keep per-key state that is not co-partitioned "
+                    "across spawn processes; run this pipeline single-process "
+                    "(spawn -n 1) or restructure around groupby/join"
+                )
+            from pathway_tpu.internals.expression import ColumnExpression
+
+            def cross_refs(node: pg.Node) -> bool:
+                found = [False]
+
+                def walk(value: Any) -> None:
+                    if isinstance(value, ColumnExpression):
+                        for ref in value._column_refs:
+                            if all(ref.table is not t for t in node.inputs):
+                                found[0] = True
+                    elif isinstance(value, dict):
+                        for v in value.values():
+                            walk(v)
+                    elif isinstance(value, (list, tuple)):
+                        for v in value:
+                            walk(v)
+
+                walk(node.config)
+                return found[0]
+
+            for node in self.graph.nodes:
+                if node.kind in ("groupby", "join") and cross_refs(node):
+                    raise NotImplementedError(
+                        f"node {node.id} ({node.kind}) references another table's "
+                        "materialized state; exchanged rows cannot resolve foreign "
+                        "state across spawn processes — inline the referenced "
+                        "columns (select them onto the input) or run single-process"
+                    )
 
         self._nodes = list(self.graph.nodes)
         for node in self._nodes:
@@ -307,10 +359,15 @@ class GraphRunner:
         self.current_time = self._commit * 2  # even data times, as in the reference
         self.draining = self._ready and self.sources_finished()
         any_output = self._substep(neu=False)
-        if any(
+        neu = any(
             getattr(self.evaluators[n.id], "neu_pending", _no_pending)()
             for n in self._nodes
-        ):
+        )
+        if self._cluster is not None:
+            # the neu phase is part of the lockstep commit protocol: every process
+            # must agree whether it runs (exchange points fire inside it)
+            neu = any(self._cluster.allgather(f"neu:{self._commit}".encode(), neu))
+        if neu:
             self.current_time = self._commit * 2 + 1
             any_output = self._substep(neu=True) or any_output
         if self._persistence is not None and self._inject is None:
@@ -386,6 +443,12 @@ class GraphRunner:
                     and not originates
                     and not (not neu and _has_pending(evaluator))
                     and node.kind != "iterate_result"
+                    # lockstep: exchange-point operators participate in every
+                    # commit's all-to-all even with no local rows (peers block on
+                    # our partitions)
+                    and not (
+                        self._cluster is not None and node.kind in ("groupby", "join")
+                    )
                 ):
                     delta = Delta.empty(self.output_columns_of(node))
                 elif originates:
@@ -496,7 +559,24 @@ class GraphRunner:
                     commits += 1
                     if max_commits is not None and commits >= max_commits:
                         break
-                    if self.sources_finished() and not any_output and not self.has_pending():
+                    local_done = (
+                        self.sources_finished() and not any_output and not self.has_pending()
+                    )
+                    if self._cluster is not None:
+                        # lockstep shutdown: stop only when EVERY process drained
+                        # (a peer's data may still route rows to us)
+                        if all(
+                            self._cluster.allgather(
+                                f"done:{self._commit}".encode(), local_done
+                            )
+                        ):
+                            break
+                        if not any_output:
+                            # keep stepping (peers may exchange into us), but pace
+                            # the idle spin — barriers resume inside the next step
+                            wake.wait(timeout=idle_wait)
+                        continue
+                    if local_done:
                         break
                     if not any_output and not self.sources_finished():
                         wake.wait(timeout=idle_wait)

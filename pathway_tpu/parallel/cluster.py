@@ -1,0 +1,258 @@
+"""Cross-process exchange backend — the reference's ``CommunicationConfig::Cluster``.
+
+The reference scales past one process with timely's TCP allocator: every worker runs
+the same dataflow and rows hash-route to their key's owner
+(``src/engine/dataflow/config.rs:73-84``,
+``external/timely-dataflow/communication/src/initialize.rs:25-31``, shard routing
+``src/engine/dataflow/shard.rs:15-20``). Here the equivalent is a full-mesh TCP
+exchange between the ``pathway_tpu spawn -n N`` processes: key-partitioned stateful
+operators (groupby, join) partition each commit's input delta by the low bits of the
+routing key and swap partitions all-to-all, so every group/join key lives on exactly
+one owner process and global aggregates are exact. Commits run in lockstep — each
+exchange is a barrier — mirroring timely's bulk-synchronous progress model (and the
+mesh collectives the same operators use across TPU chips, ``groupby_sharded.py``).
+
+Environment contract (set by ``pathway_tpu spawn``): ``PATHWAY_PROCESSES``,
+``PATHWAY_PROCESS_ID``, ``PATHWAY_FIRST_PORT``; addresses default to
+``127.0.0.1:first_port+i`` like the reference (``dataflow/config.rs:111-114``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ClusterExchange:
+    """Full-mesh, length-prefixed-frame TCP exchange between spawn processes.
+
+    Frames are tagged; ``exchange_parts`` is an all-to-all barrier: it sends one
+    payload per peer under a tag and blocks until the same tag arrived from every
+    peer. Deterministic tag sequences (commit id x node id x purpose) keep the
+    processes in lockstep without a coordinator.
+    """
+
+    _HDR = struct.Struct("<II")  # tag_len, payload_len
+
+    def __init__(self, n_processes: int, process_id: int, first_port: int):
+        self.n = n_processes
+        self.me = process_id
+        self.first_port = first_port
+        self._conns: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._inbox: Dict[tuple, bytes] = {}  # (peer, tag) -> payload
+        self._cv = threading.Condition()
+        self._closed = False
+        self._listener: Optional[socket.socket] = None
+        self._connect_all()
+        for peer, conn in self._conns.items():
+            t = threading.Thread(
+                target=self._reader, args=(peer, conn), daemon=True,
+                name=f"pathway:cluster-rx-{peer}",
+            )
+            t.start()
+
+    # -- wiring --------------------------------------------------------------
+
+    def _connect_all(self) -> None:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", self.first_port + self.me))
+        listener.listen(self.n)
+        self._listener = listener
+
+        accepted: Dict[int, socket.socket] = {}
+        accept_errors: List[BaseException] = []
+
+        def accept_loop() -> None:
+            try:
+                for _ in range(self.me):  # lower-ranked peers dial us
+                    conn, _addr = listener.accept()
+                    peer = int.from_bytes(self._recv_exact(conn, 4), "little")
+                    accepted[peer] = conn
+            except BaseException as exc:  # surfaced after join: silent partial
+                accept_errors.append(exc)  # wiring would drop peers' data
+
+        acceptor = threading.Thread(target=accept_loop, daemon=True)
+        acceptor.start()
+        # we dial every higher-ranked peer (with retry: they may not be up yet)
+        for peer in range(self.me + 1, self.n):
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    s = socket.create_connection(
+                        ("127.0.0.1", self.first_port + peer), timeout=5
+                    )
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"cluster process {self.me} could not reach peer {peer} "
+                            f"on port {self.first_port + peer}"
+                        )
+                    time.sleep(0.05)
+            s.sendall(self.me.to_bytes(4, "little"))
+            self._conns[peer] = s
+        acceptor.join(timeout=60)
+        if acceptor.is_alive():
+            raise TimeoutError(
+                f"cluster process {self.me} timed out waiting for dial-ins"
+            )
+        if accept_errors:
+            raise ConnectionError(
+                f"cluster process {self.me} failed accepting dial-ins"
+            ) from accept_errors[0]
+        if len(accepted) != self.me:
+            raise ConnectionError(
+                f"cluster process {self.me} expected {self.me} dial-ins, got "
+                f"{sorted(accepted)}"
+            )
+        self._conns.update(accepted)
+        for peer, conn in self._conns.items():
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._send_locks[peer] = threading.Lock()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("cluster peer closed the connection")
+            buf += chunk
+        return buf
+
+    def _reader(self, peer: int, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = self._recv_exact(conn, self._HDR.size)
+                tag_len, payload_len = self._HDR.unpack(hdr)
+                tag = self._recv_exact(conn, tag_len)
+                payload = self._recv_exact(conn, payload_len) if payload_len else b""
+                with self._cv:
+                    self._inbox[(peer, tag)] = payload
+                    self._cv.notify_all()
+        except (ConnectionError, OSError):
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+
+    def _send(self, peer: int, tag: bytes, payload: bytes) -> None:
+        conn = self._conns[peer]
+        with self._send_locks[peer]:
+            conn.sendall(self._HDR.pack(len(tag), len(payload)) + tag + payload)
+
+    def _recv(self, peer: int, tag: bytes, timeout: float = 300.0) -> bytes:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (peer, tag) not in self._inbox:
+                if self._closed:
+                    raise ConnectionError(
+                        f"cluster peer {peer} disconnected while waiting for {tag!r}"
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"cluster process {self.me} timed out waiting for {tag!r} "
+                        f"from peer {peer}"
+                    )
+                self._cv.wait(timeout=min(remaining, 1.0))
+            return self._inbox.pop((peer, tag))
+
+    # -- collectives ----------------------------------------------------------
+
+    def exchange_parts(self, tag: bytes, parts: Dict[int, bytes]) -> Dict[int, bytes]:
+        """All-to-all: send ``parts[peer]`` to each peer, receive theirs. Barrier."""
+        for peer in self._conns:
+            self._send(peer, tag, parts.get(peer, b""))
+        return {peer: self._recv(peer, tag) for peer in self._conns}
+
+    def allgather(self, tag: bytes, value: Any) -> List[Any]:
+        """Every process contributes ``value``; all receive the full list (by rank)."""
+        blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        got = self.exchange_parts(tag, {p: blob for p in self._conns})
+        out: List[Any] = [None] * self.n
+        out[self.me] = value
+        for peer, payload in got.items():
+            out[peer] = pickle.loads(payload)
+        return out
+
+    def close(self) -> None:
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    # -- delta routing ---------------------------------------------------------
+
+    def exchange_delta(self, tag: bytes, delta: Any, route_keys: np.ndarray) -> Any:
+        """Hash-route a commit's delta rows to their owner process and merge what
+        this process owns (reference shard routing, ``shard.rs:15-20``): owner =
+        key.lo % n. Returns the merged delta (own partition + received rows)."""
+        from pathway_tpu.engine.columnar import Delta
+        from pathway_tpu.internals.keys import shard_of
+
+        owners = shard_of(route_keys, self.n)
+        parts: Dict[int, bytes] = {}
+        for peer in range(self.n):
+            if peer == self.me:
+                continue
+            rows = np.nonzero(owners == peer)[0]
+            if len(rows):
+                sub = delta.select(rows)
+                parts[peer] = pickle.dumps(
+                    (sub.keys, sub.diffs, sub.columns, sub.neu),
+                    protocol=pickle.HIGHEST_PROTOCOL,
+                )
+            else:
+                parts[peer] = b""
+        received = self.exchange_parts(tag, parts)
+        mine = delta.select(np.nonzero(owners == self.me)[0])
+        merged = [mine]
+        for peer in sorted(received):
+            payload = received[peer]
+            if payload:
+                keys, diffs, columns, neu = pickle.loads(payload)
+                merged.append(Delta(keys, diffs, columns, neu=neu))
+        if len(merged) == 1:
+            return mine
+        return Delta.concat(merged, list(delta.columns))
+
+
+_cluster: Optional[ClusterExchange] = None
+_cluster_tried = False
+
+
+def get_cluster() -> Optional[ClusterExchange]:
+    """Process-wide exchange, created from the spawn env on first use; None when
+    running single-process."""
+    global _cluster, _cluster_tried
+    if _cluster_tried:
+        return _cluster
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    n = int(getattr(cfg, "processes", 1) or 1)
+    if n <= 1:
+        _cluster_tried = True
+        return None
+    # mark as tried only on SUCCESS: a failed wiring attempt must raise again on
+    # retry, never silently degrade to single-process partial results
+    cluster = ClusterExchange(
+        n, int(getattr(cfg, "process_id", 0) or 0), int(getattr(cfg, "first_port", 10000) or 10000)
+    )
+    _cluster = cluster
+    _cluster_tried = True
+    return _cluster
