@@ -1842,15 +1842,10 @@ class OutputEvaluator(Evaluator):
             ptrs = keys_to_pointers(delta.keys)
             time = self.runner.current_time
             names = self.input_columns
-            # tolist() on numeric columns yields native Python scalars (reference
-            # callbacks receive py values, not numpy scalars); datetime64 columns
-            # must NOT tolist (ns precision degrades to raw int nanoseconds)
-            cols = [
-                delta.columns[c].tolist()
-                if delta.columns[c].dtype.kind in "ifb"
-                else list(delta.columns[c])
-                for c in names
-            ]
+            from pathway_tpu.io._utils import columns_to_pylists
+
+            col_map = columns_to_pylists(delta.columns, names)
+            cols = [col_map[c] for c in names]
             additions = (delta.diffs > 0).tolist()
             callback = self.callback
             for ptr, is_add, *vals in zip(ptrs, additions, *cols):

@@ -52,14 +52,15 @@ def _coerce(value: str, dtype: dt.DType) -> Any:
     return value
 
 
-def _parse_csv_native(
-    filepath: str, delimiter: str, dtypes: Dict[str, dt.DType], has_schema: bool
+def _parse_dsv_bytes_native(
+    data: bytes, delimiter: str, dtypes: Dict[str, dt.DType], has_schema: bool
 ) -> List[dict] | None:
     """Fused native CSV parse (split + coercion + row dicts in C++); None → fallback.
 
     Mirrors the reference's native Dsv parser (``data_format.rs:500``): typed coercion
     happens inside the parser, malformed fields poison cells with ``Error``. JSON-typed
-    columns are post-coerced in Python (rare)."""
+    columns are post-coerced in Python (rare). THE single native-DSV dispatch — every
+    connector (fs, s3, kafka) parses through here so semantics cannot drift."""
     from pathway_tpu import native
     from pathway_tpu.engine.columnar import ERROR
 
@@ -67,8 +68,6 @@ def _parse_csv_native(
     # DictReader fallback computes naturally
     if not has_schema or native.get_lib() is None or len(delimiter.encode()) != 1:
         return None
-    with open(filepath, "rb") as f:
-        data = f.read()
     _TAGS = {dt.INT: 1, dt.FLOAT: 2, dt.BOOL: 3}
     selected = []
     json_cols = []
@@ -91,6 +90,16 @@ def _parse_csv_native(
     return rows
 
 
+def _parse_csv_native(
+    filepath: str, delimiter: str, dtypes: Dict[str, dt.DType], has_schema: bool
+) -> List[dict] | None:
+    if not has_schema:
+        return None
+    with open(filepath, "rb") as f:
+        data = f.read()
+    return _parse_dsv_bytes_native(data, delimiter, dtypes, has_schema)
+
+
 def _iter_files(path: str, object_pattern: str = "*") -> List[str]:
     p = Path(path)
     if p.is_dir():
@@ -111,6 +120,62 @@ def _metadata_for(filepath: str) -> Json:
     )
 
 
+def parse_bytes(
+    data: bytes,
+    format: str,
+    schema: sch.SchemaMetaclass | None,
+    csv_settings: Any = None,
+) -> List[dict]:
+    """Wire-format bytes -> row dicts (reference ``data_format.rs`` parsers);
+    shared by every object/message connector (fs, s3, kafka)."""
+    rows: List[dict] = []
+    if format == "plaintext_by_file":
+        rows.append({"data": data.decode("utf-8", "replace")})
+    elif format == "plaintext":
+        text = data.decode("utf-8", "replace")
+        for line in text.splitlines():
+            rows.append({"data": line})
+    elif format in ("binary", "raw"):
+        rows.append({"data": data})
+    elif format == "csv":
+        delimiter = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
+        dtypes = schema.dtypes() if schema else {}
+        native_rows = _parse_dsv_bytes_native(data, delimiter, dtypes, bool(schema))
+        if native_rows is not None:
+            rows.extend(native_rows)
+        else:
+            import io as _io
+
+            reader = _csv.DictReader(
+                _io.StringIO(data.decode("utf-8", "replace")), delimiter=delimiter
+            )
+            for rec in reader:
+                rows.append(
+                    {
+                        k: _coerce(v, dtypes.get(k, dt.STR))
+                        for k, v in rec.items()
+                        if k in dtypes or not schema
+                    }
+                )
+    elif format in ("json", "jsonlines"):
+        dtypes = schema.dtypes() if schema else {}
+        for line in data.decode("utf-8", "replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            row = {}
+            for name, dtype in (dtypes or {k: dt.ANY for k in rec}).items():
+                v = rec.get(name)
+                if dtype.strip_optional() == dt.JSON and v is not None:
+                    v = Json(v)
+                row[name] = v
+            rows.append(row)
+    else:
+        raise ValueError(f"unknown format {format!r}")
+    return rows
+
+
 def _parse_file(
     filepath: str,
     format: str,
@@ -119,44 +184,19 @@ def _parse_file(
     csv_settings: Any = None,
 ) -> List[dict]:
     rows: List[dict] = []
-    if format in ("plaintext", "plaintext_by_file"):
-        with open(filepath, "r", errors="replace") as f:
-            if format == "plaintext_by_file":
-                rows.append({"data": f.read()})
-            else:
-                for line in f:
-                    rows.append({"data": line.rstrip("\n")})
-    elif format == "binary":
-        with open(filepath, "rb") as f:
-            rows.append({"data": f.read()})
-    elif format == "csv":
+    if format == "csv":
+        # native fused path reads the file itself (mmap-friendly)
         delimiter = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
         dtypes = schema.dtypes() if schema else {}
         native_rows = _parse_csv_native(filepath, delimiter, dtypes, bool(schema))
         if native_rows is not None:
             rows.extend(native_rows)
         else:
-            with open(filepath, newline="") as f:
-                reader = _csv.DictReader(f, delimiter=delimiter)
-                for rec in reader:
-                    rows.append({k: _coerce(v, dtypes.get(k, dt.STR)) for k, v in rec.items() if k in dtypes or not schema})
-    elif format in ("json", "jsonlines"):
-        dtypes = schema.dtypes() if schema else {}
-        with open(filepath) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                rec = json.loads(line)
-                row = {}
-                for name, dtype in (dtypes or {k: dt.ANY for k in rec}).items():
-                    v = rec.get(name)
-                    if dtype.strip_optional() == dt.JSON and v is not None:
-                        v = Json(v)
-                    row[name] = v
-                rows.append(row)
+            with open(filepath, "rb") as f:
+                rows.extend(parse_bytes(f.read(), format, schema, csv_settings))
     else:
-        raise ValueError(f"unknown format {format!r}")
+        with open(filepath, "rb") as f:
+            rows.extend(parse_bytes(f.read(), format, schema, csv_settings))
     if with_metadata:
         meta = _metadata_for(filepath)
         for row in rows:

@@ -1,46 +1,379 @@
-"""Kafka connector (parity: reference ``io/kafka`` over ``data_storage.rs:692``).
+"""Kafka connector (parity: reference ``io/kafka`` over the Rust reader/writer at
+``src/connectors/data_storage.rs:692`` (KafkaReader) and ``:1258`` (KafkaWriter)).
 
-The execution image has no Kafka client library; the connector raises a clear error at call
-time. ``read_from_iterable`` offers the same Table surface fed from any message iterator, which
-is what the streaming benchmarks use.
+Real client code against the ``confluent_kafka`` API: the reader owns a consumer,
+seeks restored offsets, polls message batches into the engine's streaming source
+(offsets checkpoint in-band as segment state so persistence resumes exactly), and
+commits consumer offsets after the engine accepted each batch (at-least-once). The
+writer formats each output batch (json/dsv/raw, with the reference's ``diff``/``time``
+fields) and produces per commit. Client construction is injectable
+(``_consumer_factory``/``_producer_factory``) so unit tests run against fakes in
+environments without a broker or client library.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Iterable
+import time as time_mod
+from typing import Any, Callable, Iterable
 
+from pathway_tpu.engine.datasource import StreamingDataSource
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import pointer_from
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
 
 
-def _no_client() -> None:
-    raise ImportError(
-        "no Kafka client library (confluent_kafka / kafka-python) is available in this "
-        "environment; use pw.io.kafka.read_from_iterable(...) or pw.io.python.read(...) "
-        "to feed messages from your own consumer"
-    )
+def _default_consumer_factory(settings: dict) -> Any:
+    try:
+        from confluent_kafka import Consumer
+    except ImportError as exc:
+        raise ImportError(
+            "no Kafka client library (confluent_kafka) is available in this "
+            "environment; pass _consumer_factory=... (any object with the "
+            "confluent_kafka.Consumer poll/assign/commit surface), or use "
+            "pw.io.kafka.read_from_iterable(...)"
+        ) from exc
+    return Consumer(settings)
+
+
+def _default_producer_factory(settings: dict) -> Any:
+    try:
+        from confluent_kafka import Producer
+    except ImportError as exc:
+        raise ImportError(
+            "no Kafka client library (confluent_kafka) is available in this "
+            "environment; pass _producer_factory=... (any object with the "
+            "confluent_kafka.Producer produce/poll/flush surface)"
+        ) from exc
+    return Producer(settings)
+
+
+class _KafkaSubject:
+    """Consumer loop -> engine events, with per-batch offset segments.
+
+    Mirrors the reference ``KafkaReader``: one consumer per connector, messages
+    parsed by wire format, positions exposed as ``OffsetValue``-style state
+    (``src/connectors/offset.rs:37``) through the in-band segment markers.
+    """
+
+    def __init__(
+        self,
+        consumer_factory: Callable[[dict], Any],
+        settings: dict,
+        topics: list[str],
+        format: str,
+        schema: sch.SchemaMetaclass | None,
+        with_metadata: bool,
+        poll_timeout_s: float = 0.2,
+        commit_every_s: float = 1.5,
+        mode: str = "streaming",
+    ):
+        self.consumer_factory = consumer_factory
+        self.settings = dict(settings)
+        self.topics = topics
+        self.format = format
+        self.schema = schema
+        self.with_metadata = with_metadata
+        self.poll_timeout_s = poll_timeout_s
+        self.commit_every_s = commit_every_s
+        self.mode = mode
+        # (topic, partition) -> NEXT offset to consume (restored from checkpoints)
+        self.offsets: dict[tuple[str, int], int] = {}
+
+    # -- persistence hooks ----------------------------------------------------
+
+    @staticmethod
+    def fold_state_deltas(state_deltas: list) -> list:
+        latest: dict[tuple[str, int], dict] = {}
+        for delta in state_deltas:
+            latest[(delta["topic"], delta["partition"])] = delta
+        return [latest[k] for k in sorted(latest)]
+
+    def restore(self, state_deltas: list) -> None:
+        for delta in state_deltas:
+            self.offsets[(delta["topic"], delta["partition"])] = delta["next_offset"]
+
+    # -- message decoding -------------------------------------------------------
+
+    def _decode(self, msg: Any) -> dict | None:
+        value = msg.value()
+        if value is None:
+            return None
+        if self.format in ("raw", "binary"):
+            row: dict = {"data": value}
+        elif self.format == "plaintext":
+            row = {"data": value.decode("utf-8", "replace")}
+        elif self.format == "json":
+            rec = json.loads(value)
+            dtypes = self.schema.dtypes() if self.schema else {k: dt.ANY for k in rec}
+            row = {}
+            for name, dtype in dtypes.items():
+                v = rec.get(name)
+                if dtype.strip_optional() == dt.JSON and v is not None:
+                    v = Json(v)
+                row[name] = v
+        else:
+            raise ValueError(f"unknown kafka format {self.format!r}")
+        if self.with_metadata:
+            key = msg.key()
+            row["_metadata"] = Json(
+                {
+                    "topic": msg.topic(),
+                    "partition": msg.partition(),
+                    "offset": msg.offset(),
+                    "key": key.decode("utf-8", "replace") if key else None,
+                }
+            )
+        return row
+
+    # -- consumer loop ------------------------------------------------------------
+
+    def run(self, source: StreamingDataSource) -> None:
+        settings = dict(self.settings)
+        if self.mode in ("static", "batch"):
+            # static termination relies on per-partition EOF events (librdkafka
+            # default is off)
+            settings.setdefault("enable.partition.eof", True)
+        consumer = self.consumer_factory(settings)
+        restored = dict(self.offsets)
+
+        def on_assign(cons: Any, partitions: list) -> None:
+            # resume checkpointed positions WITHOUT dropping partitions that had
+            # no messages before the checkpoint (reference KafkaReader::seek)
+            for p in partitions:
+                off = restored.get((p.topic, p.partition))
+                if off is not None:
+                    p.offset = off
+            cons.assign(partitions)
+
+        try:
+            consumer.subscribe(list(self.topics), on_assign=on_assign)
+        except TypeError:
+            # simple fakes/clients without rebalance callbacks
+            consumer.subscribe(list(self.topics))
+            if restored:
+                try:
+                    from confluent_kafka import TopicPartition
+
+                    consumer.assign(
+                        [TopicPartition(t, p, off) for (t, p), off in restored.items()]
+                    )
+                except ImportError:
+                    consumer.assign([(t, p, off) for (t, p), off in restored.items()])
+        eof_partitions: set[tuple[str, int]] = set()
+        last_commit = time_mod.monotonic()
+        dirty: dict[tuple[str, int], int] = {}  # offsets advanced since last marker
+
+        def flush_markers() -> None:
+            # offset markers ride in-band AFTER the rows they cover, one per
+            # touched partition per batch (a marker ends the engine batch, so
+            # they flush at batch boundaries, not per message)
+            for (t, p), off in sorted(dirty.items()):
+                source.push_state({"topic": t, "partition": p, "next_offset": off})
+            dirty.clear()
+
+        def all_partitions_eof() -> bool:
+            # static mode finishes only once EVERY assigned partition reported
+            # EOF (a partial set would drop the slower partitions' tail)
+            if not eof_partitions:
+                return False
+            assigned = getattr(consumer, "assignment", lambda: None)()
+            if assigned is None:
+                return True  # client can't report assignment; best effort
+            return len(eof_partitions) >= len(assigned)
+
+        try:
+            while True:
+                msg = consumer.poll(self.poll_timeout_s)
+                if msg is None:
+                    if dirty:
+                        flush_markers()
+                    if self.mode in ("static", "batch") and all_partitions_eof():
+                        break
+                    continue
+                err = msg.error()
+                if err is not None:
+                    if getattr(err, "code", lambda: None)() == _partition_eof_code():
+                        eof_partitions.add((msg.topic(), msg.partition()))
+                        if self.mode in ("static", "batch") and all_partitions_eof():
+                            break
+                        continue
+                    raise RuntimeError(f"kafka consumer error: {err}")
+                row = self._decode(msg)
+                tp = (msg.topic(), msg.partition())
+                next_offset = msg.offset() + 1
+                self.offsets[tp] = next_offset
+                dirty[tp] = next_offset
+                if row is not None:
+                    source.push(
+                        row,
+                        key=pointer_from(msg.topic(), msg.partition(), msg.offset(), "kafka"),
+                    )
+                now = time_mod.monotonic()
+                if now - last_commit >= self.commit_every_s:
+                    last_commit = now
+                    flush_markers()
+                    try:
+                        consumer.commit(asynchronous=True)
+                    except Exception:
+                        pass  # commit is an optimization; checkpoints own resume
+        finally:
+            flush_markers()
+            try:
+                consumer.commit(asynchronous=False)
+            except Exception:
+                pass
+            consumer.close()
+
+
+def _partition_eof_code() -> Any:
+    try:
+        from confluent_kafka import KafkaError
+
+        return KafkaError._PARTITION_EOF
+    except ImportError:
+        return "_PARTITION_EOF"
 
 
 def read(
     rdkafka_settings: dict,
     topic: str | None = None,
     *,
-    schema: Any = None,
+    schema: sch.SchemaMetaclass | None = None,
     format: str = "raw",
     autocommit_duration_ms: int | None = 1500,
+    topic_names: list[str] | None = None,
+    with_metadata: bool = False,
+    mode: str = "streaming",
+    name: str | None = None,
+    _consumer_factory: Callable[[dict], Any] | None = None,
     **kwargs: Any,
-) -> Any:
-    try:
-        import confluent_kafka  # noqa: F401
-    except ImportError:
-        _no_client()
+) -> Table:
+    """Consume ``topic`` into a Table (reference ``io/kafka.read``)."""
+    topics = [topic] if topic else list(topic_names or [])
+    if not topics:
+        raise ValueError("kafka.read requires a topic (or topic_names)")
+    if _consumer_factory is None:
+        # fail at call time, not inside the connector thread
+        try:
+            import confluent_kafka  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "no Kafka client library (confluent_kafka) is available in this "
+                "environment; pass _consumer_factory=... or use "
+                "pw.io.kafka.read_from_iterable(...)"
+            ) from exc
+    if schema is None:
+        if format in ("raw", "binary"):
+            schema = sch.schema_from_types(data=bytes)
+        elif format == "plaintext":
+            schema = sch.schema_from_types(data=str)
+        else:
+            raise ValueError(f"schema is required for format {format!r}")
+    out_schema = schema
+    if with_metadata:
+        out_schema = sch.schema_from_columns(
+            {**schema.columns(), "_metadata": sch.ColumnSchema("_metadata", dt.JSON)},
+            name="kafka",
+        )
+    subject = _KafkaSubject(
+        _consumer_factory or _default_consumer_factory,
+        rdkafka_settings,
+        topics,
+        format,
+        schema,
+        with_metadata,
+        mode=mode,
+    )
+    source = StreamingDataSource(subject=subject, autocommit_ms=autocommit_duration_ms)
+    node = G.add_node(
+        pg.InputNode(source=source, streaming=mode == "streaming", name=name or "kafka")
+    )
+    return Table(node, out_schema, name=name or "kafka")
 
 
-def write(table: Any, rdkafka_settings: dict, topic_name: str | None = None, **kwargs: Any) -> None:
-    try:
-        import confluent_kafka  # noqa: F401
-    except ImportError:
-        _no_client()
+def write(
+    table: Table,
+    rdkafka_settings: dict,
+    topic_name: str | None = None,
+    *,
+    format: str = "json",
+    key: Any = None,
+    delimiter: str = ",",
+    name: str | None = None,
+    _producer_factory: Callable[[dict], Any] | None = None,
+    **kwargs: Any,
+) -> None:
+    """Produce the table's update stream to ``topic_name`` (reference KafkaWriter:
+    one message per row update, json payloads carrying ``diff`` and ``time``)."""
+    if topic_name is None:
+        raise ValueError("kafka.write requires topic_name")
+    if _producer_factory is None:
+        try:
+            import confluent_kafka  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "no Kafka client library (confluent_kafka) is available in this "
+                "environment; pass _producer_factory=..."
+            ) from exc
+    factory = _producer_factory or _default_producer_factory
+    producer_box: list = [None]
+    key_name = key.name if hasattr(key, "name") else key
+    columns = table.column_names()
+
+    def _producer() -> Any:
+        if producer_box[0] is None:
+            producer_box[0] = factory(rdkafka_settings)
+        return producer_box[0]
+
+    def batch_callback(keys: Any, diffs: Any, cols: dict, time: int) -> None:
+        producer = _producer()
+        n = len(keys)
+        from pathway_tpu.io._utils import columns_to_pylists
+
+        col_lists = columns_to_pylists(cols, columns)
+        for i in range(n):
+            row = {c: col_lists[c][i] for c in columns}
+            msg_key = None
+            if key_name is not None:
+                msg_key = str(row.get(key_name, "")).encode()
+            if format == "json":
+                payload = json.dumps(
+                    {**_jsonable(row), "diff": int(diffs[i]), "time": int(time)}
+                ).encode()
+            elif format in ("dsv", "csv"):
+                payload = delimiter.join(str(row[c]) for c in columns).encode()
+            elif format in ("raw", "plaintext"):
+                data = row.get("data", "")
+                payload = data if isinstance(data, bytes) else str(data).encode()
+            else:
+                raise ValueError(f"unknown kafka write format {format!r}")
+            producer.produce(topic_name, value=payload, key=msg_key)
+        producer.poll(0)
+
+    def on_end() -> None:
+        if producer_box[0] is not None:
+            producer_box[0].flush()
+
+    G.add_node(
+        pg.OutputNode(inputs=[table], batch_callback=batch_callback, on_end=on_end)
+    )
+
+
+def _jsonable(row: dict) -> dict:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, Json):
+            out[k] = v.value
+        elif isinstance(v, bytes):
+            out[k] = v.decode("utf-8", "replace")
+        else:
+            out[k] = v
+    return out
 
 
 def read_from_iterable(
