@@ -31,6 +31,12 @@ class Error:
     def __repr__(self) -> str:
         return "Error"
 
+    def __bool__(self) -> bool:
+        # a poisoned cell must never silently coerce to True (filters would keep
+        # rows whose predicate ERRORED — e.g. NULL comparisons); consumers that
+        # can absorb Error check isinstance explicitly
+        raise TypeError("Error value has no truth value")
+
 
 ERROR = Error()
 

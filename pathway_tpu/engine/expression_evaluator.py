@@ -224,7 +224,16 @@ class ExpressionEvaluator:
         then = self._eval(e._then)
         otherwise = self._eval(e._else)
         if cond.dtype == object:
-            cond = cond.astype(np.bool_)
+            err = np.frompyfunc(lambda v: isinstance(v, Error), 1, 1)(cond).astype(bool)
+            safe = np.where(err, False, cond)
+            cond = safe.astype(np.bool_)
+            if err.any():
+                # poisoned condition poisons the output cell (Value::Error contract)
+                out = np.empty(self.ctx.n_rows, dtype=object)
+                out[cond] = then[cond]
+                out[~cond] = otherwise[~cond]
+                out[err] = ERROR
+                return out
         if then.dtype == object or otherwise.dtype == object:
             out = np.empty(self.ctx.n_rows, dtype=object)
             out[cond] = then[cond]

@@ -97,3 +97,202 @@ def test_csv_malformed_field_poisons_with_error(tmp_path):
     # remove_errors drops the poisoned row (reference Value::Error propagation contract)
     clean = pw.io.csv.read(str(csv_file), schema=Sch, mode="static").remove_errors()
     assert capture_rows(clean) == [{"a": 1, "b": 2}]
+
+
+def test_query_reanswers_on_doc_removal():
+    """update_old semantics under retraction: removing the best doc re-answers
+    with the next best (reference ml/test_index.py re-answering matrix)."""
+    docs = T(
+        """
+        text | __time__ | __diff__
+        aaa  | 0        | 1
+        azz  | 0        | 1
+        aaa  | 4        | -1
+        """
+    )
+    queries = T(
+        """
+        q   | __time__
+        abc | 2
+        """
+    )
+    index = _make_index(docs)
+    res = index.query(queries.q, number_of_matches=1, collapse_rows=True)
+    rows = capture_rows(res)
+    assert len(rows) == 1
+    assert rows[0]["text"] == ("azz",)  # best doc retracted -> next best
+
+
+def test_query_update_stream_reanswering_events():
+    """The re-answer arrives as retract(old answer) + insert(new answer) on the
+    SAME query key (DiffEntry fixture port, reference tests/utils.py:544+)."""
+    from .utils import capture_update_stream
+
+    docs = T(
+        """
+        text | __time__
+        dzz  | 0
+        aaa  | 4
+        """
+    )
+    queries = T(
+        """
+        q   | __time__
+        abc | 2
+        """
+    )
+    index = _make_index(docs)
+    res = index.query(queries.q, number_of_matches=1, collapse_rows=True)
+    events = capture_update_stream(res)
+    seq = [(e["text"], e["__diff__"]) for e in events]
+    assert seq == [(("dzz",), 1), (("dzz",), -1), (("aaa",), 1)]
+    # per-key ordering contract via the DiffEntry fixture
+    assert len({e["__time__"] for e in events}) == 2  # answer, then re-answer
+
+
+def test_query_variable_k_per_row():
+    docs = T(
+        """
+        text
+        aaa
+        aab
+        aac
+        aad
+        """
+    )
+    queries = T(
+        """
+        q   | k
+        aaa | 1
+        aab | 3
+        """
+    )
+    index = _make_index(docs)
+    res = index.query(queries.q, number_of_matches=queries.k, collapse_rows=True)
+    rows = sorted(capture_rows(res), key=lambda r: len(r["text"]))
+    assert len(rows[0]["text"]) == 1
+    assert len(rows[1]["text"]) == 3
+
+
+def test_query_metadata_filter():
+    import json as _json
+
+    docs = T(
+        """
+        text | meta
+        aaa  | {"owner": "alice"}
+        aab  | {"owner": "bob"}
+        aac  | {"owner": "alice"}
+        """
+    )
+    from pathway_tpu.internals.json import Json
+
+    docs = docs.select(
+        docs.text, meta=pw.apply_with_type(lambda s: Json(_json.loads(s)), Json, docs.meta)
+    )
+    factory = BruteForceKnnFactory(
+        dimensions=4, metric=BruteForceKnnMetricKind.L2SQ, embedder=_vec_embedder
+    )
+    index = factory.build_index(docs.text, docs, metadata_column=docs.meta)
+    queries = T(
+        """
+        q   | flt
+        aaa | owner == 'alice'
+        """
+    )
+    res = index.query(
+        queries.q, number_of_matches=3, collapse_rows=True, metadata_filter=queries.flt
+    )
+    rows = capture_rows(res)
+    assert len(rows) == 1
+    assert sorted(rows[0]["text"]) == ["aaa", "aac"]  # bob's doc filtered out
+
+
+def test_query_all_at_once_matches_asof_now():
+    """With a static corpus, full-differential and as-of-now answers agree
+    (reference all-at-once matrix)."""
+    docs = T(
+        """
+        text
+        aaa
+        bzz
+        czz
+        """
+    )
+    queries = T(
+        """
+        q
+        abc
+        bcd
+        """
+    )
+    index = _make_index(docs)
+    r1 = index.query(queries.q, number_of_matches=2, collapse_rows=True)
+    rows1 = sorted(tuple(sorted(r["text"])) for r in capture_rows(r1))
+
+    import pathway_tpu.internals.parse_graph as pg
+
+    pg.G.clear()
+    docs2 = T(
+        """
+        text
+        aaa
+        bzz
+        czz
+        """
+    )
+    queries2 = T(
+        """
+        q
+        abc
+        bcd
+        """
+    )
+    index2 = _make_index(docs2)
+    r2 = index2.query_as_of_now(queries2.q, number_of_matches=2, collapse_rows=True)
+    rows2 = sorted(tuple(sorted(r["text"])) for r in capture_rows(r2))
+    assert rows1 == rows2
+
+
+def test_groupby_update_stream_diffentry_fixture():
+    """DiffEntry port smoke: a growing group emits retract+insert pairs in per-key
+    order (reference CheckKeyEntriesInStreamCallback semantics)."""
+    from .utils import DiffEntry, assert_key_entries_in_stream_consistent
+    from pathway_tpu.internals.keys import pointer_from
+
+    t = T(
+        """
+        word | __time__
+        cat  | 0
+        cat  | 4
+        """
+    )
+    counts = t.groupby(t.word).reduce(t.word, cnt=pw.reducers.count())
+    expected = [
+        DiffEntry(pointer_from("cat"), 0, True, {"word": "cat", "cnt": 1}),
+        DiffEntry(pointer_from("cat"), 1, False, {"word": "cat", "cnt": 1}),
+        DiffEntry(pointer_from("cat"), 2, True, {"word": "cat", "cnt": 2}),
+    ]
+    assert_key_entries_in_stream_consistent(expected, counts)
+
+
+def test_assert_stream_equality_fixture():
+    from .utils import assert_stream_equality
+
+    a = T(
+        """
+        v | __time__ | __diff__
+        1 | 0        | 1
+        2 | 2        | 1
+        1 | 4        | -1
+        """
+    )
+    b = T(
+        """
+        v | __time__ | __diff__
+        1 | 2        | 1
+        2 | 6        | 1
+        1 | 8        | -1
+        """
+    )
+    assert_stream_equality(a, b)  # same groups, times differ only by rank

@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any
 
 import numpy as np
@@ -60,3 +61,96 @@ def capture_rows(table: pw.Table) -> list[dict]:
 
 def capture_update_stream(table: pw.Table) -> list[dict]:
     return _capture_update_stream(table)
+
+
+# -- update-stream fixtures (reference tests/utils.py:119-214, 544-556) -----------
+
+
+@dataclass(order=True)
+class DiffEntry:
+    """One expected update-stream event for a key: events for a fixed key must
+    arrive ordered by (order, insertion), matching the reference's
+    ``CheckKeyEntriesInStreamCallback`` contract."""
+
+    key: Any
+    order: int
+    insertion: bool
+    row: dict
+
+    @staticmethod
+    def create(
+        pk_columns: dict,
+        order: int,
+        insertion: bool,
+        row: dict,
+    ) -> "DiffEntry":
+        from pathway_tpu.internals.keys import pointer_from
+
+        key = pointer_from(*pk_columns.values())
+        return DiffEntry(key, order, insertion, row)
+
+    def final_cleanup_entry(self) -> "DiffEntry":
+        return DiffEntry(self.key, self.order + 1, False, self.row)
+
+
+def assert_key_entries_in_stream_consistent(expected: list, table: pw.Table) -> None:
+    """Run the graph and verify each key's update events arrive in the expected
+    per-key order with the expected rows (reference ``assert_key_entries_in_
+    stream_consistent``). Events for keys not listed are failures."""
+    import collections
+
+    state: dict = collections.defaultdict(collections.deque)
+    for entry in sorted(expected):
+        state[entry.key].append(entry)
+    problems: list[str] = []
+
+    def on_change(key, row, time, is_addition):
+        queue = state.get(key)
+        if not queue:
+            problems.append(f"unexpected event for key {key}: {row} add={is_addition}")
+            return
+        head = queue.popleft()
+        got = {k: _norm(v) for k, v in row.items()}
+        want = {k: _norm(v) for k, v in head.row.items()}
+        if head.insertion != is_addition or got != want:
+            problems.append(
+                f"key {key}: expected add={head.insertion} row={want}, "
+                f"got add={is_addition} row={got}"
+            )
+
+    pw.io.subscribe(table, on_change)
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals.parse_graph import G
+
+    GraphRunner(G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert not problems, "\n".join(problems)
+    leftovers = {k: list(v) for k, v in state.items() if v}
+    assert not leftovers, f"expected events never arrived: {leftovers}"
+
+
+def _stream_groups(table: pw.Table) -> list:
+    """Captured update stream as per-commit groups of (row values, diff), with times
+    normalized to their dense rank (engine commit times are implementation detail;
+    the GROUPING and ordering are the contract — reference
+    assert_stream_split_into_groups)."""
+    events = _capture_update_stream(table)
+    names = [c for c in table.column_names()]
+    times = sorted({e["__time__"] for e in events})
+    rank = {t: i for i, t in enumerate(times)}
+    groups: dict[int, list] = {}
+    for e in events:
+        groups.setdefault(rank[e["__time__"]], []).append(
+            (tuple(_norm(e[c]) for c in names), e["__diff__"])
+        )
+    return [sorted(groups[i], key=repr) for i in sorted(groups)]
+
+
+def assert_stream_equality(a: pw.Table, b: pw.Table) -> None:
+    """Same update stream: identical per-commit groups of (row, diff), in the same
+    commit order, with times compared by rank (reference assert_stream_equality
+    up to engine-time renumbering)."""
+    ga, gb = _stream_groups(a), _stream_groups(b)
+    assert ga == gb, f"update streams differ:\n  A={ga}\n  B={gb}"
+
+
+assert_stream_equality_wo_index = assert_stream_equality
