@@ -48,6 +48,7 @@ from pathway_tpu.internals.reducers import reducers
 from pathway_tpu.internals.custom_reducers import BaseCustomAccumulator
 from pathway_tpu.internals.parse_graph import G as parse_graph_G
 from pathway_tpu.engine.runner import run, run_all
+from pathway_tpu.internals import udfs
 from pathway_tpu.internals.udfs import (
     UDF,
     AsyncRetryStrategy,
@@ -109,6 +110,7 @@ def __getattr__(name: str):
 
 __all__ = [
     "AsyncTransformer",
+    "udfs",
     "BaseCustomAccumulator",
     "CacheStrategy",
     "ColumnDefinition",

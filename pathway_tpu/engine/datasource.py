@@ -156,11 +156,20 @@ class StreamingDataSource(DataSource):
         for ev in list(cls._RUNNER_EVENTS):
             ev.set()
 
-    def __init__(self, subject: Any = None, autocommit_ms: float | None = None):
+    def __init__(
+        self,
+        subject: Any = None,
+        autocommit_ms: float | None = None,
+        loopback: bool = False,
+    ):
         self.events: "queue.Queue[tuple]" = queue.Queue()
         self._finished = threading.Event()
         self._started = False
         self.subject = subject
+        # loop-back sources (AsyncTransformer) are fed by results of THIS graph:
+        # they do not gate the primary end-of-input signal (runner fires stream-end
+        # notifications once every non-loopback source drained)
+        self.loopback = loopback
         self._thread: threading.Thread | None = None
         self._autocommit_ms = autocommit_ms
         self._seq = 0

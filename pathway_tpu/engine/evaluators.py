@@ -1815,12 +1815,106 @@ class ExternalIndexEvaluator(Evaluator):
         return delta
 
 
+class GradualBroadcastEvaluator(Evaluator):
+    """Broadcast a (lower, value, upper) threshold to every row with per-key
+    staggering and hysteresis (reference ``gradual_broadcast.rs``): each row's
+    ``apx_value`` sits at its own point of the band — apx(k) = lower +
+    (upper - lower) * frac(key) — and only re-emits when a threshold update moves
+    the band past the row's stored value, so a drifting threshold updates rows
+    progressively instead of retracting the whole table each tick."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.rows = StateTable(node.inputs[0].column_names())
+        self.apx: Dict[bytes, Any] = {}
+        self.threshold: tuple | None = None
+
+    @staticmethod
+    def _frac(keys: np.ndarray) -> np.ndarray:
+        return keys["lo"].astype(np.float64) / float(2**64)
+
+    def _candidate(self, keys: np.ndarray) -> np.ndarray:
+        lower, _value, upper = self.threshold
+        return lower + (upper - lower) * self._frac(keys)
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        from pathway_tpu.internals.keys import key_bytes
+
+        rows_delta, thr_delta = input_deltas
+        out_parts: List[tuple] = []  # (keys, diffs, cols dict incl apx)
+
+        new_threshold = self.threshold
+        if len(thr_delta):
+            ins = np.nonzero(thr_delta.diffs > 0)[0]
+            if len(ins):
+                i = int(ins[-1])
+                cfg = self.node.config
+                new_threshold = (
+                    thr_delta.columns[cfg["lower"]][i],
+                    thr_delta.columns[cfg["value"]][i],
+                    thr_delta.columns[cfg["upper"]][i],
+                )
+
+        def emit(delta: Delta, apx_vals: np.ndarray, sign: int) -> None:
+            cols = {c: delta.columns[c] for c in self.rows.column_names}
+            cols["apx_value"] = apx_vals
+            out_parts.append(
+                Delta(delta.keys, np.full(len(delta), sign, dtype=np.int64), cols)
+            )
+
+        if len(rows_delta):
+            ret = rows_delta.select(rows_delta.diffs < 0)
+            if len(ret):
+                kbs = key_bytes(ret.keys)
+                olds = np.array([self.apx.pop(kb, None) for kb in kbs], dtype=object)
+                emit(ret, olds, -1)
+            self.rows.apply(rows_delta)
+            ins = rows_delta.select(rows_delta.diffs > 0)
+            if len(ins):
+                if self.threshold is None and new_threshold is None:
+                    apx = np.zeros(len(ins), dtype=np.float64)
+                else:
+                    save, self.threshold = self.threshold, (
+                        new_threshold or self.threshold
+                    )
+                    apx = self._candidate(ins.keys)
+                    self.threshold = save
+                for kb, a in zip(key_bytes(ins.keys), apx):
+                    self.apx[kb] = a
+                emit(ins, np.asarray(apx, dtype=np.float64), 1)
+
+        if new_threshold is not None and new_threshold != self.threshold:
+            self.threshold = new_threshold
+            lower, _value, upper = new_threshold
+            snap = self.rows.snapshot()
+            if len(snap):
+                kbs = key_bytes(snap.keys)
+                stored = np.array([self.apx.get(kb) for kb in kbs], dtype=np.float64)
+                cand = self._candidate(snap.keys)
+                # hysteresis: rows whose stored value still sits inside the new
+                # band keep it; only rows the band moved past re-emit
+                move = (stored < lower) | (stored > upper)
+                move &= stored != cand
+                idx = np.nonzero(move)[0]
+                if len(idx):
+                    moving = snap.select(idx)
+                    emit(moving, stored[idx], -1)
+                    emit(moving, cand[idx], 1)
+                    for i in idx.tolist():
+                        self.apx[kbs[i]] = cand[i]
+
+        if not out_parts:
+            return Delta.empty(self.output_columns)
+        return Delta.concat(out_parts, self.output_columns)
+
+
 class OutputEvaluator(Evaluator):
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.callback = node.config.get("callback")
         self.batch_callback = node.config.get("batch_callback")
         self.on_end = node.config.get("on_end")
+        self.on_time_end = node.config.get("on_time_end")
         self.input_columns = node.inputs[0].column_names()
 
     def process(self, input_deltas: List[Delta]) -> Delta:
@@ -1852,11 +1946,19 @@ class OutputEvaluator(Evaluator):
                 callback(
                     key=ptr, row=dict(zip(names, vals)), time=time, is_addition=is_add
                 )
+        if self.on_time_end is not None and len(delta):
+            # the commit's batch is fully delivered: its time is closed (reference
+            # on_time_end markers — AsyncTransformer flushes at time boundaries)
+            self.on_time_end(self.runner.current_time)
         return Delta.empty([])
 
-    def finish(self) -> None:
-        if self.on_end is not None:
+    def notify_stream_end(self) -> None:
+        if self.on_end is not None and not getattr(self, "_on_end_fired", False):
+            self._on_end_fired = True
             self.on_end()
+
+    def finish(self) -> None:
+        self.notify_stream_end()
 
 
 def _delta_from_rows(
@@ -1904,6 +2006,7 @@ EVALUATORS: Dict[type, type] = {
     pg.ForgetNode: ForgetEvaluator,
     pg.FreezeNode: FreezeEvaluator,
     pg.ExternalIndexNode: ExternalIndexEvaluator,
+    pg.GradualBroadcastNode: GradualBroadcastEvaluator,
     pg.OutputNode: OutputEvaluator,
 }
 

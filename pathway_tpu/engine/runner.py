@@ -476,6 +476,46 @@ class GraphRunner:
     def sources_finished(self) -> bool:
         return all(node.config["source"].is_finished() for node, _ in self._sources)
 
+    def primary_sources_finished(self) -> bool:
+        return all(
+            node.config["source"].is_finished()
+            for node, _ in self._sources
+            if not getattr(node.config["source"], "loopback", False)
+        )
+
+    def _ancestor_inputs(self, node: pg.Node) -> list:
+        """Transitive InputNodes feeding ``node`` (memoized)."""
+        cache = getattr(self, "_ancestor_cache", None)
+        if cache is None:
+            cache = self._ancestor_cache = {}
+        if node.id in cache:
+            return cache[node.id]
+        cache[node.id] = []  # cycle guard (loop-back chains)
+        out: list = []
+        if isinstance(node, pg.InputNode):
+            out.append(node)
+        for inp in node.inputs:
+            out.extend(self._ancestor_inputs(inp._node))
+        cache[node.id] = out
+        return out
+
+    def _notify_stream_end(self) -> None:
+        """Deliver on_end to each subscriber whose ENTIRE input ancestry is final —
+        including loop-back sources, so a subscriber downstream of an
+        AsyncTransformer hears the end only after in-flight invocations drained
+        (and a chained transformer closes cascade-style). Re-checked every idle
+        iteration; each evaluator fires once."""
+        from pathway_tpu.engine.evaluators import OutputEvaluator
+
+        for node in self._nodes:
+            evaluator = self.evaluators.get(node.id)
+            if not isinstance(evaluator, OutputEvaluator):
+                continue
+            if all(
+                a.config["source"].is_finished() for a in self._ancestor_inputs(node)
+            ):
+                evaluator.notify_stream_end()
+
     def has_pending(self) -> bool:
         return any(_has_pending(e) for e in self.evaluators.values())
 
@@ -559,6 +599,14 @@ class GraphRunner:
                     commits += 1
                     if max_commits is not None and commits >= max_commits:
                         break
+                    if (
+                        self.primary_sources_finished()
+                        and not any_output
+                        and not self.has_pending()
+                        # cluster peers may still route rows here; finish() notifies
+                        and self._cluster is None
+                    ):
+                        self._notify_stream_end()
                     local_done = (
                         self.sources_finished() and not any_output and not self.has_pending()
                     )

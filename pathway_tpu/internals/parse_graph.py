@@ -110,6 +110,13 @@ class OutputNode(Node):
     kind = "output"
 
 
+class GradualBroadcastNode(Node):
+    """Threshold broadcast with per-key stagger + hysteresis (reference
+    ``operators/gradual_broadcast.rs``)."""
+
+    kind = "gradual_broadcast"
+
+
 class ExternalIndexNode(Node):
     kind = "external_index"
 

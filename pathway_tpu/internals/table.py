@@ -311,6 +311,39 @@ class Table(Joinable):
             "use table.ix(table.pointer_from(...)) instead"
         )
 
+    def _gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower_column: expr.ColumnReference,
+        value_column: expr.ColumnReference,
+        upper_column: expr.ColumnReference,
+    ) -> "Table":
+        """Add an ``apx_value`` column broadcasting the threshold table's
+        (lower, value, upper) band with per-key staggering + hysteresis (reference
+        ``Table._gradual_broadcast`` over ``gradual_broadcast.rs``; used by
+        louvain refinement to bound retraction churn)."""
+        from pathway_tpu.internals import dtype as dt_mod
+        from pathway_tpu.internals import schema as sch_mod
+
+        node = G.add_node(
+            pg.GradualBroadcastNode(
+                inputs=[self, threshold_table],
+                lower=lower_column.name,
+                value=value_column.name,
+                upper=upper_column.name,
+            )
+        )
+        schema = sch_mod.schema_from_columns(
+            {
+                **self._schema.columns(),
+                "apx_value": sch_mod.ColumnSchema("apx_value", dt_mod.FLOAT),
+            },
+            name="gradual_broadcast",
+        )
+        result = Table(node, schema, name="gradual_broadcast")
+        universe_solver.register_subset(result._universe, self._universe)
+        return result
+
     def having(self, *indexers: expr.ColumnReference) -> "Table":
         """Restrict to rows whose pointer exists in the indexer's table."""
         # the indexer tables are real dataflow inputs: their deltas drive the

@@ -121,6 +121,59 @@ class DiskCache(CacheStrategy):
 DefaultCache = DiskCache
 
 
+def wrap_async(
+    fun: Callable,
+    *,
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: "AsyncRetryStrategy | None" = None,
+    cache_strategy: "CacheStrategy | None" = None,
+    name: str = "async_fn",
+) -> Callable:
+    """Compose capacity/timeout/retries/caching around an async callable — the ONE
+    wrapper both ``pw.udf`` async executors and ``AsyncTransformer.with_options``
+    build on (``CacheStrategy.get`` raises ``KeyError`` on miss)."""
+    import asyncio as _asyncio
+
+    if timeout is not None:
+        inner_t = fun
+
+        async def with_timeout(*args: Any, **kwargs: Any) -> Any:
+            return await _asyncio.wait_for(inner_t(*args, **kwargs), timeout=timeout)
+
+        fun = with_timeout
+    if retry_strategy is not None:
+        inner_r = fun
+
+        async def with_retries(*args: Any, **kwargs: Any) -> Any:
+            return await retry_strategy.invoke(inner_r, *args, **kwargs)
+
+        fun = with_retries
+    if capacity:
+        inner_c = fun
+        semaphore = _asyncio.Semaphore(capacity)
+
+        async def with_capacity(*args: Any, **kwargs: Any) -> Any:
+            async with semaphore:
+                return await inner_c(*args, **kwargs)
+
+        fun = with_capacity
+    if cache_strategy is not None:
+        inner_k = fun
+
+        async def cached(*args: Any, **kwargs: Any) -> Any:
+            key = _cache_key(name, args, kwargs)
+            try:
+                return cache_strategy.get(key)
+            except KeyError:
+                value = await inner_k(*args, **kwargs)
+                cache_strategy.set(key, value)
+                return value
+
+        fun = cached
+    return fun
+
+
 def _cache_key(name: str, args: tuple, kwargs: dict) -> str:
     payload = pickle.dumps((name, args, sorted(kwargs.items())))
     return hashlib.sha256(payload).hexdigest()

@@ -40,5 +40,6 @@ def subscribe(
             callback=callback,
             batch_callback=on_batch,
             on_end=on_end,
+            on_time_end=on_time_end,
         )
     )

@@ -178,3 +178,128 @@ def test_transformer_incremental_update():
     assert sorted(e["double"] for e in additions) == [2, 4, 6]
     # no spurious retractions of unchanged rows
     assert all(e["__diff__"] == 1 for e in stream)
+
+
+# -- AsyncTransformer loop-back semantics (reference _AsyncConnector:61-527) ------
+
+
+def test_async_transformer_failed_table():
+    import pathway_tpu as pw
+    from tests.utils import capture_rows
+
+    class OutSchema(pw.Schema):
+        ret: int
+
+    class Flaky(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            if value == 13:
+                raise RuntimeError("boom")
+            return {"ret": value + 1}
+
+    t = pw.debug.table_from_rows(pw.schema_builder({"value": int}), [(1,), (13,), (3,)])
+    tr = Flaky(input_table=t)
+    ok = tr.successful
+    bad = tr.failed
+    got_ok = sorted(r["ret"] for r in capture_rows(ok))
+    assert got_ok == [2, 4]
+    import pathway_tpu.internals.parse_graph as pg
+
+    pg.G.clear()
+    t = pw.debug.table_from_rows(pw.schema_builder({"value": int}), [(1,), (13,), (3,)])
+    tr = Flaky(input_table=t)
+    bad_rows = capture_rows(tr.failed)
+    assert len(bad_rows) == 1 and bad_rows[0]["ret"] is None
+
+
+def test_async_transformer_instance_group_poisoning():
+    """With instance grouping, one failure marks the whole (instance, time) group
+    FAILURE (reference .failed contract)."""
+    import pathway_tpu as pw
+    from tests.utils import capture_rows
+
+    class OutSchema(pw.Schema):
+        ret: int
+
+    class Flaky(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value, grp) -> dict:
+            if value == 2:
+                raise RuntimeError("boom")
+            return {"ret": value * 10}
+
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"value": int, "grp": int}),
+        [(1, 0), (2, 0), (3, 1)],
+    )
+    tr = Flaky(input_table=t, instance=t.grp)
+    finished = tr.finished
+    rows = capture_rows(finished)
+    by_status = {}
+    for r in rows:
+        by_status.setdefault(r["_async_status"], []).append(r["ret"])
+    # group 0 wholly FAILURE (value=1 succeeded but shares the instance with the
+    # failure); group 1 SUCCESS
+    assert by_status.get("-FAILURE-", []) == [None, None]
+    assert by_status.get("-SUCCESS-") == [30]
+
+
+def test_async_transformer_with_options_retry():
+    import pathway_tpu as pw
+    from tests.utils import capture_rows
+
+    class OutSchema(pw.Schema):
+        ret: int
+
+    attempts = {"n": 0}
+
+    class Retrying(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            attempts["n"] += 1
+            if attempts["n"] < 3:
+                raise RuntimeError("transient")
+            return {"ret": value}
+
+    t = pw.debug.table_from_rows(pw.schema_builder({"value": int}), [(7,)])
+    tr = Retrying(input_table=t).with_options(
+        retry_strategy=pw.udfs.FixedDelayRetryStrategy(max_retries=5, delay_ms=1)
+    )
+    rows = capture_rows(tr.successful)
+    assert rows == [{"ret": 7}] and attempts["n"] == 3
+
+
+def test_gradual_broadcast_hysteresis():
+    """Threshold drift re-emits only rows the band moved past (reference
+    gradual_broadcast.rs hysteresis)."""
+    import pathway_tpu as pw
+    from tests.utils import T, capture_update_stream
+
+    t = T(
+        """
+        name
+        a
+        b
+        c
+        d
+        e
+        f
+        """
+    )
+    thr = T(
+        """
+        lower | value | upper | __time__
+        0.0   | 0.5   | 1.0   | 0
+        0.4   | 0.6   | 1.0   | 4
+        """
+    )
+    res = t._gradual_broadcast(thr, thr.lower, thr.value, thr.upper)
+    events = capture_update_stream(res)
+    first = [e for e in events if e["__diff__"] == 1 and e["__time__"] == min(ev["__time__"] for ev in events)]
+    assert len(first) == 6
+    # after the band narrows to [0.4, 1.0], only rows whose apx fell below 0.4 move
+    moved = [e for e in events if e["__time__"] > min(ev["__time__"] for ev in events)]
+    retracted = [e for e in moved if e["__diff__"] == -1]
+    for e in retracted:
+        assert e["apx_value"] < 0.4  # rows inside the new band stayed put
+    readded = [e for e in moved if e["__diff__"] == 1]
+    for e in readded:
+        assert 0.4 <= e["apx_value"] <= 1.0
+    assert len(retracted) == len(readded) > 0
