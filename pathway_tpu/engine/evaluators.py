@@ -1223,8 +1223,47 @@ class HavingEvaluator(Evaluator):
 
 
 class WithUniverseOfEvaluator(Evaluator):
+    """Runtime enforcement of the promised universe equality (the reference's
+    engine rekeys onto the other universe and fails on mismatch; here both key
+    sets are tracked and verified once the stream is final)."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        from pathway_tpu.engine.index import KeyIndex
+
+        self.self_keys = KeyIndex()
+        self.other_keys = KeyIndex()
+
     def process(self, input_deltas: List[Delta]) -> Delta:
-        return input_deltas[0]
+        self_delta, other_delta = input_deltas
+        for delta, idx in ((self_delta, self.self_keys), (other_delta, self.other_keys)):
+            if not len(delta):
+                continue
+            # removals first: an in-place update (-1 old, +1 new on one key in one
+            # delta) must leave the key PRESENT regardless of row order
+            ins = delta.diffs > 0
+            if (~ins).any():
+                idx.remove(delta.keys[~ins])
+            if ins.any():
+                idx.upsert(delta.keys[ins])
+        return self_delta
+
+    def verify_universes(self) -> None:
+        """Called at stream end: the promised key-set equality must actually hold."""
+        from pathway_tpu.internals.keys import keys_to_pointers
+
+        a_keys, _ = self.self_keys.items()
+        b_keys, _ = self.other_keys.items()
+        only_a = self.other_keys.lookup(a_keys) < 0 if len(a_keys) else np.zeros(0, bool)
+        only_b = self.self_keys.lookup(b_keys) < 0 if len(b_keys) else np.zeros(0, bool)
+        if only_a.any() or only_b.any():
+            sample_a = keys_to_pointers(a_keys[only_a][:3]) if only_a.any() else []
+            sample_b = keys_to_pointers(b_keys[only_b][:3]) if only_b.any() else []
+            raise RuntimeError(
+                "with_universe_of: promised universe equality violated at runtime — "
+                f"{int(only_a.sum())} key(s) only in the table (e.g. {sample_a}), "
+                f"{int(only_b.sum())} only in the other (e.g. {sample_b})"
+            )
 
 
 class FlattenEvaluator(Evaluator):
@@ -1730,13 +1769,22 @@ class ExternalIndexEvaluator(Evaluator):
             )
             ptrs = keys_to_pointers(index_delta.keys)
             add_mask = index_delta.diffs > 0
-            for i in range(len(index_delta)):
-                if add_mask[i]:
-                    self.index.add(
-                        ptrs[i], vectors[i], filters[i] if filters is not None else None
-                    )
-                else:
-                    self.index.remove(ptrs[i])
+            bulk_add = getattr(self.index, "add_many", None)
+            if bulk_add is not None and add_mask.all():
+                # pure-insert commit: one staged batch + one capacity jump
+                bulk_add(
+                    ptrs,
+                    list(vectors),
+                    list(filters) if filters is not None else None,
+                )
+            else:
+                for i in range(len(index_delta)):
+                    if add_mask[i]:
+                        self.index.add(
+                            ptrs[i], vectors[i], filters[i] if filters is not None else None
+                        )
+                    else:
+                        self.index.remove(ptrs[i])
 
         out_keys, out_diffs, out_rows = [], [], []
         if len(query_delta):

@@ -520,12 +520,14 @@ class GraphRunner:
         return any(_has_pending(e) for e in self.evaluators.values())
 
     def finish(self) -> None:
-        from pathway_tpu.engine.evaluators import OutputEvaluator
+        from pathway_tpu.engine.evaluators import OutputEvaluator, WithUniverseOfEvaluator
 
         for node in self._nodes:
             evaluator = self.evaluators.get(node.id)
             if isinstance(evaluator, OutputEvaluator):
                 evaluator.finish()
+            elif isinstance(evaluator, WithUniverseOfEvaluator):
+                evaluator.verify_universes()
         if self._persistence is not None:
             self._persistence.close()
         if self._monitor is not None:

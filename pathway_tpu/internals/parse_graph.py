@@ -198,12 +198,14 @@ class TimedSourceClock:
         return nt is not None and nt == self._round_min
 
 
+_GLOBAL_UNIVERSE_COUNTER = itertools.count()
+
+
 class ParseGraph:
     """Global mutable DAG; cleared by ``G.clear()`` between test runs."""
 
     def __init__(self) -> None:
         self.nodes: List[Node] = []
-        self._universe_counter = itertools.count()
         self.error_logs: List["Table"] = []
         # shared clock for debug _TimedSource streams (global __time__ order)
         self.timed_source_clock = TimedSourceClock()
@@ -222,13 +224,17 @@ class ParseGraph:
         return node
 
     def new_universe_id(self) -> int:
-        return next(self._universe_counter)
+        # ids are PROCESS-global: iterate() builds nested ParseGraphs whose
+        # universes share the one solver — per-graph counters would alias
+        return next(_GLOBAL_UNIVERSE_COUNTER)
 
     def clear(self) -> None:
         self.nodes.clear()
         self.error_logs.clear()
         self.timed_source_clock.clear()
-        self._universe_counter = itertools.count()
+        # relations of the dropped graph's universes are garbage (ids are global
+        # and never reused, but unbounded growth across test runs serves nothing)
+        universe_solver.clear()
 
     def sig(self) -> str:
         digest = hashlib.sha256()
@@ -267,9 +273,25 @@ class Universe:
 
 
 class UniverseSolver:
+    """Key-set (universe) algebra (reference ``internals/universe_solver.py``, which
+    drives a SAT solver; here the same queries resolve by structural derivation).
+
+    Universes are related by subset/equal promises AND by the algebra of the ops
+    that created them: an intersection is contained in each parent, a union
+    contains each part, a difference is contained in its left argument and is
+    disjoint from its right. ``query_is_subset`` derives through all of these.
+    """
+
     def __init__(self) -> None:
+        self.clear()
+
+    def clear(self) -> None:
         self.subset: set[tuple[int, int]] = set()
         self.equal: dict[int, int] = {}
+        self.intersections: dict[int, list[int]] = {}
+        self.unions: dict[int, list[int]] = {}
+        self.differences: dict[int, tuple[int, int]] = {}
+        self.disjoint: set[tuple[int, int]] = set()
 
     def _root(self, u: int) -> int:
         while self.equal.get(u, u) != u:
@@ -282,27 +304,77 @@ class UniverseSolver:
     def register_equal(self, a: Universe, b: Universe) -> None:
         self.equal[self._root(a.uid)] = self._root(b.uid)
 
+    def register_intersection(self, result: Universe, parents: list) -> None:
+        roots = [self._root(p.uid) for p in parents]
+        r = self._root(result.uid)
+        self.intersections[r] = roots
+        for p in roots:
+            self.subset.add((r, p))
+
+    def register_union(self, result: Universe, parts: list) -> None:
+        roots = [self._root(p.uid) for p in parts]
+        r = self._root(result.uid)
+        self.unions[r] = roots
+        for p in roots:
+            self.subset.add((p, r))
+
+    def register_difference(self, result: Universe, a: Universe, b: Universe) -> None:
+        r = self._root(result.uid)
+        self.differences[r] = (self._root(a.uid), self._root(b.uid))
+        self.subset.add((r, self._root(a.uid)))
+        self._register_disjoint_roots(r, self._root(b.uid))
+
+    def register_disjoint(self, a: Universe, b: Universe) -> None:
+        self._register_disjoint_roots(self._root(a.uid), self._root(b.uid))
+
+    def _register_disjoint_roots(self, a: int, b: int) -> None:
+        self.disjoint.add((a, b))
+        self.disjoint.add((b, a))
+
     def query_is_subset(self, sub: Universe, sup: Universe) -> bool:
-        a, b = self._root(sub.uid), self._root(sup.uid)
+        return self._subset_roots(self._root(sub.uid), self._root(sup.uid), set())
+
+    def _subset_roots(self, a: int, b: int, busy: set) -> bool:
         if a == b:
             return True
-        # BFS through transitive subset edges
+        if (a, b) in busy:
+            return False  # cycle guard for structural recursion
+        busy = busy | {(a, b)}
+        # transitive subset edges
         seen = {a}
         frontier = [a]
         while frontier:
             u = frontier.pop()
+            if u == b:
+                return True
             for (x, y) in self.subset:
                 if x == u and y not in seen:
-                    if y == b:
-                        return True
                     seen.add(y)
                     frontier.append(y)
+        # a <= intersection(P...) iff a <= every P
+        parents = self.intersections.get(b)
+        if parents and all(self._subset_roots(a, p, busy) for p in parents):
+            return True
+        # union(Q...) <= b iff every Q <= b
+        parts = self.unions.get(a)
+        if parts and all(self._subset_roots(q, b, busy) for q in parts):
+            return True
         return False
 
     def query_are_equal(self, a: Universe, b: Universe) -> bool:
         return self._root(a.uid) == self._root(b.uid) or (
             self.query_is_subset(a, b) and self.query_is_subset(b, a)
         )
+
+    def query_are_disjoint(self, a: Universe, b: Universe) -> bool:
+        ra, rb = self._root(a.uid), self._root(b.uid)
+        if (ra, rb) in self.disjoint:
+            return True
+        # subsets of disjoint universes are disjoint
+        for (x, y) in self.disjoint:
+            if self._subset_roots(ra, x, set()) and self._subset_roots(rb, y, set()):
+                return True
+        return False
 
 
 universe_solver = UniverseSolver()

@@ -364,7 +364,11 @@ class Table(Joinable):
         """Union of rows; on key clash ``other`` wins (reference update_rows)."""
         schema = _merge_schema_strict(self._schema, other._schema, "update_rows")
         node = G.add_node(pg.UpdateRowsNode(inputs=[self, other]))
-        return Table(node, schema, name="update_rows")
+        result = Table(node, schema, name="update_rows")
+        universe_solver.register_union(
+            result._universe, [self._universe, other._universe]
+        )
+        return result
 
     def update_cells(self, other: "Table") -> "Table":
         """Update values of other's columns on matching keys (other ⊆ self)."""
@@ -381,7 +385,11 @@ class Table(Joinable):
         for t in tables[1:]:
             schema = _merge_schema_strict(schema, t._schema, "concat")
         node = G.add_node(pg.ConcatNode(inputs=tables, reindex=False))
-        return Table(node, schema, name="concat")
+        result = Table(node, schema, name="concat")
+        universe_solver.register_union(
+            result._universe, [t._universe for t in tables]
+        )
+        return result
 
     def concat_reindex(self, *others: "Table") -> "Table":
         tables = [self, *others]
@@ -394,13 +402,17 @@ class Table(Joinable):
     def intersect(self, *others: "Table") -> "Table":
         node = G.add_node(pg.IntersectNode(inputs=[self, *others]))
         result = Table(node, self._schema, name="intersect")
-        universe_solver.register_subset(result._universe, self._universe)
+        universe_solver.register_intersection(
+            result._universe, [self._universe, *(o._universe for o in others)]
+        )
         return result
 
     def difference(self, other: "Table") -> "Table":
         node = G.add_node(pg.DifferenceNode(inputs=[self, other]))
         result = Table(node, self._schema, name="difference")
-        universe_solver.register_subset(result._universe, self._universe)
+        universe_solver.register_difference(
+            result._universe, self._universe, other._universe
+        )
         return result
 
     def restrict(self, other: "Table") -> "Table":
@@ -422,6 +434,7 @@ class Table(Joinable):
         return Table(node, self._schema, universe=other._universe, name="with_universe_of")
 
     def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        universe_solver.register_disjoint(self._universe, other._universe)
         return self
 
     def promise_universe_is_subset_of(self, other: "Table") -> "Table":

@@ -4,6 +4,6 @@ Parity: reference ``io/http/`` with ``_server.py`` (``PathwayWebserver``, ``rest
 Implementation lives in ``_server`` (aiohttp-based).
 """
 
-from pathway_tpu.io.http._server import PathwayWebserver, rest_connector
+from pathway_tpu.io.http._server import EndpointDocumentation, PathwayWebserver, rest_connector
 
-__all__ = ["PathwayWebserver", "rest_connector"]
+__all__ = ["EndpointDocumentation", "PathwayWebserver", "rest_connector"]

@@ -22,23 +22,175 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 
 
-class PathwayWebserver:
-    """One aiohttp server shared by any number of rest_connector endpoints."""
+class EndpointDocumentation:
+    """Per-endpoint settings for the OpenAPI v3 document (reference
+    ``io/http/_server.py:126``)."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
+    DEFAULT_RESPONSES = {
+        "200": {"description": "OK"},
+        "400": {
+            "description": "The request is incorrect. Please check if it complies "
+            "with the endpoint's input schema"
+        },
+    }
+
+    def __init__(
+        self,
+        *,
+        summary: str | None = None,
+        description: str | None = None,
+        tags: Sequence[str] | None = None,
+        method_types: Sequence[str] | None = None,
+    ):
+        self.summary = summary
+        self.description = description
+        self.tags = list(tags) if tags else None
+        self.method_types = (
+            {m.upper() for m in method_types} if method_types is not None else None
+        )
+
+    def generate_docs(self, method: str, schema: Any) -> dict | None:
+        method = method.upper()
+        if self.method_types is not None and method not in self.method_types:
+            return None
+        entry: dict = {"responses": dict(self.DEFAULT_RESPONSES)}
+        if self.summary:
+            entry["summary"] = self.summary
+        if self.description:
+            entry["description"] = self.description
+        if self.tags:
+            entry["tags"] = self.tags
+        properties, required = _openapi_schema_fields(schema)
+        if method == "GET":
+            entry["parameters"] = [
+                {
+                    "name": name,
+                    "in": "query",
+                    "required": name in required,
+                    "schema": spec,
+                }
+                for name, spec in properties.items()
+            ]
+        else:
+            entry["requestBody"] = {
+                "content": {
+                    "application/json": {
+                        "schema": {
+                            "type": "object",
+                            "properties": properties,
+                            "required": sorted(required),
+                        }
+                    }
+                },
+                "required": True,
+            }
+        return entry
+
+
+def _openapi_schema_fields(schema: Any) -> tuple[dict, set]:
+    from pathway_tpu.internals import dtype as dt
+
+    type_map = {
+        dt.INT: {"type": "integer"},
+        dt.FLOAT: {"type": "number"},
+        dt.BOOL: {"type": "boolean"},
+        dt.STR: {"type": "string"},
+        dt.JSON: {"type": "object"},
+        dt.BYTES: {"type": "string", "format": "binary"},
+    }
+    properties: dict = {}
+    required: set = set()
+    for name, col in schema.columns().items():
+        base = col.dtype.strip_optional()
+        properties[name] = dict(type_map.get(base, {"type": "string"}))
+        has_default = getattr(col, "has_default", False)
+        if has_default() if callable(has_default) else has_default:
+            if col.default_value is not None and col.default_value is not ...:
+                properties[name]["default"] = col.default_value
+        elif col.dtype == base:  # non-optional, no default
+            required.add(name)
+    return properties, required
+
+
+class PathwayWebserver:
+    """One aiohttp server shared by any number of rest_connector endpoints.
+
+    When ``openapi_docs_path`` is set (default ``/_schema``), the server exposes the
+    auto-generated OpenAPI v3 document for every registered endpoint (reference
+    ``EndpointDocumentation`` docgen)."""
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        with_cors: bool = False,
+        openapi_docs_path: str | None = "/_schema",
+    ):
         self.host = host
         self.port = port
         self.with_cors = with_cors
+        self.openapi_docs_path = openapi_docs_path
         self._routes: Dict[tuple, Any] = {}
+        self._docs: Dict[tuple, tuple] = {}  # (method, route) -> (schema, docs)
         self._started = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._runner = None
 
+    def _register_docs(
+        self,
+        route: str,
+        methods: Sequence[str],
+        schema: Any,
+        documentation: "EndpointDocumentation | None" = None,
+    ) -> None:
+        # documentation is declared at connector-construction time (before any
+        # engine run), so the OpenAPI document is complete without serving
+        for method in methods:
+            self._docs[(method.upper(), route)] = (
+                schema,
+                documentation or EndpointDocumentation(),
+            )
+
     def _register(self, route: str, methods: Sequence[str], handler: Any) -> None:
+        if (
+            self.openapi_docs_path is not None
+            and route == self.openapi_docs_path
+            and any(m.upper() == "GET" for m in methods)
+        ):
+            raise ValueError(
+                f"route {route!r} collides with the OpenAPI docs endpoint; pass "
+                "openapi_docs_path=None (or another path) to PathwayWebserver"
+            )
         for method in methods:
             self._routes[(method.upper(), route)] = handler
+        if self.openapi_docs_path is not None:
+            self._routes.setdefault(("GET", self.openapi_docs_path), self._serve_openapi)
         self._ensure_running()
+
+    async def _serve_openapi(self, request: Any) -> Any:
+        import aiohttp.web as web
+        import json as _json
+
+        return web.Response(
+            text=_json.dumps(self.openapi_description()),
+            content_type="application/json",
+        )
+
+    def openapi_description(self) -> dict:
+        """The OpenAPI v3 document covering every documented endpoint."""
+        paths: dict = {}
+        for (method, route), (schema, docs) in sorted(self._docs.items()):
+            entry = docs.generate_docs(method, schema)
+            if entry is None:
+                continue
+            paths.setdefault(route, {})[method.lower()] = entry
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": "Pathway-TPU API", "version": "1.0.0"},
+            "servers": [{"url": f"http://{self.host}:{self.port}"}],
+            "paths": paths,
+        }
 
     def _ensure_running(self) -> None:
         if self._thread is not None:
@@ -88,6 +240,7 @@ class RestServerSubject:
         schema: sch.SchemaMetaclass,
         delete_completed_queries: bool,
         request_validator: Any = None,
+        documentation: "EndpointDocumentation | None" = None,
     ):
         self.webserver = webserver
         self.route = route
@@ -95,6 +248,7 @@ class RestServerSubject:
         self.schema = schema
         self.delete_completed_queries = delete_completed_queries
         self.request_validator = request_validator
+        self.documentation = documentation
         self.futures: Dict[bytes, "asyncio.Future"] = {}
         self._counter = 0
         self._lock = threading.Lock()
@@ -175,6 +329,7 @@ def rest_connector(
     keep_queries: bool | None = None,
     delete_completed_queries: bool = False,
     request_validator: Any = None,
+    documentation: "EndpointDocumentation | None" = None,
 ) -> tuple[Table, Any]:
     """Expose an HTTP endpoint as a streaming table; returns (queries, response_writer)."""
     if webserver is None:
@@ -182,8 +337,10 @@ def rest_connector(
     if schema is None:
         schema = sch.schema_from_types(query=str)
     subject = RestServerSubject(
-        webserver, route, methods, schema, delete_completed_queries, request_validator
+        webserver, route, methods, schema, delete_completed_queries, request_validator,
+        documentation=documentation,
     )
+    webserver._register_docs(route, methods, schema, documentation)
 
     class _Runner:
         def run(self, source: StreamingDataSource) -> None:

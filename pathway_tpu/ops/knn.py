@@ -43,6 +43,36 @@ def _search_kernel(
     return top_scores, top_idx
 
 
+def pad_pow2(slots: np.ndarray, vecs: "np.ndarray | None" = None, extras: "np.ndarray | None" = None):
+    """Pad a scatter batch to a power-of-two bucket so the update kernel compiles
+    once per (bucket, capacity) pair; padding repeats row 0 (duplicate scatter
+    indices with identical values are no-ops)."""
+    n = len(slots)
+    if n == 0:
+        return slots, vecs, extras
+    bucket = 8
+    while bucket < n:
+        bucket *= 2
+    if bucket != n:
+        pad = bucket - n
+        slots = np.concatenate([slots, np.full(pad, slots[0], slots.dtype)])
+        if vecs is not None:
+            vecs = np.concatenate([vecs, np.repeat(vecs[:1], pad, axis=0)])
+        if extras is not None:
+            extras = np.concatenate([extras, np.repeat(extras[:1], pad, axis=0)])
+    return slots, vecs, extras
+
+
+def pow2_target(capacity: int, target: "int | None") -> int:
+    """Next capacity: at least double, jumping straight past ``target`` (every
+    distinct capacity costs an XLA compile of the resize/scatter shapes)."""
+    new_capacity = capacity * 2
+    if target is not None:
+        while new_capacity < target:
+            new_capacity *= 2
+    return new_capacity
+
+
 class SlotIngestMixin:
     """Host-staged keyed slot assignment shared by the dense and sharded stores.
 
@@ -74,8 +104,8 @@ class SlotIngestMixin:
             vectors = vectors[keep]
         for k in [k for k in keys if k in self.slot_of]:
             self.remove(k)
-        while len(self._free) < len(keys):
-            self._grow()
+        if len(self._free) < len(keys):
+            self._grow(target=self.capacity + len(keys) - len(self._free))
         slots = [self._free.pop() for _ in range(len(keys))]
         self.slot_of.update(zip(keys, slots))
         self.key_of.update(zip(slots, keys))
@@ -125,34 +155,40 @@ class DenseKNNStore(SlotIngestMixin):
     def __len__(self) -> int:
         return len(self.slot_of)
 
-    def _grow(self) -> None:
-        new_capacity = self.capacity * 2
+    def _grow(self, target: int | None = None) -> None:
+        new_capacity = pow2_target(self.capacity, target)
         self._flush()
+        extra = new_capacity - self.capacity
         self._data = jnp.concatenate(
-            [self._data, jnp.zeros((self.capacity, self.dim), dtype=self.dtype)]
+            [self._data, jnp.zeros((extra, self.dim), dtype=self.dtype)]
         )
-        self._valid = jnp.concatenate([self._valid, jnp.zeros((self.capacity,), dtype=bool)])
+        self._valid = jnp.concatenate([self._valid, jnp.zeros((extra,), dtype=bool)])
         self._norms = jnp.concatenate(
-            [self._norms, jnp.zeros((self.capacity,), dtype=jnp.float32)]
+            [self._norms, jnp.zeros((extra,), dtype=jnp.float32)]
         )
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
         self.capacity = new_capacity
 
     def _flush(self) -> None:
+        # staged batches pad to power-of-two buckets so the scatter kernels compile
+        # once per (bucket, capacity) pair instead of once per batch size (padding
+        # rows re-write slot[0] with its own values — a no-op)
         if self._staged_slots:
-            slots = jnp.asarray(np.array(self._staged_slots, dtype=np.int32))
-            vecs = jnp.asarray(np.stack(self._staged_vecs).astype(np.float32))
+            slots_np = np.array(self._staged_slots, dtype=np.int32)
+            vecs_np = np.stack(self._staged_vecs).astype(np.float32)
+            slots_np, vecs_np, _ = pad_pow2(slots_np, vecs_np)
+            slots = jnp.asarray(slots_np)
+            vecs = jnp.asarray(vecs_np)
             self._data = self._data.at[slots].set(vecs.astype(self.dtype))
             self._norms = self._norms.at[slots].set(jnp.sum(vecs * vecs, axis=1))
             self._valid = self._valid.at[slots].set(True)
             self._staged_slots, self._staged_vecs = [], []
         if self._staged_invalid:
-            slots = jnp.asarray(np.array(sorted(set(self._staged_invalid)), dtype=np.int32))
-            self._valid = self._valid.at[slots].set(
-                jnp.asarray(
-                    [s in self.key_of for s in sorted(set(self._staged_invalid))], dtype=bool
-                )
-            )
+            inv = sorted(set(self._staged_invalid))
+            flags_np = np.array([s in self.key_of for s in inv], dtype=bool)
+            slots_np = np.array(inv, dtype=np.int32)
+            slots_np, _, flags_np = pad_pow2(slots_np, extras=flags_np)
+            self._valid = self._valid.at[jnp.asarray(slots_np)].set(jnp.asarray(flags_np))
             self._staged_invalid = []
 
     def search_batch(self, queries: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -209,6 +245,18 @@ class BruteForceKnnIndex:
         self.store.add(key, _as_vector(vector))
         if filter_data is not None:
             self.filter_data[key] = filter_data
+
+    def add_many(
+        self, keys: List[Any], vectors: List[Any], filter_data: List[Any] | None = None
+    ) -> None:
+        """Bulk ingest: ONE staging append + one capacity jump for the whole batch
+        (per-row adds through a growing device array would pay an XLA compile per
+        capacity step)."""
+        self.store.add_many(keys, np.stack([np.asarray(_as_vector(v)) for v in vectors]))
+        if filter_data is not None:
+            for k, f in zip(keys, filter_data):
+                if f is not None:
+                    self.filter_data[k] = f
 
     def remove(self, key: Any) -> None:
         self.store.remove(key)

@@ -25,7 +25,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pathway_tpu.ops.knn import SlotIngestMixin
+from pathway_tpu.ops.knn import SlotIngestMixin, pad_pow2, pow2_target
 
 
 def _local_search(
@@ -110,19 +110,20 @@ class ShardedKNNStore(SlotIngestMixin):
         return len(self.slot_of)
 
 
-    def _grow(self) -> None:
+    def _grow(self, target: int | None = None) -> None:
         self._flush()
         old = self.capacity
-        self.capacity = old * 2
+        self.capacity = pow2_target(old, target)
+        extra = self.capacity - old
         self._data = jax.device_put(
-            jnp.concatenate([self._data, jnp.zeros((old, self.dim), jnp.float32)]),
+            jnp.concatenate([self._data, jnp.zeros((extra, self.dim), jnp.float32)]),
             self._row_sharding,
         )
         self._valid = jax.device_put(
-            jnp.concatenate([self._valid, jnp.zeros((old,), bool)]), self._vec_sharding
+            jnp.concatenate([self._valid, jnp.zeros((extra,), bool)]), self._vec_sharding
         )
         self._norms = jax.device_put(
-            jnp.concatenate([self._norms, jnp.zeros((old,), jnp.float32)]),
+            jnp.concatenate([self._norms, jnp.zeros((extra,), jnp.float32)]),
             self._vec_sharding,
         )
         self._free = _interleaved_free_list(old, self.capacity, self.n_shards) + self._free
@@ -138,6 +139,8 @@ class ShardedKNNStore(SlotIngestMixin):
             set_vecs = np.zeros((0, self.dim), dtype=np.float32)
         still_invalid = [s for s in set(self._staged_invalid) if s not in self.key_of]
         inv_slots = np.array(sorted(still_invalid), dtype=np.int32)
+        set_slots, set_vecs, _ = pad_pow2(set_slots, set_vecs)
+        inv_slots, _, _ = pad_pow2(inv_slots)
         self._data, self._valid, self._norms = self._update(
             self._data,
             self._valid,

@@ -470,3 +470,49 @@ def test_sql():
 
     agg = pw.sql("SELECT sum(a) AS total FROM tab", tab=t)
     assert capture_rows(agg) == [{"total": 9}]
+
+
+def test_universe_algebra_structural_queries():
+    """Intersect/union/difference key-set reasoning (reference universe_solver's
+    SAT queries, derived structurally here)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import universe_solver
+
+    a = pw.debug.table_from_rows(pw.schema_builder({"x": int}), [(1,), (2,), (3,)])
+    b = a.filter(a.x > 1)
+    c = a.filter(a.x < 3)
+    inter = b.intersect(c)
+    # intersection is inside each parent
+    assert universe_solver.query_is_subset(inter._universe, b._universe)
+    assert universe_solver.query_is_subset(inter._universe, c._universe)
+    # b <= intersection's parents individually does NOT imply b inside inter
+    assert not universe_solver.query_is_subset(b._universe, inter._universe)
+    # x <= intersect(b, c) when x <= b and x <= c
+    d = b.intersect(c).filter(pw.this.x == 2)
+    assert universe_solver.query_is_subset(d._universe, inter._universe)
+
+    u = b.concat(c.difference(b))
+    # every part sits inside the union
+    assert universe_solver.query_is_subset(b._universe, u._universe)
+    # union <= a because each part <= a
+    assert universe_solver.query_is_subset(u._universe, a._universe)
+
+    diff = a.difference(b)
+    assert universe_solver.query_is_subset(diff._universe, a._universe)
+    # difference is disjoint from its right argument
+    assert universe_solver.query_are_disjoint(diff._universe, b._universe)
+
+
+def test_with_universe_of_runtime_violation():
+    import pytest
+
+    import pathway_tpu as pw
+
+    a = pw.debug.table_from_rows(pw.schema_builder({"x": int}), [(1,), (2,), (3,)])
+    b = pw.debug.table_from_rows(pw.schema_builder({"y": int}), [(10,), (20,)])
+    # force the promise although the key sets differ — runtime must catch the lie
+    a.promise_universes_are_equal(b)
+    res = a.with_universe_of(b)
+    pw.io.subscribe(res, on_batch=lambda *args: None)
+    with pytest.raises(RuntimeError, match="universe equality violated"):
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)

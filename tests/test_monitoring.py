@@ -45,3 +45,43 @@ def test_prober_stats_fed_by_run():
     assert len(seen) == 2
     metrics = runner.prober_stats.to_openmetrics()
     assert "input_latency_ms -1" in metrics  # finished
+
+
+def test_rest_openapi_schema_endpoint():
+    """Auto-generated OpenAPI v3 docs served at /_schema (reference
+    EndpointDocumentation, io/http/_server.py:126)."""
+    import json
+    import urllib.request
+
+    import pathway_tpu as pw
+    from pathway_tpu.io.http import EndpointDocumentation, PathwayWebserver, rest_connector
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    port = 18951
+    ws = PathwayWebserver(host="127.0.0.1", port=port)
+
+    class QuerySchema(pw.Schema):
+        query: str
+        k: int = pw.column_definition(default_value=3, dtype=int)
+
+    rest_connector(
+        webserver=ws,
+        route="/v1/ask",
+        schema=QuerySchema,
+        methods=("POST", "GET"),
+        documentation=EndpointDocumentation(
+            summary="Ask a question", tags=["rag"], method_types=("POST",)
+        ),
+    )
+    doc = ws.openapi_description()
+    assert doc["openapi"].startswith("3.")
+    ask = doc["paths"]["/v1/ask"]
+    assert "post" in ask and "get" not in ask  # method_types filter
+    body = ask["post"]["requestBody"]["content"]["application/json"]["schema"]
+    assert body["properties"]["query"] == {"type": "string"}
+    assert body["properties"]["k"]["type"] == "integer"
+    assert body["properties"]["k"]["default"] == 3
+    assert body["required"] == ["query"]
+    assert ask["post"]["summary"] == "Ask a question"
+
