@@ -16,6 +16,7 @@
 #define XXH_INLINE_ALL
 #include "xxhash.h"
 
+#include <cassert>
 #include <cerrno>
 #include <cstdint>
 #include <cstdlib>
@@ -564,14 +565,8 @@ struct KeyIndex {
     filled = live;  // tombstones vanish on rebuild
   }
 
-  void rehash_if_needed() {
-    uint64_t cap = mask + 1;
-    if (static_cast<uint64_t>(filled) * 4 < cap * 3) return;
-    // tombstone-dominated tables rebuild at the SAME size (purge, not grow) so
-    // insert/remove churn with constant live keys keeps memory bounded; only a
-    // genuinely full table doubles
-    uint64_t new_cap = cap;
-    while (static_cast<uint64_t>(live) * 4 >= new_cap * 2) new_cap <<= 1;
+  // Rebuild at `new_cap` (same size = tombstone purge) re-inserting live entries.
+  void rehash_to(uint64_t new_cap) {
     std::vector<uint64_t> ohi, olo;
     std::vector<int8_t> ost;
     std::vector<int64_t> osl;
@@ -589,6 +584,30 @@ struct KeyIndex {
       state[pos] = 1;
       slots[pos] = osl[i];
     }
+  }
+
+  void rehash_if_needed() {
+    uint64_t cap = mask + 1;
+    if (static_cast<uint64_t>(filled) * 2 < cap) return;  // max load 0.5
+    // tombstone-dominated tables rebuild at the SAME size (purge, not grow) so
+    // insert/remove churn with constant live keys keeps memory bounded; only a
+    // genuinely full table doubles
+    uint64_t new_cap = cap;
+    while (static_cast<uint64_t>(live) * 4 >= new_cap) new_cap <<= 1;
+    rehash_to(new_cap);
+  }
+
+  // Guarantee capacity for `extra` further inserts without a mid-batch rehash,
+  // so batch loops can prefetch probe positions safely. (If the early-return
+  // fails, the growth loop always doubles at least once: need*2 >= cap implies
+  // (live+extra)*4 >= cap whenever filled == live, and a tombstoned table is
+  // purged by the same-size rebuild.)
+  void reserve_for(uint64_t extra) {
+    uint64_t cap = mask + 1;
+    if ((static_cast<uint64_t>(filled) + extra) * 2 < cap) return;
+    uint64_t new_cap = cap;
+    while ((static_cast<uint64_t>(live) + extra) * 4 >= new_cap) new_cap <<= 1;
+    rehash_to(new_cap);
   }
 
   // Returns the table position of `key` if present, else the first insertable
@@ -678,7 +697,9 @@ int64_t pwtpu_idx_slot_bound(void* h) {
 void pwtpu_idx_upsert(void* h, const uint64_t* keys, int64_t n,
                       int64_t* out_slots, uint8_t* out_is_new) {
   KeyIndex* idx = static_cast<KeyIndex*>(h);
+  idx->reserve_for(static_cast<uint64_t>(n));
   for (int64_t i = 0; i < n; ++i) {
+    if (i + 8 < n) __builtin_prefetch(&idx->state[keys[2 * (i + 8) + 1] & idx->mask]);
     const uint64_t* k = key_hi_lo(keys, i);
     uint8_t is_new = 0;
     out_slots[i] = idx->upsert(k[0], k[1], &is_new);
@@ -690,6 +711,7 @@ void pwtpu_idx_lookup(void* h, const uint64_t* keys, int64_t n,
                       int64_t* out_slots) {
   const KeyIndex* idx = static_cast<const KeyIndex*>(h);
   for (int64_t i = 0; i < n; ++i) {
+    if (i + 8 < n) __builtin_prefetch(&idx->state[keys[2 * (i + 8) + 1] & idx->mask]);
     const uint64_t* k = key_hi_lo(keys, i);
     out_slots[i] = idx->lookup(k[0], k[1]);
   }
@@ -700,6 +722,7 @@ void pwtpu_idx_remove(void* h, const uint64_t* keys, int64_t n,
                       int64_t* out_slots) {
   KeyIndex* idx = static_cast<KeyIndex*>(h);
   for (int64_t i = 0; i < n; ++i) {
+    if (i + 8 < n) __builtin_prefetch(&idx->state[keys[2 * (i + 8) + 1] & idx->mask]);
     const uint64_t* k = key_hi_lo(keys, i);
     out_slots[i] = idx->remove(k[0], k[1]);
   }
@@ -754,10 +777,16 @@ void pwtpu_idx_items(void* h, uint64_t* out_keys, int64_t* out_slots) {
 
 namespace {
 
+// Values are dense unique non-negative ids (join-side row SLOTS): each value lives
+// in at most one bag at a time. That contract lets bags be intrusive doubly-linked
+// lists over two flat arrays indexed by value — O(1) insert/remove, no per-key
+// allocation, and a rehash that only moves the fixed-size header entries.
 struct MultiMap {
   std::vector<uint64_t> khi, klo;
   std::vector<int8_t> state;
-  std::vector<std::vector<int64_t>> vals;
+  std::vector<int64_t> head;  // first value in the bag
+  std::vector<int64_t> cnt;   // bag size
+  std::vector<int64_t> nxt, prv;  // intrusive links, indexed by value
   uint64_t mask = 0;
   int64_t live = 0;
   int64_t filled = 0;
@@ -769,26 +798,31 @@ struct MultiMap {
     khi.assign(cap, 0);
     klo.assign(cap, 0);
     state.assign(cap, 0);
-    vals.assign(cap, {});
+    head.assign(cap, -1);
+    cnt.assign(cap, 0);
     mask = cap - 1;
     filled = live;
   }
 
-  void rehash_if_needed() {
-    uint64_t cap = mask + 1;
-    if (static_cast<uint64_t>(filled) * 4 < cap * 3) return;
-    // tombstone-dominated tables rebuild at the SAME size (purge, not grow) so
-    // insert/remove churn with constant live keys keeps memory bounded; only a
-    // genuinely full table doubles
-    uint64_t new_cap = cap;
-    while (static_cast<uint64_t>(live) * 4 >= new_cap * 2) new_cap <<= 1;
+  void ensure_links(int64_t v) {
+    assert(v >= 0 && "MultiMap values must be non-negative slot ids");
+    if (static_cast<size_t>(v) >= nxt.size()) {
+      size_t n = nxt.size() ? nxt.size() : 64;
+      while (n <= static_cast<size_t>(v)) n *= 2;
+      nxt.resize(n, -1);
+      prv.resize(n, -1);
+    }
+  }
+
+  void rehash_to(uint64_t new_cap) {
     std::vector<uint64_t> ohi, olo;
     std::vector<int8_t> ost;
-    std::vector<std::vector<int64_t>> ovl;
+    std::vector<int64_t> ohd, ocn;
     ohi.swap(khi);
     olo.swap(klo);
     ost.swap(state);
-    ovl.swap(vals);
+    ohd.swap(head);
+    ocn.swap(cnt);
     rebuild(new_cap);
     for (uint64_t i = 0; i < ost.size(); ++i) {
       if (ost[i] != 1) continue;
@@ -797,8 +831,17 @@ struct MultiMap {
       khi[pos] = ohi[i];
       klo[pos] = olo[i];
       state[pos] = 1;
-      vals[pos] = std::move(ovl[i]);
+      head[pos] = ohd[i];
+      cnt[pos] = ocn[i];
     }
+  }
+
+  void rehash_if_needed() {
+    uint64_t cap = mask + 1;
+    if (static_cast<uint64_t>(filled) * 2 < cap) return;  // max load 0.5
+    uint64_t new_cap = cap;
+    while (static_cast<uint64_t>(live) * 4 >= new_cap) new_cap <<= 1;
+    rehash_to(new_cap);
   }
 
   uint64_t find(uint64_t hi, uint64_t lo, bool* found) const {
@@ -828,39 +871,57 @@ struct MultiMap {
       khi[pos] = hi;
       klo[pos] = lo;
       state[pos] = 1;
-      vals[pos].clear();
+      head[pos] = -1;
+      cnt[pos] = 0;
       ++live;
     }
-    vals[pos].push_back(v);
+    ensure_links(v);
+    int64_t h = head[pos];
+    nxt[v] = h;
+    prv[v] = -1;
+    if (h >= 0) prv[h] = v;
+    head[pos] = v;
+    ++cnt[pos];
     ++total_vals;
   }
 
-  // Removes one occurrence of v (swap-remove: bag semantics). Returns true if found.
+  // Removes v from the bag at `key` (unique-value contract). Returns true if found.
   bool remove(uint64_t hi, uint64_t lo, int64_t v) {
     bool found = false;
     uint64_t pos = find(hi, lo, &found);
     if (!found) return false;
-    std::vector<int64_t>& bag = vals[pos];
-    for (size_t i = 0; i < bag.size(); ++i) {
-      if (bag[i] == v) {
-        bag[i] = bag.back();
-        bag.pop_back();
-        --total_vals;
-        if (bag.empty()) {
-          state[pos] = 2;
-          bag.shrink_to_fit();
-          --live;
-        }
-        return true;
-      }
+    if (static_cast<size_t>(v) >= nxt.size()) return false;
+    // verify membership: v's chain must reach from head (prv==-1 means v is a head
+    // of SOME bag; confirm it's this one)
+    if (prv[v] < 0 && head[pos] != v) return false;
+    if (prv[v] < 0 && head[pos] == v) {
+      head[pos] = nxt[v];
+      if (nxt[v] >= 0) prv[nxt[v]] = -1;
+    } else {
+      nxt[prv[v]] = nxt[v];
+      if (nxt[v] >= 0) prv[nxt[v]] = prv[v];
     }
-    return false;
+    nxt[v] = -1;
+    prv[v] = -1;
+    --total_vals;
+    if (--cnt[pos] == 0) {
+      state[pos] = 2;
+      head[pos] = -1;
+      --live;
+    }
+    return true;
   }
 
-  const std::vector<int64_t>* get(uint64_t hi, uint64_t lo) const {
+  // Bag accessors (head/cnt by table position; -1/0 when absent).
+  int64_t bag_head(uint64_t hi, uint64_t lo) const {
     bool found = false;
     uint64_t pos = find(hi, lo, &found);
-    return found ? &vals[pos] : nullptr;
+    return found ? head[pos] : -1;
+  }
+  int64_t bag_count(uint64_t hi, uint64_t lo) const {
+    bool found = false;
+    uint64_t pos = find(hi, lo, &found);
+    return found ? cnt[pos] : 0;
   }
 };
 
@@ -897,10 +958,13 @@ int64_t pwtpu_mm_count(void* h, const uint64_t* keys, int64_t n,
                        int64_t* out_counts) {
   const MultiMap* mm = static_cast<const MultiMap*>(h);
   int64_t total = 0;
+  const uint64_t msk = mm->mask;
   for (int64_t i = 0; i < n; ++i) {
+    if (i + 8 < n) {
+      __builtin_prefetch(&mm->state[keys[2 * (i + 8) + 1] & msk]);
+    }
     const uint64_t* k = key_hi_lo(keys, i);
-    const std::vector<int64_t>* bag = mm->get(k[0], k[1]);
-    int64_t c = bag != nullptr ? static_cast<int64_t>(bag->size()) : 0;
+    int64_t c = mm->bag_count(k[0], k[1]);
     out_counts[i] = c;
     total += c;
   }
@@ -913,11 +977,15 @@ void pwtpu_mm_fill(void* h, const uint64_t* keys, int64_t n,
                    int64_t* out_values) {
   const MultiMap* mm = static_cast<const MultiMap*>(h);
   int64_t w = 0;
+  const uint64_t msk = mm->mask;
   for (int64_t i = 0; i < n; ++i) {
+    if (i + 8 < n) {
+      __builtin_prefetch(&mm->state[keys[2 * (i + 8) + 1] & msk]);
+    }
     const uint64_t* k = key_hi_lo(keys, i);
-    const std::vector<int64_t>* bag = mm->get(k[0], k[1]);
-    if (bag == nullptr) continue;
-    for (int64_t v : *bag) out_values[w++] = v;
+    for (int64_t v = mm->bag_head(k[0], k[1]); v >= 0; v = mm->nxt[v]) {
+      out_values[w++] = v;
+    }
   }
 }
 
@@ -932,7 +1000,12 @@ void pwtpu_side_insert(void* idx_h, void* mm_h, const uint64_t* row_keys,
                        uint64_t* jk_arr, int64_t* out_slots) {
   KeyIndex* idx = static_cast<KeyIndex*>(idx_h);
   MultiMap* mm = static_cast<MultiMap*>(mm_h);
+  idx->reserve_for(static_cast<uint64_t>(n));
   for (int64_t i = 0; i < n; ++i) {
+    if (i + 8 < n) {
+      __builtin_prefetch(&idx->state[row_keys[2 * (i + 8) + 1] & idx->mask]);
+      __builtin_prefetch(&mm->state[jkeys[2 * (i + 8) + 1] & mm->mask]);
+    }
     const uint64_t* rk = key_hi_lo(row_keys, i);
     const uint64_t* jk = key_hi_lo(jkeys, i);
     uint8_t is_new = 0;
@@ -971,7 +1044,7 @@ void pwtpu_mm_items(void* h, uint64_t* out_keys, int64_t* out_values) {
   int64_t j = 0;
   for (uint64_t pos = 0; pos <= mm->mask; ++pos) {
     if (mm->state[pos] != 1) continue;
-    for (int64_t v : mm->vals[pos]) {
+    for (int64_t v = mm->head[pos]; v >= 0; v = mm->nxt[v]) {
       out_keys[2 * j] = mm->khi[pos];
       out_keys[2 * j + 1] = mm->klo[pos];
       out_values[j] = v;

@@ -765,10 +765,26 @@ class JoinEvaluator(Evaluator):
         left_delta, right_delta = input_deltas
         cluster = getattr(self.runner, "_cluster", None)
         parts: List[Delta] = []
+        JK = self.JoinKind
         for delta, side_name in ((left_delta, "left"), (right_delta, "right")):
             if len(delta) == 0 and cluster is None:
                 continue
-            part = self._run_side(delta, side_name)
+            # Frontier optimization: own-side rows are arranged only so FUTURE
+            # other-side deltas can probe them (and, for outer kinds, so null-row
+            # bookkeeping can see past own-side counts). When the other side's
+            # subtree is closed — no delta this commit and none ever again — and
+            # the other side never emits null rows, arranging this side buys
+            # nothing: skip it. This is the static-build-side join fast path.
+            is_left = side_name == "left"
+            other_delta = right_delta if is_left else left_delta
+            other_null = self.kind in ((JK.RIGHT, JK.OUTER) if is_left else (JK.LEFT, JK.OUTER))
+            other_table = self.node.inputs[1 if is_left else 0]
+            skip_arrange = (
+                not other_null
+                and len(other_delta) == 0
+                and self.runner.subtree_closed(other_table._node)
+            )
+            part = self._run_side(delta, side_name, skip_arrange=skip_arrange)
             if part is not None and len(part):
                 parts.append(part)
         if not parts:
@@ -776,7 +792,9 @@ class JoinEvaluator(Evaluator):
         out = Delta.concat(parts, self.output_columns)
         return out.consolidated()
 
-    def _run_side(self, delta: Delta, side_name: str) -> Delta | None:
+    def _run_side(
+        self, delta: Delta, side_name: str, *, skip_arrange: bool = False
+    ) -> Delta | None:
         JK = self.JoinKind
         is_left = side_name == "left"
         own = self.left if is_left else self.right
@@ -848,16 +866,17 @@ class JoinEvaluator(Evaluator):
                 )
 
         # mutate own-side state AFTER all probes/gathers that read it
-        ret_rows = np.nonzero(diffs < 0)[0]
-        if len(ret_rows):
-            own.remove_batch(delta.keys[ret_rows])
-        ins_rows = np.nonzero(diffs > 0)[0]
-        if len(ins_rows):
-            own.insert_batch(
-                delta.keys[ins_rows],
-                jkeys[ins_rows],
-                {c: delta.columns[c][ins_rows] for c in own.names},
-            )
+        if not skip_arrange:
+            ret_rows = np.nonzero(diffs < 0)[0]
+            if len(ret_rows):
+                own.remove_batch(delta.keys[ret_rows])
+            ins_rows = np.nonzero(diffs > 0)[0]
+            if len(ins_rows):
+                own.insert_batch(
+                    delta.keys[ins_rows],
+                    jkeys[ins_rows],
+                    {c: delta.columns[c][ins_rows] for c in own.names},
+                )
 
         total = len(ev_row) + len(null_rows) + len(flip_slots)
         if total == 0:

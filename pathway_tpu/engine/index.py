@@ -225,7 +225,14 @@ class _PyKeyIndex(KeyIndex):
 
 
 class MultiMap:
-    """128-bit key -> bag of int64 values (join-key -> row slots)."""
+    """128-bit key -> bag of int64 values (join-key -> row slots).
+
+    CONTRACT: values must be dense, non-negative, and unique across the whole
+    map (each value in at most one bag at a time) — they are join-side row
+    slots. The native implementation stores bags as intrusive linked lists over
+    value-indexed arrays and silently corrupts chains if a value is inserted
+    under two keys; the Python fallback is more permissive but callers must not
+    rely on that."""
 
     def __new__(cls):
         if cls is MultiMap:

@@ -483,6 +483,44 @@ class GraphRunner:
             if not getattr(node.config["source"], "loopback", False)
         )
 
+    def subtree_closed(self, node: pg.Node) -> bool:
+        """Frontier check: True when ``node``'s operator subtree can emit no further
+        delta in any future commit (all ancestor sources finished, no pending operator
+        state anywhere in the subtree). The TPU-native stand-in for the reference's
+        frontier tracking (timely progress; ``TotalFrontier``, ``src/engine/frontier.rs``):
+        downstream operators use it to stop maintaining state that can never be probed
+        again. Conservative: returns False under journal replay, persistence, cluster
+        mode, and nested iterate runners, where closure is not locally decidable."""
+        if (
+            self._materialize_all
+            or self._inject is not None
+            or self._persistence is not None
+            or self._cluster is not None
+        ):
+            return False
+        cache = getattr(self, "_closed_cache", None)
+        if cache is None or cache[0] != self._commit:
+            cache = (self._commit, {})
+            self._closed_cache = cache
+        memo = cache[1]
+        if node.id in memo:
+            return memo[node.id]
+        memo[node.id] = False  # cycle guard (loop-back chains stay open)
+        closed = True
+        if isinstance(node, pg.InputNode):
+            closed = node.config["source"].is_finished()
+        else:
+            evaluator = self.evaluators.get(node.id)
+            if evaluator is not None and (
+                _has_pending(evaluator)
+                or getattr(evaluator, "neu_pending", _no_pending)()
+            ):
+                closed = False
+            else:
+                closed = all(self.subtree_closed(inp._node) for inp in node.inputs)
+        memo[node.id] = closed
+        return closed
+
     def _ancestor_inputs(self, node: pg.Node) -> list:
         """Transitive InputNodes feeding ``node`` (memoized)."""
         cache = getattr(self, "_ancestor_cache", None)
