@@ -223,8 +223,13 @@ class StreamingDataSource(DataSource):
             self._started = True
 
             def runner() -> None:
+                # a connector-thread failure must surface in the engine loop, not
+                # die silently with the thread (reference: connector errors
+                # terminate the run or hit the error log per terminate_on_error)
                 try:
                     self.subject.run(self)
+                except BaseException as exc:  # noqa: BLE001
+                    self.events.put(("error", exc))
                 finally:
                     self.close()
 
@@ -252,6 +257,12 @@ class StreamingDataSource(DataSource):
             if event[0] == "eof":
                 self._finished.set()
                 break
+            if event[0] == "error":
+                # re-raise the connector thread's failure on the engine loop
+                # (reference Connector error propagation; terminate_on_error and
+                # error-log routing are applied by the evaluator/runner above us)
+                self._finished.set()
+                raise event[1]
             if event[0] == "begin":
                 _, token, fp = event
                 self._in_progress = {"token": token, "fp": fp, "emitted": 0}

@@ -50,7 +50,9 @@ class Evaluator:
 
     # -- operator snapshots (reference ``operator_snapshot.rs``) -------------
 
-    _NON_STATE_ATTRS = ("node", "runner", "output_columns")
+    # _udf_memo holds non-deterministic-apply replay values (may contain device
+    # arrays, not picklable); journal replay re-runs the UDFs and rebuilds it
+    _NON_STATE_ATTRS = ("node", "runner", "output_columns", "_udf_memo")
 
     def state_dict(self) -> Dict[str, bytes]:
         """Picklable per-attribute snapshot of this operator's incremental state.
@@ -141,14 +143,25 @@ class Evaluator:
 
         return resolver
 
+    def _eval_expr(
+        self, e: expr.ColumnExpression, delta: Delta, resolver: Callable
+    ) -> np.ndarray:
+        """Evaluate with non-deterministic-apply replay wired in: retraction rows
+        reuse the value computed at insert time (see EvalContext docstring)."""
+        return ee.evaluate(
+            e,
+            len(delta),
+            resolver,
+            keys=delta.keys,
+            diffs=delta.diffs,
+            memo=self.__dict__.setdefault("_udf_memo", {}),
+        )
+
     def _eval_exprs(
         self, exprs: Dict[str, expr.ColumnExpression], table: Any, delta: Delta
     ) -> Dict[str, np.ndarray]:
         resolver = self._resolver_for(table, delta)
-        return {
-            name: ee.evaluate(e, len(delta), resolver, keys=delta.keys)
-            for name, e in exprs.items()
-        }
+        return {name: self._eval_expr(e, delta, resolver) for name, e in exprs.items()}
 
 
 class InputEvaluator(Evaluator):
@@ -408,7 +421,7 @@ class GroupbyEvaluator(Evaluator):
                     seqs = np.arange(self.seq, self.seq + n, dtype=np.int64)
                     arrays.append(seqs.astype(object))
                 else:
-                    arrays.append(ee.evaluate(a, n, resolver))
+                    arrays.append(self._eval_expr(a, delta, resolver))
             leaf_args.append(arrays)
         self.seq += n
 
@@ -758,7 +771,7 @@ class JoinEvaluator(Evaluator):
             # no on-condition: every row shares the salt-only bucket (cross join)
             return broadcast_key(pointer_from(), len(delta))
         resolver = self._resolver_for(table, delta)
-        arrays = [ee.evaluate(e, len(delta), resolver) for e in exprs]
+        arrays = [self._eval_expr(e, delta, resolver) for e in exprs]
         return keys_from_values(arrays)
 
     def process(self, input_deltas: List[Delta]) -> Delta:
@@ -865,11 +878,14 @@ class JoinEvaluator(Evaluator):
                     [np.full(len(f[0]), f[1], dtype=np.int64) for f in flips]
                 )
 
-        # mutate own-side state AFTER all probes/gathers that read it
+        # mutate own-side state AFTER all probes/gathers that read it.
+        # Retractions ALWAYS apply (rows arranged before the other side closed
+        # must still evict, or they leak for the run's lifetime); only new
+        # inserts are skipped under the frontier fast path.
+        ret_rows = np.nonzero(diffs < 0)[0]
+        if len(ret_rows):
+            own.remove_batch(delta.keys[ret_rows])
         if not skip_arrange:
-            ret_rows = np.nonzero(diffs < 0)[0]
-            if len(ret_rows):
-                own.remove_batch(delta.keys[ret_rows])
             ins_rows = np.nonzero(diffs > 0)[0]
             if len(ins_rows):
                 own.insert_batch(
@@ -1779,10 +1795,10 @@ class ExternalIndexEvaluator(Evaluator):
         if len(index_delta):
             resolver = self._resolver_for(index_table, index_delta)
             vec_ref = self.node.config["index_column"]
-            vectors = ee.evaluate(vec_ref, len(index_delta), resolver)
+            vectors = self._eval_expr(vec_ref, index_delta, resolver)
             filter_col = self.node.config.get("index_filter_data_column")
             filters = (
-                ee.evaluate(filter_col, len(index_delta), resolver)
+                self._eval_expr(filter_col, index_delta, resolver)
                 if filter_col is not None
                 else None
             )
@@ -1808,16 +1824,18 @@ class ExternalIndexEvaluator(Evaluator):
         out_keys, out_diffs, out_rows = [], [], []
         if len(query_delta):
             resolver = self._resolver_for(query_table, query_delta)
-            qvecs = ee.evaluate(self.node.config["query_column"], len(query_delta), resolver)
+            qvecs = self._eval_expr(
+                self.node.config["query_column"], query_delta, resolver
+            )
             limit_col = self.node.config.get("query_responses_limit_column")
             limits = (
-                ee.evaluate(limit_col, len(query_delta), resolver)
+                self._eval_expr(limit_col, query_delta, resolver)
                 if limit_col is not None
                 else None
             )
             qfilter_col = self.node.config.get("query_filter_column")
             qfilters = (
-                ee.evaluate(qfilter_col, len(query_delta), resolver)
+                self._eval_expr(qfilter_col, query_delta, resolver)
                 if qfilter_col is not None
                 else None
             )

@@ -28,17 +28,30 @@ _DEVICE_THRESHOLD = 4096
 
 
 class EvalContext:
-    """Resolves column references to materialized numpy columns for one batch."""
+    """Resolves column references to materialized numpy columns for one batch.
+
+    ``diffs`` + ``memo`` enable non-deterministic-apply replay: a UDF flagged
+    ``deterministic=False`` must emit the SAME value when a row retracts as it did
+    when the row was inserted (reference UDF ``deterministic`` contract,
+    ``internals/udfs/__init__.py``) — so insert-row results are memoized by row key
+    and retraction rows replay them instead of re-invoking the UDF. This is both a
+    correctness obligation (a re-invocation could differ, leaving a dangling
+    retraction) and the serving-path fast path (a query's delete-completed
+    retraction must not re-run the embedder)."""
 
     def __init__(
         self,
         n_rows: int,
         resolver: Callable[[expr.ColumnReference], np.ndarray],
         keys: np.ndarray | None = None,
+        diffs: np.ndarray | None = None,
+        memo: Dict[int, dict] | None = None,
     ):
         self.n_rows = n_rows
         self.resolver = resolver
         self.keys = keys
+        self.diffs = diffs
+        self.memo = memo
 
 
 # Run-scoped UDF error policy, set per thread by the GraphRunner (reference
@@ -351,11 +364,55 @@ class ExpressionEvaluator:
             out = np.frompyfunc(lambda v: conv(v, None), 1, 1)(val)
         return _tidy(out)
 
+    _MEMO_MISS = object()
+
+    def _memo_store(self, e: expr.ApplyExpression) -> "dict | None":
+        """The per-expression replay store for a non-deterministic apply, when the
+        calling evaluator supplied keys/diffs/memo (see EvalContext docstring)."""
+        ctx = self.ctx
+        if (
+            getattr(e, "_deterministic", True)
+            or ctx.keys is None
+            or ctx.diffs is None
+            or ctx.memo is None
+        ):
+            return None
+        return ctx.memo.setdefault(id(e), {})
+
+    def _memo_replay(self, store: "dict | None", out: np.ndarray) -> np.ndarray:
+        """Fill retraction rows from the store; returns the replayed-row mask."""
+        replayed = np.zeros(self.ctx.n_rows, dtype=bool)
+        if store:
+            from pathway_tpu.internals.keys import key_bytes
+
+            neg = np.nonzero(self.ctx.diffs < 0)[0]
+            if len(neg):
+                for i, kb in zip(neg, key_bytes(self.ctx.keys[neg])):
+                    v = store.pop(kb, self._MEMO_MISS)
+                    if v is not self._MEMO_MISS:
+                        out[i] = v
+                        replayed[i] = True
+        return replayed
+
+    def _memo_record(self, store: "dict | None", out: np.ndarray) -> None:
+        if store is None:
+            return
+        from pathway_tpu.internals.keys import key_bytes
+
+        pos = np.nonzero(self.ctx.diffs > 0)[0]
+        if len(pos):
+            for i, kb in zip(pos, key_bytes(self.ctx.keys[pos])):
+                store[kb] = out[i]
+
     def _eval_ApplyExpression(self, e: expr.ApplyExpression) -> np.ndarray:
         args = [self._eval(a) for a in e._args]
         kwargs = {k: self._eval(v) for k, v in e._kwargs.items()}
         out = np.empty(self.ctx.n_rows, dtype=object)
+        store = self._memo_store(e)
+        replayed = self._memo_replay(store, out)
         for i in range(self.ctx.n_rows):
+            if replayed[i]:
+                continue
             row_args = [a[i] for a in args]
             row_kwargs = {k: v[i] for k, v in kwargs.items()}
             if e._propagate_none and (
@@ -369,6 +426,7 @@ class ExpressionEvaluator:
                 out[i] = ERROR
                 continue
             out[i] = _call_udf(e._fun, row_args, row_kwargs)
+        self._memo_record(store, out)
         return _tidy(out) if e._return_type != dt.ANY else out
 
     def _eval_BatchApplyExpression(self, e: expr.ApplyExpression) -> np.ndarray:
@@ -376,6 +434,8 @@ class ExpressionEvaluator:
         kwargs = {k: self._eval(v) for k, v in e._kwargs.items()}
         max_bs = e._max_batch_size or self.ctx.n_rows or 1
         out = np.empty(self.ctx.n_rows, dtype=object)
+        store = self._memo_store(e)
+        replayed = self._memo_replay(store, out)
         # poisoned rows never reach the UDF; their outputs stay ERROR
         poisoned = np.zeros(self.ctx.n_rows, dtype=bool)
         for col in args + list(kwargs.values()):
@@ -383,7 +443,8 @@ class ExpressionEvaluator:
                 poisoned |= np.frompyfunc(lambda v: isinstance(v, Error), 1, 1)(col).astype(
                     bool
                 )
-        clean_idx = np.nonzero(~poisoned)[0]
+        poisoned &= ~replayed
+        clean_idx = np.nonzero(~poisoned & ~replayed)[0]
         out[poisoned] = ERROR
         for start in range(0, len(clean_idx), max_bs):
             idx = clean_idx[start : start + max_bs]
@@ -401,6 +462,7 @@ class ExpressionEvaluator:
                 )
             for i, r in zip(idx, results):
                 out[i] = r
+        self._memo_record(store, out)
         return out
 
     def _eval_AsyncApplyExpression(self, e: expr.AsyncApplyExpression) -> np.ndarray:
@@ -408,18 +470,21 @@ class ExpressionEvaluator:
 
         args = [self._eval(a) for a in e._args]
         kwargs = {k: self._eval(v) for k, v in e._kwargs.items()}
+        out = np.empty(self.ctx.n_rows, dtype=object)
+        store = self._memo_store(e)
+        replayed = self._memo_replay(store, out)
+        run_rows = np.nonzero(~replayed)[0]
 
         async def run_all() -> list:
             tasks = [
                 e._fun(*[a[i] for a in args], **{k: v[i] for k, v in kwargs.items()})
-                for i in range(self.ctx.n_rows)
+                for i in run_rows
             ]
             return await asyncio.gather(*tasks, return_exceptions=True)
 
         results = _run_coro(run_all())
-        out = np.empty(self.ctx.n_rows, dtype=object)
         terminate = get_runtime()["terminate_on_error"]
-        for i, r in enumerate(results):
+        for i, r in zip(run_rows, results):
             if isinstance(r, Exception):
                 if terminate:
                     raise r
@@ -427,6 +492,7 @@ class ExpressionEvaluator:
                 out[i] = ERROR
             else:
                 out[i] = r
+        self._memo_record(store, out)
         return _tidy(out)
 
     _eval_FullyAsyncApplyExpression = _eval_AsyncApplyExpression
@@ -492,5 +558,7 @@ def evaluate(
     n_rows: int,
     resolver: Callable[[expr.ColumnReference], np.ndarray],
     keys: np.ndarray | None = None,
+    diffs: np.ndarray | None = None,
+    memo: "Dict[int, dict] | None" = None,
 ) -> np.ndarray:
-    return ExpressionEvaluator(EvalContext(n_rows, resolver, keys)).eval(e)
+    return ExpressionEvaluator(EvalContext(n_rows, resolver, keys, diffs, memo)).eval(e)

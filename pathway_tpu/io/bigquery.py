@@ -1,11 +1,16 @@
-"""BigQuery sink (parity: reference ``io/bigquery`` — streaming ``insert_rows_json``)."""
+"""BigQuery sink (parity: reference ``io/bigquery`` — buffered streaming
+``insert_rows_json``).
+
+Real client code against the ``google.cloud.bigquery`` API, with per-commit flush
+and injectable client (``_client``) so unit tests run against fakes in environments
+without credentials or the client library.
+"""
 
 from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.internals import parse_graph as pg
-from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._utils import add_batched_sink
 from pathway_tpu.internals.table import Table
 
 
@@ -14,40 +19,40 @@ def write(
     dataset_name: str,
     table_name: str,
     service_user_credentials_file: str | None = None,
+    *,
+    max_batch_size: int | None = None,
+    _client: Any = None,
     **kwargs: Any,
 ) -> None:
-    try:
-        from google.cloud import bigquery
-        from google.oauth2.service_account import Credentials
-    except ImportError:
-        raise ImportError("google-cloud-bigquery is not available in this environment")
+    """Stream ``table``'s updates into ``dataset.table`` via ``insert_rows_json``.
 
-    if service_user_credentials_file is not None:
-        credentials = Credentials.from_service_account_file(service_user_credentials_file)
-        client = bigquery.Client(credentials=credentials)
-    else:
-        client = bigquery.Client()
-    target = f"{client.project}.{dataset_name}.{table_name}"
-    batch: list[dict] = []
-    batch_size = int(kwargs.get("max_batch_size") or 500)
+    ``_client``: any object with the bigquery ``Client`` surface
+    (``project`` attr + ``insert_rows_json(target, rows)``); tests inject fakes.
+    """
+    if _client is None:
+        try:
+            from google.cloud import bigquery
+            from google.oauth2.service_account import Credentials
+        except ImportError:
+            raise ImportError(
+                "no BigQuery client library (google-cloud-bigquery) is available "
+                "in this environment; pass _client=... (any object with the "
+                "bigquery.Client insert_rows_json surface)"
+            )
+        if service_user_credentials_file is not None:
+            credentials = Credentials.from_service_account_file(
+                service_user_credentials_file
+            )
+            _client = bigquery.Client(credentials=credentials)
+        else:
+            _client = bigquery.Client()
+    target = f"{_client.project}.{dataset_name}.{table_name}"
 
-    from pathway_tpu.io._utils import plain_row
-
-    def flush() -> None:
-        if not batch:
-            return
-        rows, batch[:] = list(batch), []
-        errors = client.insert_rows_json(target, rows)
+    def write_rows(rows: list[dict]) -> None:
+        errors = _client.insert_rows_json(target, rows)
         if errors:
             raise RuntimeError(f"BigQuery insert failed: {errors}")
 
-    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
-        batch.append({**plain_row(row), "time": time, "diff": 1 if is_addition else -1})
-        if len(batch) >= batch_size:
-            flush()
-
-    def close() -> None:
-        flush()
-        client.close()
-
-    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=close))
+    add_batched_sink(
+        table, write_rows, max_batch_size=int(max_batch_size or 500), client=_client
+    )

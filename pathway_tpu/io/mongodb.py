@@ -1,26 +1,50 @@
 """MongoDB sink (parity: reference ``io/mongodb`` over ``data_storage.rs:2232`` with the
-Bson formatter ``data_format.rs:1975``). Requires pymongo."""
+Bson formatter ``data_format.rs:1975``).
+
+Real client code against the ``pymongo`` API: rows batch per commit and write with
+``insert_many`` (the reference's Mongo writer batches documents per output batch).
+Client construction is injectable (``_client``) so unit tests run against fakes in
+environments without a server or client library.
+"""
 
 from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.internals import parse_graph as pg
-from pathway_tpu.internals.parse_graph import G
-from pathway_tpu.io._utils import plain_row
+from pathway_tpu.io._utils import add_batched_sink
 from pathway_tpu.internals.table import Table
 
 
-def write(table: Table, connection_string: str, database: str, collection: str, **kwargs: Any) -> None:
-    try:
-        import pymongo
-    except ImportError:
-        raise ImportError("pymongo is not available in this environment")
+def write(
+    table: Table,
+    connection_string: str,
+    database: str,
+    collection: str,
+    *,
+    max_batch_size: int | None = None,
+    _client: Any = None,
+    **kwargs: Any,
+) -> None:
+    """Write ``table``'s update stream into a MongoDB collection.
 
-    client = pymongo.MongoClient(connection_string)
-    coll = client[database][collection]
-
-    def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
-        coll.insert_one({**plain_row(row), "time": time, "diff": 1 if is_addition else -1})
-
-    G.add_node(pg.OutputNode(inputs=[table], callback=callback, on_end=client.close))
+    Each document carries the row's columns plus ``time``/``diff`` (reference Bson
+    formatter fields, ``data_format.rs:1975``). ``_client``: any object with the
+    pymongo ``client[db][coll].insert_many`` surface (tests inject fakes).
+    """
+    if _client is None:
+        try:
+            import pymongo
+        except ImportError:
+            raise ImportError(
+                "no MongoDB client library (pymongo) is available in this "
+                "environment; pass _client=... (any object with the pymongo "
+                "MongoClient surface)"
+            )
+        _client = pymongo.MongoClient(connection_string)
+    coll = _client[database][collection]
+    add_batched_sink(
+        table,
+        coll.insert_many,
+        max_batch_size=int(max_batch_size or 1024),
+        client=_client,
+    )

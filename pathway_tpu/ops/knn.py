@@ -196,13 +196,18 @@ class DenseKNNStore(SlotIngestMixin):
         self._flush()
         if isinstance(queries, jax.Array):
             # device-resident queries (e.g. straight from the embedder) chain into
-            # the search without a host round-trip
-            queries = queries.astype(jnp.float32).reshape(-1, self.dim)
+            # the search without a host round-trip; skip no-op casts/reshapes so
+            # the serving path dispatches exactly one device computation
+            if queries.dtype != jnp.float32:
+                queries = queries.astype(jnp.float32)
+            if queries.ndim != 2 or queries.shape[-1] != self.dim:
+                queries = queries.reshape(-1, self.dim)
         else:
             queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
         k_eff = max(1, min(k, self.capacity))
+        data = self._data if self._data.dtype == jnp.float32 else self._data.astype(jnp.float32)
         top_scores, top_idx = _search_kernel(
-            self._data.astype(jnp.float32),
+            data,
             self._valid,
             self._norms,
             queries if isinstance(queries, jax.Array) else jnp.asarray(queries),

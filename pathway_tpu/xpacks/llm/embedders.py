@@ -89,7 +89,12 @@ class SentenceTransformerEmbedder(BaseEmbedder):
 
     def device_expression(self, *args: Any, **kwargs: Any) -> expr.ColumnExpression:
         """Query-path variant: embedding cells are DEVICE-resident jax slices so
-        downstream device kernels (KNN search) chain without a host round-trip."""
+        downstream device kernels (KNN search) chain without a host round-trip.
+
+        Declared ``deterministic=False`` so the engine memoizes each query row's
+        embedding and REPLAYS it on retraction (the rest connector's
+        delete-completed-queries cleanup) instead of re-running the encoder — one
+        encode per query, with the memo entry popped on retraction."""
         encoder = self.encoder
 
         def embed_batch(texts: List[str]) -> List[Any]:
@@ -100,7 +105,7 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             embed_batch,
             np.ndarray,
             False,
-            True,
+            False,
             args,
             kwargs,
             max_batch_size=self.batch_size,

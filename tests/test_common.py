@@ -587,3 +587,77 @@ def test_join_streaming_both_sides_keeps_arranging():
     )
     GraphRunner(pg.G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
     assert sorted(got) == [("a", 20, 1), ("b", 10, 1)]
+
+
+def test_nondeterministic_udf_retraction_replays_value():
+    """A UDF flagged deterministic=False must emit the SAME value when a row
+    retracts as it did on insert (reference UDF `deterministic` contract) — the
+    engine memoizes insert results and replays them instead of re-invoking."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.engine.runner import GraphRunner
+
+    calls = [0]
+
+    def nondet(x: str) -> str:
+        calls[0] += 1
+        return f"{x}#{calls[0]}"
+
+    pg.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"k": str}),
+        [("a", 0, 1), ("b", 0, 1), ("a", 2, -1)],
+        is_stream=True,
+    )
+    udf = pw.udf(nondet, deterministic=False)
+    res = t.select(t.k, v=udf(t.k))
+    got = []
+    pw.io.subscribe(
+        res,
+        on_batch=lambda keys, diffs, columns, time: got.extend(
+            zip(columns["v"].tolist(), diffs.tolist())
+        ),
+    )
+    GraphRunner(pg.G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert calls[0] == 2  # once per inserted row; the retraction replayed
+    ins_a = [v for v, d in got if d == 1 and v.startswith("a#")]
+    ret = [v for v, d in got if d == -1]
+    assert ret == ins_a  # retraction carries the inserted value verbatim
+
+
+def test_join_frontier_still_evicts_retracted_rows():
+    """Rows arranged BEFORE the build side closed must still evict when they
+    retract later, even though new inserts skip arrangement (no state leak)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.engine.runner import GraphRunner
+
+    pg.G.clear()
+    lt = pw.debug.table_from_rows(
+        pw.schema_builder({"k": str}),
+        # commit 0: a, b (arranged — build delta arrives same commit);
+        # commit 1: retract a (must evict); commit 2: c (skip-arranged)
+        [("a", 0, 1), ("b", 0, 1), ("a", 2, -1), ("c", 4, 1)],
+        is_stream=True,
+    )
+    rt = pw.debug.table_from_rows(
+        pw.schema_builder({"k2": str, "v": int}),
+        [("a", 1), ("b", 2), ("c", 3)],
+    )
+    j = lt.join(rt, lt.k == rt.k2).select(lt.k, rt.v)
+    got = []
+    pw.io.subscribe(
+        j,
+        on_batch=lambda keys, diffs, columns, time: got.extend(
+            zip(columns["k"].tolist(), diffs.tolist())
+        ),
+    )
+    runner = GraphRunner(pg.G._current)
+    runner.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert sorted(got) == [("a", -1), ("a", 1), ("b", 1), ("c", 1)]
+    join_ev = next(
+        ev for ev in runner.evaluators.values()
+        if ev.__class__.__name__ == "JoinEvaluator"
+    )
+    # "a" evicted, "b" stays (commit-0 arranged), "c" never arranged
+    assert len(join_ev.left.row_index) == 1

@@ -171,7 +171,8 @@ def test_gated_connectors_raise_clearly():
         pw.io.mongodb.write(t, "mongodb://x", "db", "coll")
     with pytest.raises(ImportError):
         pw.io.deltalake.write(t, "/tmp/dl")
-    with pytest.raises(ImportError):
+    with pytest.raises(FileNotFoundError):
+        # airbyte is a real protocol runner now; a missing config fails upfront
         pw.io.airbyte.read("conn.yaml", ["users"])
     with pytest.raises(ImportError):
         pw.io.postgres.write(t, {"host": "x"}, "t")

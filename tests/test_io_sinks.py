@@ -1,0 +1,344 @@
+"""Sink + airbyte connectors against injected fakes (VERDICT r2 padded-files list:
+mongodb/bigquery/pubsub/slack/logstash/airbyte become real client code paths,
+unit-tested with fakes — reference ``data_storage.rs:2232``, ``io/bigquery``,
+``io/pubsub``, ``io/slack``, ``io/logstash``, ``io/airbyte``)."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+def _run():
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+
+def _source_table():
+    return pw.debug.table_from_rows(
+        pw.schema_builder({"name": str, "age": int}),
+        [("Alice", 10), ("Bob", 9), ("Carol", 8)],
+    )
+
+
+# -- mongodb ----------------------------------------------------------------------
+
+
+class FakeMongoCollection:
+    def __init__(self):
+        self.docs: list[dict] = []
+
+    def insert_many(self, docs):
+        self.docs.extend(docs)
+
+
+class FakeMongoClient:
+    def __init__(self):
+        self.coll = FakeMongoCollection()
+        self.closed = False
+
+    def __getitem__(self, name):
+        return {"c": self.coll}.get("c") and {"coll": self.coll} and _FakeDb(self.coll)
+
+    def close(self):
+        self.closed = True
+
+
+class _FakeDb:
+    def __init__(self, coll):
+        self._coll = coll
+
+    def __getitem__(self, name):
+        return self._coll
+
+
+def test_mongodb_write_batches_documents():
+    pg.G.clear()
+    t = _source_table()
+    client = FakeMongoClient()
+    pw.io.mongodb.write(t, "mongodb://unused", "db", "people", _client=client)
+    _run()
+    assert sorted(d["name"] for d in client.coll.docs) == ["Alice", "Bob", "Carol"]
+    assert all(d["diff"] == 1 for d in client.coll.docs)
+    assert client.closed
+
+
+# -- bigquery ---------------------------------------------------------------------
+
+
+class FakeBQClient:
+    project = "proj"
+
+    def __init__(self, fail=False):
+        self.rows: list[tuple[str, dict]] = []
+        self.fail = fail
+
+    def insert_rows_json(self, target, rows):
+        if self.fail:
+            return [{"index": 0, "errors": ["boom"]}]
+        self.rows.extend((target, r) for r in rows)
+        return []
+
+
+def test_bigquery_write_streams_rows():
+    pg.G.clear()
+    t = _source_table()
+    client = FakeBQClient()
+    pw.io.bigquery.write(t, "ds", "tbl", _client=client)
+    _run()
+    assert len(client.rows) == 3
+    assert all(target == "proj.ds.tbl" for target, _ in client.rows)
+    assert sorted(r["age"] for _, r in client.rows) == [8, 9, 10]
+
+
+def test_bigquery_write_surfaces_insert_errors():
+    pg.G.clear()
+    t = _source_table()
+    pw.io.bigquery.write(t, "ds", "tbl", _client=FakeBQClient(fail=True))
+    with pytest.raises(Exception, match="BigQuery insert failed"):
+        _run()
+
+
+# -- pubsub -----------------------------------------------------------------------
+
+
+class FakeFuture:
+    def __init__(self):
+        self.waited = False
+
+    def result(self, timeout=None):
+        self.waited = True
+
+
+class FakePublisher:
+    def __init__(self):
+        self.published: list[tuple[str, bytes]] = []
+        self.futures: list[FakeFuture] = []
+
+    def topic_path(self, project, topic):
+        return f"projects/{project}/topics/{topic}"
+
+    def publish(self, topic_path, data):
+        self.published.append((topic_path, data))
+        fut = FakeFuture()
+        self.futures.append(fut)
+        return fut
+
+
+def test_pubsub_write_publishes_and_flushes():
+    pg.G.clear()
+    t = _source_table()
+    publisher = FakePublisher()
+    pw.io.pubsub.write(t, publisher, "proj", "topic")
+    _run()
+    assert len(publisher.published) == 3
+    path, payload = publisher.published[0]
+    assert path == "projects/proj/topics/topic"
+    assert json.loads(payload)["diff"] == 1
+    assert all(f.waited for f in publisher.futures)  # on_end blocked on delivery
+
+
+# -- slack + logstash (HTTP sinks against a local server) -------------------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.requests: list[dict] = []
+
+
+def _local_http_server(recorder: _Recorder):
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            recorder.requests.append(
+                {
+                    "path": self.path,
+                    "auth": self.headers.get("Authorization"),
+                    "body": json.loads(body) if body else None,
+                }
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b'{"ok": true}')
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def test_slack_send_alerts_posts_messages():
+    recorder = _Recorder()
+    server = _local_http_server(recorder)
+    try:
+        pg.G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_builder({"msg": str}), [("alert one",), ("alert two",)]
+        )
+        pw.io.slack.send_alerts(
+            t.msg,
+            "C123",
+            "xoxb-token",
+            api_url=f"http://127.0.0.1:{server.server_port}/api/chat.postMessage",
+        )
+        _run()
+        assert len(recorder.requests) == 2
+        req = recorder.requests[0]
+        assert req["auth"] == "Bearer xoxb-token"
+        assert req["body"]["channel"] == "C123"
+        assert {r["body"]["text"] for r in recorder.requests} == {
+            "alert one",
+            "alert two",
+        }
+    finally:
+        server.shutdown()
+
+
+def test_logstash_write_posts_documents():
+    recorder = _Recorder()
+    server = _local_http_server(recorder)
+    try:
+        pg.G.clear()
+        t = _source_table()
+        pw.io.logstash.write(t, f"http://127.0.0.1:{server.server_port}/")
+        _run()
+        assert len(recorder.requests) == 3
+        assert sorted(r["body"]["name"] for r in recorder.requests) == [
+            "Alice",
+            "Bob",
+            "Carol",
+        ]
+    finally:
+        server.shutdown()
+
+
+# -- airbyte (protocol fake) ------------------------------------------------------
+
+
+class FakeAirbyteProcess:
+    def __init__(self, lines: list[str]):
+        self.stdout = iter(lines)
+
+    def wait(self):
+        return 0
+
+
+def _airbyte_config(tmp_path):
+    cfg = tmp_path / "connection.yaml"
+    cfg.write_text(
+        json.dumps(
+            {"source": {"executable": "fake-source", "config": {"seed": 7}}}
+        )
+    )
+    return str(cfg)
+
+
+def test_airbyte_read_records_and_state(tmp_path):
+    protocol = [
+        json.dumps({"type": "LOG", "log": {"level": "INFO", "message": "hi"}}),
+        json.dumps(
+            {"type": "RECORD", "record": {"stream": "users", "data": {"id": 1, "n": "a"}}}
+        ),
+        json.dumps(
+            {"type": "RECORD", "record": {"stream": "skipme", "data": {"id": 9}}}
+        ),
+        "free-form log line",
+        json.dumps(
+            {"type": "RECORD", "record": {"stream": "users", "data": {"id": 2, "n": "b"}}}
+        ),
+        json.dumps({"type": "STATE", "state": {"cursor": 2}}),
+    ]
+    seen_cmds: list[list[str]] = []
+
+    def factory(cmd, env):
+        seen_cmds.append(cmd)
+        return FakeAirbyteProcess(protocol)
+
+    pg.G.clear()
+    t = pw.io.airbyte.read(
+        _airbyte_config(tmp_path),
+        streams=["users"],
+        mode="static",
+        _process_factory=factory,
+    )
+    got = []
+    pw.io.subscribe(
+        t, lambda key, row, time, is_addition: got.append(row["data"].value)
+    )
+    _run()
+    assert sorted(d["id"] for d in got) == [1, 2]  # 'skipme' stream filtered out
+    (cmd,) = seen_cmds
+    assert cmd[0] == "fake-source" and cmd[1] == "read"
+    # the configured catalog requested exactly the selected stream, incremental
+    cat_path = cmd[cmd.index("--catalog") + 1]
+    # workdir is deleted after the sync; the command shape is the contract here
+    assert cat_path.endswith("catalog.json")
+
+
+def test_airbyte_resumes_from_state(tmp_path):
+    """A restored STATE blob must reach the next read via --state."""
+    from pathway_tpu.io.airbyte import _AirbyteSubject
+
+    state_files: list[dict] = []
+
+    def factory(cmd, env):
+        if "--state" in cmd:
+            with open(cmd[cmd.index("--state") + 1]) as f:
+                state_files.append(json.load(f))
+        return FakeAirbyteProcess(
+            [json.dumps({"type": "STATE", "state": {"cursor": 5}})]
+        )
+
+    subject = _AirbyteSubject(
+        factory, {"executable": "fake", "config": {}}, ["s"], "static", 1.0, None
+    )
+    subject.restore([{"state": {"cursor": 3}}])
+
+    class _Src:
+        def push(self, *a, **kw):
+            pass
+
+        def push_state(self, *a, **kw):
+            pass
+
+    subject.run(_Src())
+    assert state_files == [{"cursor": 3}]
+    # and the newest state wins the fold
+    assert _AirbyteSubject.fold_state_deltas(
+        [{"state": {"cursor": 3}}, {"state": {"cursor": 5}}]
+    ) == [{"state": {"cursor": 5}}]
+
+
+def test_airbyte_surfaces_trace_errors(tmp_path):
+    def factory(cmd, env):
+        return FakeAirbyteProcess(
+            [
+                json.dumps(
+                    {
+                        "type": "TRACE",
+                        "trace": {"type": "ERROR", "error": {"message": "cred bad"}},
+                    }
+                )
+            ]
+        )
+
+    pg.G.clear()
+    t = pw.io.airbyte.read(
+        _airbyte_config(tmp_path),
+        streams=["users"],
+        mode="static",
+        _process_factory=factory,
+    )
+    pw.io.subscribe(t, lambda *a, **kw: None)
+    with pytest.raises(Exception, match="cred bad"):
+        _run()
