@@ -37,6 +37,33 @@ class UnpicklableStateError(Exception):
     """Operator state can't be checkpointed; the journal must keep full history."""
 
 
+def _collect_nondet_exprs(value: Any, found: List[Any], seen: set) -> None:
+    """Deterministic walk over a node config collecting non-deterministic apply
+    expressions (dicts by sorted key, sequences in order, expression trees by
+    ``_deps`` order) — the walk order IS the expressions' stable identity across
+    process restarts, so memoized replay state can live in operator snapshots."""
+    if isinstance(value, expr.ColumnExpression):
+        if id(value) in seen:
+            return
+        seen.add(id(value))
+        if isinstance(value, expr.ApplyExpression) and not value._deterministic:
+            found.append(value)
+        for dep in value._deps():
+            _collect_nondet_exprs(dep, found, seen)
+    elif isinstance(value, dict):
+        for k in sorted(value, key=repr):
+            _collect_nondet_exprs(value[k], found, seen)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            _collect_nondet_exprs(v, found, seen)
+
+
+def _to_host(v: Any) -> Any:
+    if type(v).__module__.startswith("jax"):
+        return np.asarray(v)
+    return v
+
+
 class Evaluator:
     def __init__(self, node: pg.Node, runner: Any):
         self.node = node
@@ -44,15 +71,20 @@ class Evaluator:
         self.output_columns: List[str] = (
             node.output.column_names() if node.output is not None else []
         )
+        found: List[Any] = []
+        _collect_nondet_exprs(node.config, found, set())
+        # id(expr) -> stable token; the token keys _udf_memo so replay state
+        # survives a checkpoint/restore round-trip (id() does not)
+        self._memo_tokens: Dict[int, str] = {
+            id(e): f"nd{i}" for i, e in enumerate(found)
+        }
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         raise NotImplementedError
 
     # -- operator snapshots (reference ``operator_snapshot.rs``) -------------
 
-    # _udf_memo holds non-deterministic-apply replay values (may contain device
-    # arrays, not picklable); journal replay re-runs the UDFs and rebuilds it
-    _NON_STATE_ATTRS = ("node", "runner", "output_columns", "_udf_memo")
+    _NON_STATE_ATTRS = ("node", "runner", "output_columns", "_memo_tokens")
 
     def state_dict(self) -> Dict[str, bytes]:
         """Picklable per-attribute snapshot of this operator's incremental state.
@@ -67,6 +99,14 @@ class Evaluator:
         for name, value in self.__dict__.items():
             if name in self._NON_STATE_ATTRS:
                 continue
+            if name == "_udf_memo":
+                # replay values may be device arrays (the serving path keeps
+                # query embeddings on the TPU) — snapshot their host mirror so
+                # post-restore retractions still replay the exact value
+                value = {
+                    tok: {kb: _to_host(v) for kb, v in store.items()}
+                    for tok, store in value.items()
+                }
             try:
                 out[name] = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
             except Exception as exc:
@@ -155,6 +195,7 @@ class Evaluator:
             keys=delta.keys,
             diffs=delta.diffs,
             memo=self.__dict__.setdefault("_udf_memo", {}),
+            memo_tokens=self._memo_tokens,
         )
 
     def _eval_exprs(

@@ -45,13 +45,16 @@ class EvalContext:
         resolver: Callable[[expr.ColumnReference], np.ndarray],
         keys: np.ndarray | None = None,
         diffs: np.ndarray | None = None,
-        memo: Dict[int, dict] | None = None,
+        memo: Dict[Any, dict] | None = None,
+        memo_tokens: Dict[int, str] | None = None,
     ):
         self.n_rows = n_rows
         self.resolver = resolver
         self.keys = keys
         self.diffs = diffs
         self.memo = memo
+        # id(expr) -> stable snapshot-safe token (see Evaluator._memo_tokens)
+        self.memo_tokens = memo_tokens or {}
 
 
 # Run-scoped UDF error policy, set per thread by the GraphRunner (reference
@@ -377,7 +380,7 @@ class ExpressionEvaluator:
             or ctx.memo is None
         ):
             return None
-        return ctx.memo.setdefault(id(e), {})
+        return ctx.memo.setdefault(ctx.memo_tokens.get(id(e), id(e)), {})
 
     def _memo_replay(self, store: "dict | None", out: np.ndarray) -> np.ndarray:
         """Fill retraction rows from the store; returns the replayed-row mask."""
@@ -559,6 +562,9 @@ def evaluate(
     resolver: Callable[[expr.ColumnReference], np.ndarray],
     keys: np.ndarray | None = None,
     diffs: np.ndarray | None = None,
-    memo: "Dict[int, dict] | None" = None,
+    memo: "Dict[Any, dict] | None" = None,
+    memo_tokens: "Dict[int, str] | None" = None,
 ) -> np.ndarray:
-    return ExpressionEvaluator(EvalContext(n_rows, resolver, keys, diffs, memo)).eval(e)
+    return ExpressionEvaluator(
+        EvalContext(n_rows, resolver, keys, diffs, memo, memo_tokens)
+    ).eval(e)

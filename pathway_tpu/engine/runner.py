@@ -560,6 +560,13 @@ class GraphRunner:
     def finish(self) -> None:
         from pathway_tpu.engine.evaluators import OutputEvaluator, WithUniverseOfEvaluator
 
+        for node, _ in self._sources:
+            # graceful producer shutdown (streaming subjects poll this between
+            # refresh cycles — e.g. the airbyte sync loop)
+            subject = getattr(node.config["source"], "subject", None)
+            stop = getattr(subject, "stop", None)
+            if stop is not None:
+                stop()
         for node in self._nodes:
             evaluator = self.evaluators.get(node.id)
             if isinstance(evaluator, OutputEvaluator):

@@ -64,18 +64,25 @@ def _load_source_config(path: str) -> dict:
 
 
 def _build_command(source_cfg: dict, config_path: str, catalog_path: str,
-                   state_path: str | None) -> list[str]:
+                   state_path: str | None, env_vars: dict | None = None) -> list[str]:
     tail = ["read", "--config", config_path, "--catalog", catalog_path]
     if state_path is not None:
         tail += ["--state", state_path]
     executable = source_cfg.get("executable")
     if executable:
+        # env_vars reach a local executable via the process environment
         return shlex.split(str(executable)) + tail
     image = source_cfg.get("docker_image")
     if image:
         mount_dir = os.path.dirname(os.path.abspath(config_path))
+        env_flags: list[str] = []
+        for k in sorted(env_vars or {}):
+            # forwarded INTO the container (the host-side docker CLI's
+            # environment is invisible to the connector)
+            env_flags += ["-e", k]
         return [
             "docker", "run", "--rm", "-i",
+            *env_flags,
             "-v", f"{mount_dir}:{mount_dir}:ro",
             str(image),
         ] + tail
@@ -147,7 +154,9 @@ class _AirbyteSubject:
             state_path = os.path.join(workdir, "state.json")
             with open(state_path, "w") as f:
                 json.dump(self.state, f)
-        cmd = _build_command(self.source_cfg, config_path, catalog_path, state_path)
+        cmd = _build_command(
+            self.source_cfg, config_path, catalog_path, state_path, self.env_vars
+        )
         proc = self.process_factory(cmd, self.env_vars)
         # stderr drains on a side thread so a chatty source can't block on a full
         # pipe; its tail feeds failure diagnostics

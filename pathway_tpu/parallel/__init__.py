@@ -11,8 +11,6 @@ Components:
 - :mod:`mesh` — device-mesh construction (``data``/``model`` axes, multi-host aware).
 - :mod:`sharding` — sharding rules (param trees, batches, keyed table state).
 - :mod:`exchange` — key-hash exchange (shard routing, the ``shard.rs:15-20`` analog).
-- :mod:`train` — TP+DP contrastive training step for the flagship sentence encoder.
-- :mod:`ring_attention` — sequence-parallel blockwise attention via ``ppermute``.
 - :mod:`knn_sharded` — mesh-sharded KNN store with all-gather top-k merge.
 """
 
@@ -24,8 +22,6 @@ from pathway_tpu.parallel.sharding import (
 )
 from pathway_tpu.parallel.exchange import shard_of_keys, exchange_by_key
 from pathway_tpu.parallel.knn_sharded import ShardedKNNStore
-from pathway_tpu.parallel.ring_attention import ring_attention
-from pathway_tpu.parallel.train import ContrastiveTrainer
 
 __all__ = [
     "make_mesh",
@@ -36,6 +32,4 @@ __all__ = [
     "shard_of_keys",
     "exchange_by_key",
     "ShardedKNNStore",
-    "ring_attention",
-    "ContrastiveTrainer",
 ]

@@ -2,5 +2,24 @@
 
 from pathway_tpu.stdlib.ml import index
 from pathway_tpu.stdlib.ml.index import KNNIndex
+from pathway_tpu.stdlib.ml import hmm
+from pathway_tpu.stdlib.ml import smart_table_ops
+from pathway_tpu.stdlib.ml import datasets
+from pathway_tpu.stdlib.ml.smart_table_ops import (
+    fuzzy_match,
+    fuzzy_match_tables,
+    fuzzy_self_match,
+    smart_fuzzy_match,
+)
 
-__all__ = ["KNNIndex", "index"]
+__all__ = [
+    "KNNIndex",
+    "index",
+    "hmm",
+    "smart_table_ops",
+    "datasets",
+    "fuzzy_match",
+    "fuzzy_match_tables",
+    "fuzzy_self_match",
+    "smart_fuzzy_match",
+]

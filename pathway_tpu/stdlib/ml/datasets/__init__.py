@@ -1,0 +1,8 @@
+"""Dataset loaders (parity: reference ``stdlib/ml/datasets``)."""
+
+from pathway_tpu.stdlib.ml.datasets.classification import (
+    load_mnist_sample,
+    load_synthetic_classification,
+)
+
+__all__ = ["load_mnist_sample", "load_synthetic_classification"]

@@ -96,18 +96,156 @@ class ParseUnstructured(UDF):
 UnstructuredParser = ParseUnstructured
 
 
+DEFAULT_IMAGE_PARSE_PROMPT = (
+    "Describe the contents of this image precisely, including any visible text, "
+    "tables, and figures."
+)
+
+
+def _image_to_b64(img: Any, fmt: str = "PNG") -> str:
+    import base64
+    import io
+
+    buf = io.BytesIO()
+    img.save(buf, format=fmt)
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def _vision_describe(llm: Any, prompt: str, b64: str) -> str:
+    """One vision-LLM call in the OpenAI image_url message shape (the wire format
+    the reference's ImageParser builds, ``parsers.py:396``)."""
+    messages = [
+        {
+            "role": "user",
+            "content": [
+                {"type": "text", "text": prompt},
+                {
+                    "type": "image_url",
+                    "image_url": {"url": f"data:image/png;base64,{b64}"},
+                },
+            ],
+        }
+    ]
+    fn = getattr(llm, "func", None) or llm
+    return str(fn(messages))
+
+
 class ImageParser(UDF):
-    def __init__(self, llm: Any = None, parse_prompt: str | None = None, **kwargs: Any):
+    """image bytes → [(description, metadata)] via a vision LLM (reference ``:396``).
+
+    The image decodes with PIL, optionally downsizes to ``downsize_horizontal_width``
+    (vision-token budget control, as in the reference), encodes to base64, and goes to
+    ``llm`` as an OpenAI-style ``image_url`` chat message. ``llm``: any chat UDF or
+    callable taking a messages list (tests inject fakes).
+    """
+
+    def __init__(
+        self,
+        llm: Any = None,
+        parse_prompt: str = DEFAULT_IMAGE_PARSE_PROMPT,
+        downsize_horizontal_width: int | None = 1280,
+        include_metadata: bool = True,
+        **kwargs: Any,
+    ):
         super().__init__()
-        raise NotImplementedError(
-            "ImageParser needs a vision LLM client; not available in this environment "
-            "(reference parsers.py:396)"
-        )
+        self.llm = llm
+        self.parse_prompt = parse_prompt
+        self.downsize_horizontal_width = downsize_horizontal_width
+        self.include_metadata = include_metadata
+
+        def parse(contents: bytes) -> list:
+            if self.llm is None:
+                raise ValueError(
+                    "ImageParser needs a vision-capable `llm` (a chat UDF or any "
+                    "callable accepting an OpenAI-style messages list)"
+                )
+            import io
+
+            from PIL import Image
+
+            img = Image.open(io.BytesIO(contents))
+            img.load()
+            width, height = img.size
+            if (
+                self.downsize_horizontal_width
+                and width > self.downsize_horizontal_width
+            ):
+                ratio = self.downsize_horizontal_width / width
+                img = img.resize(
+                    (self.downsize_horizontal_width, max(1, int(height * ratio)))
+                )
+            if img.mode not in ("RGB", "L"):
+                img = img.convert("RGB")
+            text = _vision_describe(self.llm, self.parse_prompt, _image_to_b64(img))
+            meta = (
+                {"width": width, "height": height, "format": "png"}
+                if self.include_metadata
+                else {}
+            )
+            return [(text, meta)]
+
+        self.func = parse
+
+
+def _default_rasterizer(contents: bytes) -> list:
+    """PDF/slide bytes → list of PIL images, one per slide/page."""
+    try:
+        from pdf2image import convert_from_bytes
+    except ImportError as e:
+        raise ImportError(
+            "SlideParser needs a slide rasterizer: install pdf2image (poppler) or "
+            "pass _rasterizer=... (bytes -> list of PIL images)"
+        ) from e
+    return convert_from_bytes(contents)
 
 
 class SlideParser(UDF):
-    def __init__(self, **kwargs: Any):
+    """slide-deck bytes → one vision-parsed doc per slide (reference ``:569``;
+    entitlement-gated there, open here).
+
+    Each slide rasterizes to an image and goes through the same vision-LLM path as
+    ``ImageParser``; metadata carries the slide number and count. Rasterization is
+    injectable (``_rasterizer``) so tests run without poppler.
+    """
+
+    def __init__(
+        self,
+        llm: Any = None,
+        parse_prompt: str = DEFAULT_IMAGE_PARSE_PROMPT,
+        downsize_horizontal_width: int | None = 1280,
+        _rasterizer: Callable[[bytes], list] | None = None,
+        **kwargs: Any,
+    ):
         super().__init__()
-        raise NotImplementedError(
-            "SlideParser is licensed/vision-dependent in the reference (parsers.py:569)"
-        )
+        self.llm = llm
+        self.parse_prompt = parse_prompt
+        self.downsize_horizontal_width = downsize_horizontal_width
+        self.rasterizer = _rasterizer or _default_rasterizer
+
+        def parse(contents: bytes) -> list:
+            if self.llm is None:
+                raise ValueError(
+                    "SlideParser needs a vision-capable `llm` (a chat UDF or any "
+                    "callable accepting an OpenAI-style messages list)"
+                )
+            images = self.rasterizer(contents)
+            docs = []
+            for i, img in enumerate(images):
+                if (
+                    self.downsize_horizontal_width
+                    and img.size[0] > self.downsize_horizontal_width
+                ):
+                    ratio = self.downsize_horizontal_width / img.size[0]
+                    img = img.resize(
+                        (
+                            self.downsize_horizontal_width,
+                            max(1, int(img.size[1] * ratio)),
+                        )
+                    )
+                text = _vision_describe(
+                    self.llm, self.parse_prompt, _image_to_b64(img)
+                )
+                docs.append((text, {"slide": i, "slide_count": len(images)}))
+            return docs
+
+        self.func = parse

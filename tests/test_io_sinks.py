@@ -342,3 +342,19 @@ def test_airbyte_surfaces_trace_errors(tmp_path):
     pw.io.subscribe(t, lambda *a, **kw: None)
     with pytest.raises(Exception, match="cred bad"):
         _run()
+
+
+def test_airbyte_docker_command_forwards_env(tmp_path):
+    from pathway_tpu.io.airbyte import _build_command
+
+    cmd = _build_command(
+        {"docker_image": "airbyte/source-faker"},
+        "/w/config.json",
+        "/w/catalog.json",
+        None,
+        {"API_KEY": "x", "A": "1"},
+    )
+    assert cmd[:4] == ["docker", "run", "--rm", "-i"]
+    # env forwarded INTO the container, deterministic order
+    assert cmd[4:8] == ["-e", "A", "-e", "API_KEY"]
+    assert cmd[-5:] == ["read", "--config", "/w/config.json", "--catalog", "/w/catalog.json"]

@@ -1,0 +1,147 @@
+"""ML stdlib: HMM decoding, fuzzy joins, dataset loaders (VERDICT r2 §2.2: ml
+stdlib was `ml/index.py` only — reference ``stdlib/ml/{hmm,smart_table_ops,datasets}``)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+
+
+def _run_capture_update_stream(table):
+    got = []
+    pw.io.subscribe(
+        table,
+        on_batch=lambda keys, diffs, columns, time: got.extend(
+            (time, dict(zip(columns, vals)), d)
+            for *vals, d in zip(*columns.values(), diffs.tolist())
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return got
+
+
+def _manul_graph():
+    import networkx as nx
+    from functools import partial
+
+    def emission(observation, state):
+        table = {
+            ("HUNGRY", "GRUMPY"): 0.9,
+            ("HUNGRY", "HAPPY"): 0.1,
+            ("FULL", "GRUMPY"): 0.7,
+            ("FULL", "HAPPY"): 0.3,
+        }
+        return np.log(table[(state, observation)])
+
+    g = nx.DiGraph()
+    for s in ("HUNGRY", "FULL"):
+        g.add_node(s, calc_emission_log_ppb=partial(emission, state=s))
+    g.add_edge("HUNGRY", "HUNGRY", log_transition_ppb=np.log(0.4))
+    g.add_edge("HUNGRY", "FULL", log_transition_ppb=np.log(0.6))
+    g.add_edge("FULL", "HUNGRY", log_transition_ppb=np.log(0.6))
+    g.add_edge("FULL", "FULL", log_transition_ppb=np.log(0.4))
+    g.graph["start_nodes"] = ["HUNGRY", "FULL"]
+    return g
+
+
+def test_hmm_reducer_incremental_decode():
+    """Streaming observations re-decode incrementally; final decode matches the
+    reference's documented example (last 3 states for the manul HMM)."""
+    pg.G.clear()
+    obs = pw.debug.table_from_rows(
+        pw.schema_builder({"observation": str}),
+        [
+            ("HAPPY", 0, 1),
+            ("HAPPY", 2, 1),
+            ("GRUMPY", 4, 1),
+            ("GRUMPY", 6, 1),
+            ("HAPPY", 8, 1),
+            ("GRUMPY", 10, 1),
+        ],
+        is_stream=True,
+    )
+    reducer = pw.reducers.udf_reducer(
+        pw.stdlib.ml.hmm.create_hmm_reducer(_manul_graph(), num_results_kept=3)
+    )
+    decoded = obs.reduce(decoded_state=reducer(pw.this.observation))
+    events = _run_capture_update_stream(decoded)
+    inserts = [row["decoded_state"] for _t, row, d in events if d > 0]
+    # grows one state per observation until the kept-suffix window fills
+    assert inserts[0] == ("FULL",)
+    assert inserts[1] == ("FULL", "FULL")
+    assert inserts[-1] == ("HUNGRY", "FULL", "HUNGRY")
+    assert all(len(s) <= 3 for s in inserts)
+
+
+def test_hmm_beam_size_limits_states():
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+
+    acc_cls = create_hmm_reducer(_manul_graph(), beam_size=1)
+    acc = acc_cls.from_row(["GRUMPY"])
+    acc.update(acc_cls.from_row(["GRUMPY"]))
+    acc._drain()
+    assert len(acc.beam) == 1  # beam pruned to the single best state
+
+
+def test_fuzzy_match_tables_mutual_best():
+    pg.G.clear()
+    left = pw.debug.table_from_rows(
+        pw.schema_builder({"name": str}),
+        [("Alice Cooper",), ("Bob Marley",), ("Charlie Parker",)],
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_builder({"person": str}),
+        [("cooper alice",), ("marley bob",), ("parker charlie",)],
+    )
+    matches = pw.stdlib.ml.fuzzy_match_tables(left, right)
+    mdf = pw.debug.table_to_pandas(matches)
+    lcap = pw.debug.table_to_pandas(left)
+    rcap = pw.debug.table_to_pandas(right)
+    assert len(mdf) == 3
+    lnames = {k: v for k, v in zip(lcap.index, lcap["name"])}
+    rnames = {k: v for k, v in zip(rcap.index, rcap["person"])}
+    pairs = {(lnames[l], rnames[r]) for l, r in zip(mdf["left"], mdf["right"])}
+    assert pairs == {
+        ("Alice Cooper", "cooper alice"),
+        ("Bob Marley", "marley bob"),
+        ("Charlie Parker", "parker charlie"),
+    }
+    assert (mdf["weight"] > 0).all()
+
+
+def test_fuzzy_self_match_dedupes_pairs():
+    pg.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"name": str}),
+        [("Data Works Inc",), ("data works incorporated",), ("Quantum Cats",)],
+    )
+    matches = pw.stdlib.ml.fuzzy_self_match(t.name)
+    mdf = pw.debug.table_to_pandas(matches)
+    tdf = pw.debug.table_to_pandas(t)
+    names = {k: v for k, v in zip(tdf.index, tdf["name"])}
+    # exactly one row for the similar pair, reported once (left < right)
+    assert len(mdf) == 1
+    left, right = mdf["left"].iloc[0], mdf["right"].iloc[0]
+    assert left < right
+    assert {names[left], names[right]} == {
+        "Data Works Inc",
+        "data works incorporated",
+    }
+
+
+def test_synthetic_classification_dataset_tables():
+    pg.G.clear()
+    X_train, y_train, X_test, y_test = (
+        pw.stdlib.ml.datasets.load_synthetic_classification(
+            n_train=60, n_test=12, dim=4, n_classes=3
+        )
+    )
+    xt = pw.debug.table_to_pandas(X_train)
+    yt = pw.debug.table_to_pandas(y_train)
+    assert len(xt) == 60 and len(yt) == 60
+    assert xt["data"].iloc[0].shape == (4,)
+    assert set(yt["label"]) <= {"0", "1", "2"}
+    assert len(pw.debug.table_to_pandas(X_test)) == 12

@@ -9,17 +9,13 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from pathway_tpu.models.encoder import EncoderConfig
 from pathway_tpu.ops.knn import DenseKNNStore
 from pathway_tpu.parallel import (
-    ContrastiveTrainer,
     ShardedKNNStore,
     exchange_by_key,
     make_mesh,
     mesh_shape_for,
-    ring_attention,
 )
-from pathway_tpu.parallel.ring_attention import attention_reference
 
 
 def test_mesh_shape_factorization():
@@ -32,19 +28,6 @@ def test_mesh_shape_factorization():
 def test_make_mesh_axes():
     mesh = make_mesh(8)
     assert mesh.shape == {"data": 2, "model": 4}
-
-
-def test_ring_attention_matches_reference():
-    mesh = make_mesh(8)  # data=2, model=4
-    rng = np.random.default_rng(0)
-    b, s, h, d = 4, 16, 2, 8  # batch divisible by 2, seq by 4
-    q = jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
-    k = jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
-    v = jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
-    mask = jnp.asarray(rng.random((b, s)) > 0.2)
-    out = ring_attention(q, k, v, mask, mesh=mesh)
-    ref = attention_reference(q, k, v, mask)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
 def test_sharded_knn_matches_dense():
@@ -84,30 +67,6 @@ def test_sharded_knn_remove_and_grow():
         for j, ok in zip(idx[row], valid[row]):
             if ok:
                 assert store.key_of[int(j)] % 2 == 1  # evens were removed
-
-
-def test_contrastive_train_step_decreases_loss():
-    mesh = make_mesh(8)
-    config = EncoderConfig(
-        vocab_size=512,
-        hidden_size=64,
-        num_layers=2,
-        num_heads=4,
-        intermediate_size=128,
-        max_position=64,
-    )
-    trainer = ContrastiveTrainer(mesh, config=config, learning_rate=1e-3)
-    rng = np.random.default_rng(3)
-    b, s = 8, 16
-    batch = {
-        "input_ids": rng.integers(0, 512, size=(b, s)),
-        "attention_mask": np.ones((b, s), dtype=np.int32),
-        "positive_ids": rng.integers(0, 512, size=(b, s)),
-        "positive_mask": np.ones((b, s), dtype=np.int32),
-    }
-    losses = [trainer.train_step(batch) for _ in range(5)]
-    assert all(np.isfinite(losses))
-    assert losses[-1] < losses[0]
 
 
 def test_exchange_by_key_routes_to_owner():

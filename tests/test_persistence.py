@@ -485,3 +485,61 @@ def test_double_crash_mid_segment_skip_width(tmp_path):
 
     rows = _run_segmented(store, run3)
     assert sorted(r["v"] for r in rows.values()) == [10, 20, 30, 40]
+
+
+def test_nondet_udf_memo_survives_checkpoint(tmp_path):
+    """A deterministic=False UDF's replay memo rides operator snapshots: after a
+    restore-from-checkpoint (journal compacted, history not re-run), a retraction
+    of a pre-checkpoint row must replay the ORIGINAL value, not re-invoke."""
+    store = tmp_path / "ps"
+    calls = []
+
+    def nondet(x: str) -> str:
+        calls.append(x)
+        return f"{x}#{len(calls)}"
+
+    class Subject:
+        def __init__(self, rows):
+            self.rows = rows
+
+        def run(self, source):
+            from pathway_tpu.internals.keys import pointer_from
+
+            for key, value, diff in self.rows:
+                source.push({"k": value}, key=pointer_from(key), diff=diff)
+
+    def build(rows):
+        from pathway_tpu.engine.datasource import StreamingDataSource
+        from pathway_tpu.internals import parse_graph as pg
+        from pathway_tpu.internals.table import Table
+
+        schema = pw.schema_builder({"k": str})
+        source = StreamingDataSource(subject=Subject(rows), autocommit_ms=5)
+        node = G.add_node(pg.InputNode(source=source, streaming=True, name="s"))
+        t = Table(node, schema, name="s")
+        udf = pw.udf(nondet, deterministic=False)
+        res = t.select(t.k, v=udf(t.k))
+        events = []
+        pw.io.subscribe(
+            res,
+            on_batch=lambda keys, diffs, columns, time: events.extend(
+                zip(columns["v"].tolist(), diffs.tolist())
+            ),
+        )
+        return events
+
+    cfg = pw.persistence.Config(
+        pw.persistence.Backend.filesystem(store), snapshot_interval_ms=1
+    )
+    ev1 = build([("a", "a", 1), ("b", "b", 1)])
+    GraphRunner(G._current).run(persistence_config=cfg)
+    a_value = next(v for v, d in ev1 if d == 1 and v.startswith("a#"))
+    assert (store / "checkpoint.pkl").exists()
+
+    # restart: source replays its first two rows (deduped by the journal) and
+    # then retracts "a" — the retraction must carry a_value verbatim
+    G.clear()
+    ev2 = build([("a", "a", 1), ("b", "b", 1), ("a", "a", -1)])
+    GraphRunner(G._current).run(persistence_config=cfg)
+    retractions = [v for v, d in ev2 if d < 0]
+    assert retractions == [a_value]

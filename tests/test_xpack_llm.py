@@ -149,3 +149,64 @@ def test_vector_store_server_rest_e2e():
     assert stats["file_count"] == 3
     files = client.get_input_files()
     assert len(files) == 3
+
+
+def test_image_parser_vision_pipeline():
+    """ImageParser: decode -> downsize -> base64 -> vision LLM message."""
+    import base64
+    import io
+
+    from PIL import Image
+
+    from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+    img = Image.new("RGB", (2000, 1000), color=(200, 30, 30))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+
+    seen = []
+
+    def fake_vision(messages):
+        seen.append(messages)
+        return "a red rectangle"
+
+    parser = ImageParser(llm=fake_vision, downsize_horizontal_width=640)
+    docs = parser.func(buf.getvalue())
+    assert docs == [("a red rectangle", {"width": 2000, "height": 1000, "format": "png"})]
+    (messages,) = seen
+    content = messages[0]["content"]
+    assert content[0]["type"] == "text"
+    url = content[1]["image_url"]["url"]
+    assert url.startswith("data:image/png;base64,")
+    # the sent image was downsized to the configured width
+    sent = Image.open(io.BytesIO(base64.b64decode(url.split(",", 1)[1])))
+    assert sent.size == (640, 320)
+
+
+def test_slide_parser_per_slide_docs():
+    from PIL import Image
+
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    slides = [Image.new("RGB", (100, 80), color=(0, 0, c)) for c in (10, 20, 30)]
+    calls = []
+
+    def fake_vision(messages):
+        calls.append(messages)
+        return f"slide #{len(calls)}"
+
+    parser = SlideParser(llm=fake_vision, _rasterizer=lambda contents: slides)
+    docs = parser.func(b"%PDF-fake")
+    assert [d[0] for d in docs] == ["slide #1", "slide #2", "slide #3"]
+    assert [d[1]["slide"] for d in docs] == [0, 1, 2]
+    assert all(d[1]["slide_count"] == 3 for d in docs)
+
+
+def test_image_parser_requires_llm():
+    import pytest
+
+    from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+    parser = ImageParser()
+    with pytest.raises(ValueError, match="vision-capable"):
+        parser.func(b"not-an-image")
