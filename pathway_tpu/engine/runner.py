@@ -675,6 +675,16 @@ class GraphRunner:
                         break
                     if not any_output and not self.sources_finished():
                         wake.wait(timeout=idle_wait)
+        except BaseException as exc:
+            # a failing run must be distinguishable from a clean close by sinks
+            # that hand state to OTHER graphs (ExportedTable._fail) — finish()
+            # in the finally block fires their on_end either way
+            from pathway_tpu.engine.evaluators import OutputEvaluator
+
+            for evaluator in self.evaluators.values():
+                if isinstance(evaluator, OutputEvaluator):
+                    evaluator.notify_failure(exc)
+            raise
         finally:
             StreamingDataSource.unregister_runner(wake)
             runtime.update(prev_runtime)

@@ -35,6 +35,7 @@ from pathway_tpu.io import (
     sqlite,
 )
 from pathway_tpu.io._subscribe import subscribe
+from pathway_tpu.io.export_import import ExportedTable, export_table, import_table
 
 __all__ = [
     "airbyte",
@@ -64,4 +65,7 @@ __all__ = [
     "slack",
     "sqlite",
     "subscribe",
+    "ExportedTable",
+    "export_table",
+    "import_table",
 ]

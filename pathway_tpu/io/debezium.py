@@ -72,33 +72,94 @@ def read(
     *,
     schema: sch.SchemaMetaclass,
     autocommit_duration_ms: int | None = 1500,
+    mode: str = "streaming",
+    name: str | None = None,
+    _consumer_factory: Any = None,
     **kwargs: Any,
 ) -> Any:
-    """Consume Debezium envelopes from a Kafka topic (requires a Kafka client)."""
-    try:
-        import confluent_kafka
-    except ImportError:
-        raise ImportError(
-            "no Kafka client library is available in this environment; use "
-            "pw.io.debezium.read_from_iterable(...) to feed envelopes from your own "
-            "consumer"
-        )
+    """Consume Debezium envelopes from a Kafka topic.
+
+    Rides the full Kafka connector machinery (``io/kafka._KafkaSubject``): offsets
+    checkpoint as in-band segment state and SEEK back on resume (the reference's
+    Debezium seek, ``data_format.rs:1053`` + ``offset.rs``); the consumer is
+    injectable for broker-less tests. Row keys derive from the schema's primary-key
+    columns when declared (upserts retract/insert under the same key), else from
+    the full row values.
+    """
+    from pathway_tpu.engine.datasource import StreamingDataSource
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.internals.keys import pointer_from
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.table import Table
+    from pathway_tpu.io.kafka import _default_consumer_factory, _KafkaSubject
+
     if topic_name is None:
         raise ValueError("pw.io.debezium.read requires topic_name")
+    if _consumer_factory is None:
+        try:
+            import confluent_kafka  # noqa: F401
+        except ImportError:
+            raise ImportError(
+                "no Kafka client library is available in this environment; pass "
+                "_consumer_factory=... or use pw.io.debezium.read_from_iterable(...)"
+            )
+    names = schema.column_names()
+    pk_cols = schema.primary_key_columns()
 
-    def consume() -> Iterable[bytes]:
-        consumer = confluent_kafka.Consumer(rdkafka_settings)
-        consumer.subscribe([topic_name])
-        while True:
-            msg = consumer.poll(1.0)
-            if msg is None:
-                continue
-            if msg.error():
-                if msg.error().code() == confluent_kafka.KafkaError._PARTITION_EOF:
-                    continue
-                raise RuntimeError(f"kafka consumer error: {msg.error()}")
-            yield msg.value()
+    class _DebeziumKafkaSubject(_KafkaSubject):
+        def _decode_events(self, msg: Any) -> list:
+            value = msg.value()
+            if value is None:
+                return []
+            events = parse_debezium_message(value, names)
+            # With a primary key, both halves of an update key by the SAME pk so
+            # the retraction cancels the original insert — and a `before` that
+            # lacks the pk (Postgres REPLICA IDENTITY DEFAULT ships before=null)
+            # falls back to `after`'s pk. Without a declared pk the row VALUES
+            # are the key, which requires full before images (REPLICA IDENTITY
+            # FULL); a null before can't name the row it retracts.
+            after_pk = None
+            if pk_cols:
+                for values, diff in events:
+                    if diff > 0 and all(values.get(c) is not None for c in pk_cols):
+                        after_pk = tuple(values[c] for c in pk_cols)
+                        break
+            out = []
+            for values, diff in events:
+                if pk_cols:
+                    pk = tuple(values.get(c) for c in pk_cols)
+                    if any(v is None for v in pk):
+                        if after_pk is None:
+                            raise ValueError(
+                                "debezium envelope carries no primary-key values "
+                                f"(columns {pk_cols}); configure the source with "
+                                "a replica identity that ships them"
+                            )
+                        pk = after_pk
+                    key = pointer_from(*pk)
+                else:
+                    if diff < 0 and all(values.get(c) is None for c in names):
+                        raise ValueError(
+                            "debezium retraction has no before image and the "
+                            "schema declares no primary key; declare one "
+                            "(column_definition(primary_key=True)) or enable "
+                            "REPLICA IDENTITY FULL"
+                        )
+                    key = pointer_from(*(values[c] for c in names))
+                out.append((values, diff, key))
+            return out
 
-    return read_from_iterable(
-        consume(), schema=schema, autocommit_duration_ms=autocommit_duration_ms
+    subject = _DebeziumKafkaSubject(
+        _consumer_factory or _default_consumer_factory,
+        rdkafka_settings,
+        [topic_name],
+        "json",
+        schema,
+        False,
+        mode=mode,
     )
+    source = StreamingDataSource(subject=subject, autocommit_ms=autocommit_duration_ms)
+    node = G.add_node(
+        pg.InputNode(source=source, streaming=mode == "streaming", name=name or "debezium")
+    )
+    return Table(node, schema, name=name or "debezium")

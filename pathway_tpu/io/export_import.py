@@ -1,0 +1,167 @@
+"""Cross-graph table handoff (parity: reference ``trait ExportedTable``
+``src/engine/graph.rs:630`` + ``src/engine/dataflow/export.rs``; Python side
+``internals/datasource.py:105`` ``ImportDataSource``).
+
+``export_table`` attaches a live handle to a table of one dataflow graph;
+``import_table`` mounts that handle as a streaming source of ANOTHER graph —
+the importing graph first receives the exported table's current snapshot, then
+every subsequent update, with original row keys preserved. The exporting and
+importing graphs typically run on different threads (the reference's
+interactive LiveTable pattern: one long-running background graph feeding
+short-lived foreground graphs).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.internals.parse_graph import G
+
+
+class ExportedTable:
+    """Live handle over an exported table: frontier + snapshot + subscriptions
+    (reference ``ExportedTable``: ``frontier()``, ``snapshot_at()``, callbacks)."""
+
+    def __init__(self, column_names: List[str], schema: Any):
+        self.column_names = list(column_names)
+        self.schema = schema
+        self._lock = threading.Lock()
+        self._advanced = threading.Condition(self._lock)
+        self._rows: Dict[bytes, tuple] = {}  # kb -> (Pointer, row dict)
+        self._frontier = -1
+        self._closed = False
+        self._failed: Optional[BaseException] = None
+        self._listeners: List[Callable] = []
+
+    # -- exporting-graph side ------------------------------------------------
+
+    def _on_batch(self, keys: Any, diffs: Any, columns: Dict[str, Any], time: int) -> None:
+        from pathway_tpu.internals.keys import key_bytes, keys_to_pointers
+
+        ptrs = keys_to_pointers(keys)
+        kbs = key_bytes(keys)
+        rows = [
+            {c: columns[c][i] for c in self.column_names} for i in range(len(ptrs))
+        ]
+        dlist = [int(d) for d in diffs]
+        # listeners are invoked UNDER the export lock: a concurrent subscribe()
+        # then cannot observe a batch before (or interleaved with) its snapshot
+        # delivery, and listeners never see two batches concurrently
+        with self._advanced:
+            for kb, ptr, row, d in zip(kbs, ptrs, rows, dlist):
+                if d > 0:
+                    self._rows[kb] = (ptr, row)
+                else:
+                    self._rows.pop(kb, None)
+            self._frontier = time
+            self._advanced.notify_all()
+            for listener in self._listeners:
+                listener(list(zip(ptrs, rows, dlist)), time)
+
+    def _close(self) -> None:
+        with self._advanced:
+            if self._closed:
+                return
+            self._closed = True
+            self._advanced.notify_all()
+            for listener in self._listeners:
+                listener(None, self._frontier)  # None batch = stream end
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._advanced:
+            self._failed = exc
+        self._close()
+
+    # -- importing-graph / user side -----------------------------------------
+
+    def frontier(self) -> int:
+        with self._lock:
+            return self._frontier
+
+    def failed(self) -> bool:
+        with self._lock:
+            return self._failed is not None
+
+    def snapshot_at(self, frontier: int | None = None, timeout: float | None = None) -> list:
+        """(Pointer, row) pairs once the export has advanced to ``frontier``
+        (reference ``snapshot_at``); None waits for whatever is current."""
+        with self._advanced:
+            if frontier is not None:
+                ok = self._advanced.wait_for(
+                    lambda: self._frontier >= frontier or self._closed,
+                    timeout=timeout,
+                )
+                if not ok:
+                    raise TimeoutError(
+                        f"exported table did not reach frontier {frontier}"
+                    )
+            return [(ptr, dict(row)) for ptr, row in self._rows.values()]
+
+    def subscribe(self, listener: Callable) -> None:
+        """Register ``listener(events, time)`` — called with the CURRENT snapshot
+        first (as inserts), then with every subsequent update batch; a ``None``
+        events value signals stream end. Snapshot delivery, registration, and
+        every later batch delivery all happen under the export lock, so the
+        listener can never see a batch before (or interleaved with) its
+        snapshot."""
+        with self._advanced:
+            snapshot = [
+                (ptr, dict(row), 1) for ptr, row in self._rows.values()
+            ]
+            if snapshot:
+                listener(snapshot, self._frontier)
+            if self._closed:
+                listener(None, self._frontier)
+            else:
+                self._listeners.append(listener)
+
+
+def export_table(table: Any) -> ExportedTable:
+    """Attach a live export handle to ``table`` (reference ``Graph::export_table``)."""
+    exported = ExportedTable(table.column_names(), table._schema)
+    G.add_node(
+        pg.OutputNode(
+            inputs=[table],
+            batch_callback=exported._on_batch,
+            on_end=exported._close,
+            on_error=exported._fail,
+        )
+    )
+    return exported
+
+
+class _ImportSubject:
+    """Streams an ExportedTable into a fresh graph, original keys preserved."""
+
+    def __init__(self, exported: ExportedTable):
+        self.exported = exported
+
+    def run(self, source: Any) -> None:
+        done = threading.Event()
+
+        def listener(events: Any, time: int) -> None:
+            if events is None:
+                done.set()
+                return
+            for ptr, row, diff in events:
+                source.push(dict(row), key=ptr, diff=diff)
+
+        self.exported.subscribe(listener)
+        done.wait()
+        if self.exported.failed():
+            raise RuntimeError("exporting graph failed") from self.exported._failed
+
+
+def import_table(exported: ExportedTable, *, autocommit_duration_ms: int | None = 50) -> Any:
+    """Mount an :class:`ExportedTable` as a source of the CURRENT graph
+    (reference ``Scope::import_table``, ``operator_handler.py:155``)."""
+    from pathway_tpu.engine.datasource import StreamingDataSource
+    from pathway_tpu.internals.table import Table
+
+    source = StreamingDataSource(
+        subject=_ImportSubject(exported), autocommit_ms=autocommit_duration_ms
+    )
+    node = G.add_node(pg.InputNode(source=source, streaming=True, name="import"))
+    return Table(node, exported.schema, name="import")

@@ -130,6 +130,15 @@ class _KafkaSubject:
             )
         return row
 
+    def _decode_events(self, msg: Any) -> list:
+        """(row, diff, key) events for one message; subclasses override for wire
+        formats carrying their own change semantics (Debezium envelopes)."""
+        row = self._decode(msg)
+        if row is None:
+            return []
+        key = pointer_from(msg.topic(), msg.partition(), msg.offset(), "kafka")
+        return [(row, 1, key)]
+
     # -- consumer loop ------------------------------------------------------------
 
     def run(self, source: StreamingDataSource) -> None:
@@ -203,16 +212,13 @@ class _KafkaSubject:
                             break
                         continue
                     raise RuntimeError(f"kafka consumer error: {err}")
-                row = self._decode(msg)
+                events = self._decode_events(msg)
                 tp = (msg.topic(), msg.partition())
                 next_offset = msg.offset() + 1
                 self.offsets[tp] = next_offset
                 dirty[tp] = next_offset
-                if row is not None:
-                    source.push(
-                        row,
-                        key=pointer_from(msg.topic(), msg.partition(), msg.offset(), "kafka"),
-                    )
+                for row, diff, key in events:
+                    source.push(row, key=key, diff=diff)
                 now = time_mod.monotonic()
                 if now - last_commit >= self.commit_every_s:
                     last_commit = now
