@@ -107,6 +107,53 @@ def read(
     pk_cols = schema.primary_key_columns()
 
     class _DebeziumKafkaSubject(_KafkaSubject):
+        """Keyed CDC decoding with UpsertSession semantics (reference
+        ``adaptors.rs:67``): a per-pk last-values cache resolves retractions whose
+        ``before`` image is missing or partial (Postgres REPLICA IDENTITY
+        DEFAULT), so the engine always retracts the exact values it inserted.
+        The cache rides offset markers, making it resume-exact."""
+
+        def __init__(self, *args: Any, **kwargs: Any):
+            super().__init__(*args, **kwargs)
+            self._last_values: dict = {}  # pk tuple -> row values dict
+            self._dirty_upserts: dict = {}  # pk -> values | None, since last marker
+
+        def _marker_extra(self) -> dict:
+            if self._dirty_upserts:
+                d, self._dirty_upserts = self._dirty_upserts, {}
+                return {"upserts": d}
+            return {}
+
+        @staticmethod
+        def fold_state_deltas(state_deltas: list) -> list:
+            latest: dict = {}
+            upserts: dict = {}
+            for delta in state_deltas:
+                latest[(delta["topic"], delta["partition"])] = {
+                    k: v for k, v in delta.items() if k != "upserts"
+                }
+                for pk, vals in (delta.get("upserts") or {}).items():
+                    if vals is None:
+                        upserts.pop(pk, None)
+                    else:
+                        upserts[pk] = vals
+            out = [latest[k] for k in sorted(latest)]
+            if upserts:
+                if out:
+                    out[-1] = {**out[-1], "upserts": upserts}
+                else:
+                    out = [{"upserts": upserts}]
+            return out
+
+        def restore(self, state_deltas: list) -> None:
+            super().restore([d for d in state_deltas if "topic" in d])
+            for delta in state_deltas:
+                for pk, vals in (delta.get("upserts") or {}).items():
+                    if vals is None:
+                        self._last_values.pop(pk, None)
+                    else:
+                        self._last_values[pk] = vals
+
         def _decode_events(self, msg: Any) -> list:
             value = msg.value()
             if value is None:
@@ -114,10 +161,11 @@ def read(
             events = parse_debezium_message(value, names)
             # With a primary key, both halves of an update key by the SAME pk so
             # the retraction cancels the original insert — and a `before` that
-            # lacks the pk (Postgres REPLICA IDENTITY DEFAULT ships before=null)
-            # falls back to `after`'s pk. Without a declared pk the row VALUES
-            # are the key, which requires full before images (REPLICA IDENTITY
-            # FULL); a null before can't name the row it retracts.
+            # lacks the pk (REPLICA IDENTITY DEFAULT ships before=null) falls
+            # back to `after`'s pk, with the retracted VALUES resolved from the
+            # last-values cache (the values actually inserted). Without a
+            # declared pk the row values are the key, requiring full before
+            # images (REPLICA IDENTITY FULL).
             after_pk = None
             if pk_cols:
                 for values, diff in events:
@@ -136,7 +184,29 @@ def read(
                                 "a replica identity that ships them"
                             )
                         pk = after_pk
+                    if diff < 0:
+                        # the cache is AUTHORITATIVE for retractions: the engine
+                        # must retract exactly the values it inserted, and before
+                        # images are unreliable (REPLICA IDENTITY DEFAULT ships
+                        # null or pk-only befores). Envelope values are only a
+                        # fallback for rows never seen (e.g. pre-resume history
+                        # with REPLICA IDENTITY FULL).
+                        cached = self._last_values.get(pk)
+                        if cached is not None:
+                            values = dict(cached)
+                        elif all(values.get(c) is None for c in names):
+                            raise ValueError(
+                                f"debezium retraction for pk {pk} has no before "
+                                "image and no prior insert was seen; cannot "
+                                "resolve the values to retract"
+                            )
                     key = pointer_from(*pk)
+                    if diff > 0:
+                        self._last_values[pk] = dict(values)
+                        self._dirty_upserts[pk] = dict(values)
+                    else:
+                        self._last_values.pop(pk, None)
+                        self._dirty_upserts[pk] = None
                 else:
                     if diff < 0 and all(values.get(c) is None for c in names):
                         raise ValueError(

@@ -27,8 +27,14 @@ class ExportedTable:
     def __init__(self, column_names: List[str], schema: Any):
         self.column_names = list(column_names)
         self.schema = schema
-        self._lock = threading.Lock()
+        # reentrant: listeners run under this lock and may call back into the
+        # public API (frontier/failed/subscribe, and snapshot_at of an
+        # already-reached frontier). A snapshot_at that would have to WAIT from
+        # inside a listener raises instead (the listener runs on the only
+        # producing thread — waiting there could never be satisfied).
+        self._lock = threading.RLock()
         self._advanced = threading.Condition(self._lock)
+        self._dispatching: int | None = None  # thread id during listener dispatch
         self._rows: Dict[bytes, tuple] = {}  # kb -> (Pointer, row dict)
         self._frontier = -1
         self._closed = False
@@ -48,7 +54,10 @@ class ExportedTable:
         dlist = [int(d) for d in diffs]
         # listeners are invoked UNDER the export lock: a concurrent subscribe()
         # then cannot observe a batch before (or interleaved with) its snapshot
-        # delivery, and listeners never see two batches concurrently
+        # delivery, and listeners never see two batches concurrently. Iterating
+        # a COPY keeps a listener subscribed from inside this dispatch (it got
+        # a snapshot that already includes this batch) from hearing the batch
+        # a second time.
         with self._advanced:
             for kb, ptr, row, d in zip(kbs, ptrs, rows, dlist):
                 if d > 0:
@@ -57,8 +66,12 @@ class ExportedTable:
                     self._rows.pop(kb, None)
             self._frontier = time
             self._advanced.notify_all()
-            for listener in self._listeners:
-                listener(list(zip(ptrs, rows, dlist)), time)
+            self._dispatching = threading.get_ident()
+            try:
+                for listener in list(self._listeners):
+                    listener(list(zip(ptrs, rows, dlist)), time)
+            finally:
+                self._dispatching = None
 
     def _close(self) -> None:
         with self._advanced:
@@ -66,8 +79,12 @@ class ExportedTable:
                 return
             self._closed = True
             self._advanced.notify_all()
-            for listener in self._listeners:
-                listener(None, self._frontier)  # None batch = stream end
+            self._dispatching = threading.get_ident()
+            try:
+                for listener in list(self._listeners):
+                    listener(None, self._frontier)  # None batch = stream end
+            finally:
+                self._dispatching = None
 
     def _fail(self, exc: BaseException) -> None:
         with self._advanced:
@@ -86,9 +103,19 @@ class ExportedTable:
 
     def snapshot_at(self, frontier: int | None = None, timeout: float | None = None) -> list:
         """(Pointer, row) pairs once the export has advanced to ``frontier``
-        (reference ``snapshot_at``); None waits for whatever is current."""
+        (reference ``snapshot_at``); None waits for whatever is current.
+        Raises when the exporting graph failed, or closed before reaching the
+        requested frontier — a crashed export must not read as a small table."""
         with self._advanced:
             if frontier is not None:
+                need_wait = self._frontier < frontier and not self._closed
+                if need_wait and self._dispatching == threading.get_ident():
+                    raise RuntimeError(
+                        "snapshot_at of a future frontier called from inside an "
+                        "ExportedTable listener would deadlock the exporting "
+                        "thread; listeners may only snapshot frontiers already "
+                        "reached"
+                    )
                 ok = self._advanced.wait_for(
                     lambda: self._frontier >= frontier or self._closed,
                     timeout=timeout,
@@ -97,6 +124,13 @@ class ExportedTable:
                     raise TimeoutError(
                         f"exported table did not reach frontier {frontier}"
                     )
+            if self._failed is not None:
+                raise RuntimeError("exporting graph failed") from self._failed
+            if frontier is not None and self._frontier < frontier:
+                raise RuntimeError(
+                    f"export closed at frontier {self._frontier} before "
+                    f"reaching {frontier}"
+                )
             return [(ptr, dict(row)) for ptr, row in self._rows.values()]
 
     def subscribe(self, listener: Callable) -> None:

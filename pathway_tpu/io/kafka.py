@@ -139,6 +139,10 @@ class _KafkaSubject:
         key = pointer_from(msg.topic(), msg.partition(), msg.offset(), "kafka")
         return [(row, 1, key)]
 
+    def _marker_extra(self) -> dict:
+        """Extra resumable state to ride the next offset marker (subclass hook)."""
+        return {}
+
     # -- consumer loop ------------------------------------------------------------
 
     def run(self, source: StreamingDataSource) -> None:
@@ -180,9 +184,16 @@ class _KafkaSubject:
         def flush_markers() -> None:
             # offset markers ride in-band AFTER the rows they cover, one per
             # touched partition per batch (a marker ends the engine batch, so
-            # they flush at batch boundaries, not per message)
+            # they flush at batch boundaries, not per message). Subclasses may
+            # piggyback extra resumable state on the first marker of a batch
+            # (the Debezium upsert cache).
+            extra = self._marker_extra()
             for (t, p), off in sorted(dirty.items()):
-                source.push_state({"topic": t, "partition": p, "next_offset": off})
+                marker = {"topic": t, "partition": p, "next_offset": off}
+                if extra:
+                    marker.update(extra)
+                    extra = {}
+                source.push_state(marker)
             dirty.clear()
 
         def all_partitions_eof() -> bool:

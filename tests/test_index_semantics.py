@@ -296,3 +296,98 @@ def test_assert_stream_equality_fixture():
         """
     )
     assert_stream_equality(a, b)  # same groups, times differ only by rank
+
+
+def test_query_k_zero_and_k_exceeding_corpus():
+    docs = T(
+        """
+        text | __time__
+        aaa  | 0
+        bbb  | 0
+        """
+    )
+    queries = T(
+        """
+        q   | k | __time__
+        abc | 0 | 2
+        azz | 5 | 2
+        """
+    )
+    index = _make_index(docs)
+    res = index.query_as_of_now(
+        queries.q, number_of_matches=queries.k, collapse_rows=True
+    )
+    rows = capture_rows(res)
+    assert len(rows) == 2
+    sizes = sorted(len(r["text"]) for r in rows)
+    assert sizes == [0, 2]  # k=0 -> no matches; k=5 -> whole 2-doc corpus
+
+
+def test_query_results_are_score_ordered():
+    """Matches must come best-first (reference index contract: scores descend)."""
+    docs = T(
+        """
+        text | __time__
+        a    | 0
+        aa   | 0
+        aaaa | 0
+        """
+    )
+    queries = T(
+        """
+        q  | __time__
+        ab | 2
+        """
+    )
+    index = _make_index(docs)
+    res = index.query_as_of_now(queries.q, number_of_matches=3, collapse_rows=True)
+    rows = capture_rows(
+        res.select(res.text, score=res._pw_index_reply_score)
+    )
+    (row,) = rows
+    scores = list(row["score"])
+    assert scores == sorted(scores, reverse=True)  # best (least-negative L2) first
+    # the embedder makes "a"-prefixed docs differ only in the length component:
+    # "aa" (len 2) matches "ab" (len 2) exactly
+    assert row["text"][0] == "aa"
+
+
+def test_query_filter_combined_with_reanswer():
+    """Metadata filters keep applying across re-answers (filter + update_old)."""
+    import json as _json
+
+    from pathway_tpu.internals.json import Json
+
+    docs = T(
+        """
+        text | meta                | __time__
+        dzz  | {"lang": "en"}      | 0
+        aab  | {"lang": "fr"}      | 0
+        aaa  | {"lang": "en"}      | 4
+        """
+    )
+    docs = docs.select(
+        docs.text,
+        meta=pw.apply_with_type(lambda s: Json(_json.loads(s)), Json, docs.meta),
+    )
+    queries = T(
+        """
+        q   | __time__
+        abc | 2
+        """
+    )
+    factory = BruteForceKnnFactory(
+        dimensions=4, metric=BruteForceKnnMetricKind.L2SQ, embedder=_vec_embedder
+    )
+    index = factory.build_index(docs.text, docs, metadata_column=docs.meta)
+    res = index.query(
+        queries.q,
+        number_of_matches=1,
+        collapse_rows=True,
+        metadata_filter="lang == 'en'",
+    )
+    rows = capture_rows(res)
+    assert len(rows) == 1
+    # the French doc is filtered although it is the nearest at query time;
+    # when "aaa" (en) arrives the answer upgrades from "dzz" to "aaa"
+    assert rows[0]["text"] == ("aaa",)

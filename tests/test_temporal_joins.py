@@ -487,3 +487,70 @@ def test_interval_join_with_behavior_cutoff_streaming():
     # the late t=3 row is past the cutoff (max seen 20, cutoff 2) and is dropped
     assert (3, 3) not in got
     assert (1, 1) in got and (2, 2) in got and (20, 20) in got
+
+
+def test_interval_join_outer_streaming_null_flip():
+    """A late-arriving right row must RETRACT the left row's null output and
+    emit the matched pair (the incremental flip obligation of outer temporal
+    joins — reference interval_join outer under streaming)."""
+    pg.G.clear()
+    left = pw.debug.table_from_rows(
+        pw.schema_builder({"t": int}), [(10, 0, 1)], is_stream=True
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_builder({"t2": int, "v": int}),
+        [(100, 0, 0, 1), (11, 7, 2, 1)],  # match for t=10 arrives LATER (time 2)
+        is_stream=True,
+    )
+    res = left.interval_join_outer(
+        right, left.t, right.t2, pw.temporal.interval(-2, 2)
+    ).select(lt=left.t, rv=right.v)
+    events = []
+    pw.io.subscribe(
+        res,
+        on_batch=lambda keys, diffs, columns, time: events.extend(
+            (time, lt, rv, d)
+            for lt, rv, d in zip(
+                columns["lt"].tolist(), columns["rv"].tolist(), diffs.tolist()
+            )
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    # final state: (10, 7) matched and (None, 0) for the unmatched right row
+    state = {}
+    for _t, lt, rv, d in events:
+        state[(lt, rv)] = state.get((lt, rv), 0) + d
+    live = sorted((k for k, v in state.items() if v > 0), key=repr)
+    assert live == sorted([(10, 7), (None, 0)], key=repr)
+    # and the null row (10, None) was emitted then retracted
+    assert (10, None) in [(lt, rv) for _t, lt, rv, d in events if d > 0]
+    assert (10, None) in [(lt, rv) for _t, lt, rv, d in events if d < 0]
+
+
+def test_asof_now_join_keeps_first_answers():
+    """asof_now joins answer at arrival and never retract, even when the right
+    side later changes (reference _asof_now_join.py semantics)."""
+    pg.G.clear()
+    queries = pw.debug.table_from_rows(
+        pw.schema_builder({"q": int}),
+        [(1, 2, 1), (2, 6, 1)],
+        is_stream=True,
+    )
+    state = pw.debug.table_from_rows(
+        pw.schema_builder({"k": int, "ver": str}),
+        # version changes between the two queries
+        [(0, "v1", 0, 1), (0, "v1", 4, -1), (0, "v2", 4, 1)],
+        is_stream=True,
+    )
+    res = queries.asof_now_join(state).select(q=queries.q, ver=state.ver)
+    events = []
+    pw.io.subscribe(
+        res,
+        on_batch=lambda keys, diffs, columns, time: events.extend(
+            zip(columns["q"].tolist(), columns["ver"].tolist(), diffs.tolist())
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert all(d > 0 for _q, _v, d in events)  # never a retraction
+    answers = {q: v for q, v, _d in events}
+    assert answers == {1: "v1", 2: "v2"}  # each query saw the state AT ARRIVAL
