@@ -45,21 +45,25 @@ class ProberStats:
                 self.rows_by_node[nid] = self.rows_by_node.get(nid, 0) + n
             self.input_finished = finished
 
+    def _latencies_locked(self, now: float) -> tuple:
+        """(input_latency_ms, output_latency_ms); -1 when input is finished.
+        Caller holds ``self.lock`` — the single home of the -1/started-fallback
+        convention shared by the /status endpoint and the OTel gauges."""
+        if self.input_finished:
+            return (-1, -1)
+        base_in = self.last_input_time if self.last_input_time is not None else self.started
+        base_out = self.last_output_time if self.last_output_time is not None else self.started
+        return (int((now - base_in) * 1000), int((now - base_out) * 1000))
+
+    def latencies_ms(self) -> tuple:
+        now = time.time()
+        with self.lock:
+            return self._latencies_locked(now)
+
     def to_openmetrics(self) -> str:
         now = time.time()
         with self.lock:
-            if self.input_finished:
-                input_latency = -1
-            elif self.last_input_time is None:
-                input_latency = int((now - self.started) * 1000)
-            else:
-                input_latency = int((now - self.last_input_time) * 1000)
-            if self.input_finished:
-                output_latency = -1
-            elif self.last_output_time is None:
-                output_latency = int((now - self.started) * 1000)
-            else:
-                output_latency = int((now - self.last_output_time) * 1000)
+            input_latency, output_latency = self._latencies_locked(now)
             lines = [
                 "# HELP input_latency_ms A latency of input in milliseconds (-1 when finished)",
                 "# TYPE input_latency_ms gauge",

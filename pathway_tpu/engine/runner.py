@@ -44,6 +44,7 @@ class GraphRunner:
         self._materialized: set = set()
         self._materialize_all = False  # nested iterate runners read states directly
         self._cluster: Any = None  # multi-process exchange (parallel/cluster.py)
+        self._metrics: Any = None  # OTel MetricsRecorder (engine/telemetry.py)
 
     def state_of(self, node: pg.Node) -> StateTable:
         if node.id not in self._materialized:
@@ -356,6 +357,7 @@ class GraphRunner:
         forgetting updates, so ``_filter_out_results_of_forgetting`` can drop whole neu
         deltas without losing genuine data.
         """
+        commit_t0 = time_mod.monotonic()
         self.current_time = self._commit * 2  # even data times, as in the reference
         self.draining = self._ready and self.sources_finished()
         any_output = self._substep(neu=False)
@@ -393,6 +395,12 @@ class GraphRunner:
                 self._step_counts,
                 self.sources_finished(),
             )
+            if self._metrics is not None:
+                self._metrics.record_commit(
+                    input_rows,
+                    self._output_rows_this_commit,
+                    time_mod.monotonic() - commit_t0,
+                )
         if self._monitor is not None:
             self._monitor.update(self._commit, self._step_counts, self.states)
         self._commit += 1
@@ -605,7 +613,9 @@ class GraphRunner:
 
         self.prober_stats = ProberStats()
         self._http_server = maybe_start_http_server(self.prober_stats, with_http_server)
-        from pathway_tpu.engine.telemetry import span
+        from pathway_tpu.engine.telemetry import MetricsRecorder, span
+
+        self._metrics = MetricsRecorder.get(self.prober_stats)
 
         if not self._ready:
             with span("graph_runner.build", nodes=len(self.graph.nodes)):

@@ -46,3 +46,125 @@ def span(name: str, **attributes: Any) -> Iterator[None]:
             except Exception:
                 pass
         yield
+
+
+# -- metrics (reference telemetry.rs:37-45: OTLP process mem/cpu + latency) -------
+
+
+def _metrics_enabled() -> bool:
+    return os.environ.get("PATHWAY_TELEMETRY", "").lower() not in (
+        "", "0", "false", "no", "off",
+    ) or "opentelemetry.metrics" in sys.modules
+
+
+class MetricsRecorder:
+    """OpenTelemetry metric instruments around runs (reference
+    ``telemetry.rs:37-45``: process memory/cpu observable gauges, input/output
+    latency gauges, row counters @ the meter's export interval).
+
+    Instruments go through the opentelemetry METRICS API: a no-op without a
+    configured ``MeterProvider``; operators wire an OTLP (or any) exporter by
+    setting the global provider before ``pw.run``. Process stats come from
+    psutil, sampled by the SDK's observation callbacks — zero cost per commit.
+
+    Process-wide SINGLETON (``MetricsRecorder.get``): instruments register on
+    the global meter exactly once; repeated ``pw.run`` calls (notebooks, the
+    export/import pattern) swap which run's ``ProberStats`` feeds the latency
+    gauges instead of piling up duplicate instruments and leaked callbacks.
+    """
+
+    _instance: "MetricsRecorder | None" = None
+
+    @classmethod
+    def get(cls, prober_stats: Any = None) -> "MetricsRecorder":
+        if cls._instance is None:
+            cls._instance = cls()
+        cls._instance._stats = prober_stats
+        return cls._instance
+
+    def __init__(self):
+        self._enabled = False
+        self._stats: Any = None  # the CURRENT run's ProberStats (gauges read it)
+        self._commit_counter: Any = None
+        self._input_counter: Any = None
+        self._output_counter: Any = None
+        self._latency_hist: Any = None
+        if not _metrics_enabled():
+            return
+        try:
+            from opentelemetry import metrics
+
+            meter = metrics.get_meter("pathway_tpu")
+            import psutil
+
+            process = psutil.Process()
+
+            def _mem_cb(_options: Any) -> list:
+                from opentelemetry.metrics import Observation
+
+                return [Observation(process.memory_info().rss)]
+
+            def _cpu_cb(_options: Any) -> list:
+                from opentelemetry.metrics import Observation
+
+                return [Observation(process.cpu_percent(interval=None))]
+
+            def _input_latency_cb(_options: Any) -> list:
+                from opentelemetry.metrics import Observation
+
+                stats = self._stats
+                if stats is None:
+                    return []
+                ms = stats.latencies_ms()[0]
+                return [Observation(ms)] if ms >= 0 else []
+
+            def _output_latency_cb(_options: Any) -> list:
+                from opentelemetry.metrics import Observation
+
+                stats = self._stats
+                if stats is None:
+                    return []
+                ms = stats.latencies_ms()[1]
+                return [Observation(ms)] if ms >= 0 else []
+
+            meter.create_observable_gauge(
+                "process.memory.usage", callbacks=[_mem_cb], unit="By",
+                description="resident set size",
+            )
+            meter.create_observable_gauge(
+                "process.cpu.utilization", callbacks=[_cpu_cb], unit="%",
+            )
+            meter.create_observable_gauge(
+                "pathway.input.latency", callbacks=[_input_latency_cb], unit="ms",
+            )
+            meter.create_observable_gauge(
+                "pathway.output.latency", callbacks=[_output_latency_cb], unit="ms",
+            )
+            self._commit_counter = meter.create_counter(
+                "pathway.commits", description="commits processed"
+            )
+            self._input_counter = meter.create_counter(
+                "pathway.input.rows", description="source rows ingested"
+            )
+            self._output_counter = meter.create_counter(
+                "pathway.output.rows", description="rows delivered to sinks"
+            )
+            self._latency_hist = meter.create_histogram(
+                "pathway.commit.duration", unit="s",
+            )
+            self._enabled = True
+        except Exception:
+            self._enabled = False
+
+    def record_commit(self, input_rows: int, output_rows: int, duration_s: float) -> None:
+        if not self._enabled:
+            return
+        try:
+            self._commit_counter.add(1)
+            if input_rows:
+                self._input_counter.add(input_rows)
+            if output_rows:
+                self._output_counter.add(output_rows)
+            self._latency_hist.record(duration_s)
+        except Exception:
+            pass

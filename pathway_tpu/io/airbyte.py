@@ -253,7 +253,9 @@ def read(
             "supported (the reference's 'remote' type runs Google Cloud jobs)"
         )
     source_cfg = _load_source_config(os.fspath(config_file_path))
-    subject = _AirbyteSubject(
+    from pathway_tpu.io.python import _NoopRunner, _runs_on_this_process
+
+    subject: Any = _AirbyteSubject(
         _process_factory or _default_process_factory,
         source_cfg,
         list(streams),
@@ -261,6 +263,10 @@ def read(
         refresh_interval_ms / 1000.0,
         env_vars,
     )
+    if not _runs_on_this_process(subject):
+        # one sync process per connection (reference parallel-reader placement);
+        # peer processes receive rows through the exchange
+        subject = _NoopRunner()
     schema = sch.schema_from_types(data=dt.JSON)
     source = StreamingDataSource(subject=subject, autocommit_ms=autocommit_duration_ms)
     node = G.add_node(

@@ -95,6 +95,16 @@ def read(
 
     if topic_name is None:
         raise ValueError("pw.io.debezium.read requires topic_name")
+    from pathway_tpu.internals.config import get_pathway_config
+
+    if get_pathway_config().processes > 1 and "group.id" not in rdkafka_settings:
+        # same parallel-read contract as kafka.read: consumer groups split
+        # partitions across processes; without one every process re-consumes
+        # the full CDC topic and aggregates double-count
+        raise ValueError(
+            "multi-process debezium.read requires rdkafka_settings['group.id'] "
+            "so the broker splits partitions across the spawned processes"
+        )
     if _consumer_factory is None:
         try:
             import confluent_kafka  # noqa: F401
@@ -190,16 +200,25 @@ def read(
                         # images are unreliable (REPLICA IDENTITY DEFAULT ships
                         # null or pk-only befores). Envelope values are only a
                         # fallback for rows never seen (e.g. pre-resume history
-                        # with REPLICA IDENTITY FULL).
+                        # with REPLICA IDENTITY FULL). A retraction for a row
+                        # NEVER seen in this run with no usable before image is
+                        # DROPPED: this engine's state doesn't hold the row (a
+                        # restart without persistence starts empty), so there is
+                        # nothing to retract and the insert half upserts cleanly.
                         cached = self._last_values.get(pk)
                         if cached is not None:
                             values = dict(cached)
                         elif all(values.get(c) is None for c in names):
-                            raise ValueError(
-                                f"debezium retraction for pk {pk} has no before "
-                                "image and no prior insert was seen; cannot "
-                                "resolve the values to retract"
+                            import logging
+
+                            logging.getLogger("pathway_tpu").warning(
+                                "debezium retraction for pk %s has no before "
+                                "image and no prior insert was seen in this "
+                                "run; dropping the retraction (engine state "
+                                "cannot hold the row)",
+                                pk,
                             )
+                            continue
                     key = pointer_from(*pk)
                     if diff > 0:
                         self._last_values[pk] = dict(values)

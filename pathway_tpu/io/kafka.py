@@ -274,6 +274,17 @@ def read(
     topics = [topic] if topic else list(topic_names or [])
     if not topics:
         raise ValueError("kafka.read requires a topic (or topic_names)")
+    from pathway_tpu.internals.config import get_pathway_config
+
+    if get_pathway_config().processes > 1 and "group.id" not in rdkafka_settings:
+        # parallel read correctness rides Kafka consumer groups: same group ->
+        # the broker assigns DISJOINT partitions per process (the reference's
+        # parallel_readers split); without one every process would re-consume
+        # the full topic
+        raise ValueError(
+            "multi-process kafka.read requires rdkafka_settings['group.id'] so "
+            "the broker splits partitions across the spawned processes"
+        )
     if _consumer_factory is None:
         # fail at call time, not inside the connector thread
         try:

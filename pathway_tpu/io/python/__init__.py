@@ -79,6 +79,26 @@ class _SubjectRunner:
             self.subject.on_stop()
 
 
+class _NoopRunner:
+    """Non-reader processes park the subject: the source closes immediately."""
+
+    def run(self, source: StreamingDataSource) -> None:
+        return
+
+
+def _runs_on_this_process(subject: Any) -> bool:
+    """Reference parallel-reader placement (``dataflow.rs:3317``): a source that
+    does not declare itself ``parallelized`` reads on process 0 only — its rows
+    reach peer processes through the groupby/join exchange. Subjects that shard
+    their own input (one reader per process) set ``parallelized = True``."""
+    if getattr(subject, "parallelized", False):
+        return True
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    return cfg.processes <= 1 or cfg.process_id == 0
+
+
 def read(
     subject: ConnectorSubject,
     *,
@@ -87,9 +107,12 @@ def read(
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
-    source = StreamingDataSource(
-        subject=_SubjectRunner(subject), autocommit_ms=autocommit_duration_ms
+    runner = (
+        _SubjectRunner(subject)
+        if _runs_on_this_process(subject)
+        else _NoopRunner()
     )
+    source = StreamingDataSource(subject=runner, autocommit_ms=autocommit_duration_ms)
     subject._schema = schema
     node = G.add_node(pg.InputNode(source=source, streaming=True, name=name or "python"))
     return Table(node, schema, name=name or "python")

@@ -219,3 +219,78 @@ def test_spawn_streaming_commits_with_retractions(tmp_path):
     # global truth: a -> 1 (2 inserts - 1 retract), b -> 2
     assert dict(merged) == {"a": 1, "b": 2}
     assert all(v == 1 for v in owners.values())  # one owner per group
+
+
+def test_python_connector_reads_on_process_zero_only(tmp_path, monkeypatch):
+    """A non-parallelized python ConnectorSubject must read on process 0 only
+    (reference parallel-reader placement, dataflow.rs:3317); peers see its rows
+    via the exchange, not by re-running the subject."""
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject, read
+
+    class Subj(ConnectorSubject):
+        def run(self):
+            self.next(v=1)
+            self.close()
+
+    class Sch(pw.Schema):
+        v: int
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    from pathway_tpu.internals import config as cfg_mod
+
+    cfg_mod.get_pathway_config.cache_clear() if hasattr(
+        cfg_mod.get_pathway_config, "cache_clear"
+    ) else None
+
+    import pathway_tpu.internals.parse_graph as pg_mod
+
+    pg_mod.G.clear()
+    t = read(Subj(), schema=Sch)
+    node = next(n for n in pg_mod.G._current.nodes if n.kind == "input")
+    from pathway_tpu.io.python import _NoopRunner
+
+    assert isinstance(node.config["source"].subject, _NoopRunner)
+
+    # process 0 DOES read
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    if hasattr(cfg_mod.get_pathway_config, "cache_clear"):
+        cfg_mod.get_pathway_config.cache_clear()
+    pg_mod.G.clear()
+    t0 = read(Subj(), schema=Sch)
+    node0 = next(n for n in pg_mod.G._current.nodes if n.kind == "input")
+    assert not isinstance(node0.config["source"].subject, _NoopRunner)
+
+    # a parallelized subject reads everywhere
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    if hasattr(cfg_mod.get_pathway_config, "cache_clear"):
+        cfg_mod.get_pathway_config.cache_clear()
+
+    class ShardedSubj(Subj):
+        parallelized = True
+
+    pg_mod.G.clear()
+    t1 = read(ShardedSubj(), schema=Sch)
+    node1 = next(n for n in pg_mod.G._current.nodes if n.kind == "input")
+    assert not isinstance(node1.config["source"].subject, _NoopRunner)
+
+
+def test_multiprocess_kafka_requires_consumer_group(monkeypatch):
+    import pytest
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import config as cfg_mod
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    if hasattr(cfg_mod.get_pathway_config, "cache_clear"):
+        cfg_mod.get_pathway_config.cache_clear()
+    import pathway_tpu.internals.parse_graph as pg_mod
+
+    pg_mod.G.clear()
+    with pytest.raises(ValueError, match="group.id"):
+        pw.io.kafka.read(
+            {"bootstrap.servers": "x"},
+            topic="t",
+            _consumer_factory=lambda s: None,
+        )

@@ -85,3 +85,100 @@ def test_rest_openapi_schema_endpoint():
     assert body["required"] == ["query"]
     assert ask["post"]["summary"] == "Ask a question"
 
+    # the SERVED GET /_schema route must return the same document (the aiohttp
+    # handler path: route registration + JSON serialization of defaults)
+    import threading
+
+    t = pw.debug.table_from_rows(pw.schema_builder({"x": int}), [(1,)])
+    pw.io.subscribe(t, lambda *a, **kw: None)
+    run_thread = threading.Thread(
+        target=lambda: pw.run(monitoring_level=pw.MonitoringLevel.NONE),
+        daemon=True,
+    )
+    run_thread.start()
+    import time as time_mod
+
+    served = None
+    deadline = time_mod.monotonic() + 20
+    while time_mod.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/_schema", timeout=2
+            ) as resp:
+                served = json.loads(resp.read())
+                break
+        except Exception:
+            time_mod.sleep(0.2)
+    assert served is not None, "GET /_schema never became reachable"
+    assert served["paths"]["/v1/ask"]["post"]["summary"] == "Ask a question"
+    schema_k = served["paths"]["/v1/ask"]["post"]["requestBody"]["content"][
+        "application/json"
+    ]["schema"]["properties"]["k"]
+    assert schema_k["default"] == 3  # default_value survived JSON serialization
+
+
+
+def test_otel_metrics_recorder_instruments(monkeypatch):
+    """With PATHWAY_TELEMETRY on, the recorder creates OTel instruments and
+    records per-commit measurements (reference telemetry.rs:37-45); a fake meter
+    provider captures what the SDK would export."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.http_server import ProberStats
+    from pathway_tpu.engine.telemetry import MetricsRecorder
+
+    recorded = {"counters": {}, "hist": []}
+
+    class FakeInstrument:
+        def __init__(self, name):
+            self.name = name
+
+        def add(self, value, attributes=None):
+            recorded["counters"][self.name] = (
+                recorded["counters"].get(self.name, 0) + value
+            )
+
+        def record(self, value, attributes=None):
+            recorded["hist"].append((self.name, value))
+
+    class FakeMeter:
+        def __init__(self):
+            self.gauges = []
+
+        def create_observable_gauge(self, name, callbacks=None, **kw):
+            self.gauges.append((name, callbacks))
+
+        def create_counter(self, name, **kw):
+            return FakeInstrument(name)
+
+        def create_histogram(self, name, **kw):
+            return FakeInstrument(name)
+
+    fake_meter = FakeMeter()
+    from opentelemetry import metrics as otel_metrics
+
+    monkeypatch.setenv("PATHWAY_TELEMETRY", "1")
+    monkeypatch.setattr(otel_metrics, "get_meter", lambda name: fake_meter)
+
+    MetricsRecorder._instance = None  # fresh singleton for the fake meter
+    stats = ProberStats()
+    rec = MetricsRecorder.get(stats)
+    assert rec._enabled
+    # repeated runs REUSE the instruments (no duplicate gauges), only the
+    # stats source swaps
+    rec2 = MetricsRecorder.get(ProberStats())
+    assert rec2 is rec
+    assert len(fake_meter.gauges) == 4
+    gauge_names = [g[0] for g in fake_meter.gauges]
+    assert "process.memory.usage" in gauge_names
+    assert "pathway.input.latency" in gauge_names
+    rec.record_commit(10, 4, 0.05)
+    rec.record_commit(0, 1, 0.01)
+    assert recorded["counters"]["pathway.commits"] == 2
+    assert recorded["counters"]["pathway.input.rows"] == 10
+    assert recorded["counters"]["pathway.output.rows"] == 5
+    assert len(recorded["hist"]) == 2
+    # observable gauge callbacks are live (psutil-backed)
+    mem_cb = dict(fake_meter.gauges)["process.memory.usage"][0]
+    (obs,) = mem_cb(None)
+    assert obs.value > 0
+    MetricsRecorder._instance = None  # don't leak the fake-metered singleton
