@@ -19,12 +19,19 @@ import sys
 from typing import Any, Iterator
 
 
+def _telemetry_requested(module: str) -> bool:
+    """One home for the enablement rule shared by traces and metrics: the
+    PATHWAY_TELEMETRY env gate, or the relevant OTel module already imported
+    (an operator wiring an SDK provider implies intent)."""
+    requested = os.environ.get("PATHWAY_TELEMETRY", "").lower() not in (
+        "", "0", "false", "no", "off",
+    )
+    return requested or module in sys.modules
+
+
 def _tracer() -> Any:
     try:
-        requested = os.environ.get("PATHWAY_TELEMETRY", "").lower() not in (
-            "", "0", "false", "no", "off",
-        )
-        if "opentelemetry.trace" not in sys.modules and not requested:
+        if not _telemetry_requested("opentelemetry.trace"):
             return None  # no SDK configured and not requested: stay no-op, import-free
         from opentelemetry import trace
 
@@ -52,9 +59,7 @@ def span(name: str, **attributes: Any) -> Iterator[None]:
 
 
 def _metrics_enabled() -> bool:
-    return os.environ.get("PATHWAY_TELEMETRY", "").lower() not in (
-        "", "0", "false", "no", "off",
-    ) or "opentelemetry.metrics" in sys.modules
+    return _telemetry_requested("opentelemetry.metrics")
 
 
 class MetricsRecorder:
@@ -77,7 +82,12 @@ class MetricsRecorder:
 
     @classmethod
     def get(cls, prober_stats: Any = None) -> "MetricsRecorder":
-        if cls._instance is None:
+        if cls._instance is None or (
+            not cls._instance._enabled and _metrics_enabled()
+        ):
+            # telemetry may be switched on BETWEEN runs (notebooks): a disabled
+            # cached instance rebuilds once enablement appears; an enabled one
+            # is never rebuilt (instruments must register exactly once)
             cls._instance = cls()
         cls._instance._stats = prober_stats
         return cls._instance

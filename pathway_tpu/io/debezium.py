@@ -209,13 +209,30 @@ def read(
                         if cached is not None:
                             values = dict(cached)
                         elif all(values.get(c) is None for c in names):
+                            from pathway_tpu.internals.config import (
+                                get_pathway_config,
+                            )
+
+                            if get_pathway_config().processes > 1:
+                                # multi-process: a consumer-group rebalance can
+                                # hand us a partition whose inserts a PEER
+                                # cached — the row may well live in exchanged
+                                # engine state, so dropping would leak it; fail
+                                # loudly instead
+                                raise ValueError(
+                                    f"debezium retraction for pk {pk} has no "
+                                    "before image and no local insert history "
+                                    "(likely a consumer-group rebalance); "
+                                    "enable REPLICA IDENTITY FULL or Pathway "
+                                    "persistence so retraction values resolve"
+                                )
                             import logging
 
                             logging.getLogger("pathway_tpu").warning(
                                 "debezium retraction for pk %s has no before "
                                 "image and no prior insert was seen in this "
-                                "run; dropping the retraction (engine state "
-                                "cannot hold the row)",
+                                "run; dropping the retraction (single-process: "
+                                "engine state cannot hold the row)",
                                 pk,
                             )
                             continue

@@ -416,3 +416,28 @@ def _as_vector(value: Any) -> Any:
     if isinstance(value, (tuple, list)):
         return np.asarray(value, dtype=np.float32)
     raise TypeError(f"expected a vector, got {type(value).__name__}")
+
+
+class IvfKnnIndex(BruteForceKnnIndex):
+    """ExternalIndex-protocol adapter over the IVF-Flat store (the reference's
+    approximate index role — USearch HNSW — served the TPU way; see
+    ``ops/knn_ivf.py``)."""
+
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "l2sq",
+        initial_capacity: int = 1024,
+        n_clusters: int = 64,
+        n_probe: int = 8,
+    ):
+        from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+        self.store = IvfKnnStore(
+            dim,
+            metric=metric,
+            initial_capacity=initial_capacity,
+            n_clusters=n_clusters,
+            n_probe=n_probe,
+        )
+        self.filter_data = {}

@@ -7,6 +7,8 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
     BruteForceKnnFactory,
     BruteForceKnnMetricKind,
+    IvfKnn,
+    IvfKnnFactory,
     LshKnn,
     LshKnnFactory,
     USearchKnn,
@@ -33,6 +35,8 @@ __all__ = [
     "HybridIndex",
     "HybridIndexFactory",
     "InnerIndex",
+    "IvfKnn",
+    "IvfKnnFactory",
     "LshKnn",
     "LshKnnFactory",
     "TantivyBM25",

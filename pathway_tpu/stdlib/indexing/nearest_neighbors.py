@@ -366,3 +366,79 @@ def default_lsh_knn_document_index(
         data_table,
         LshKnn(data_column, metadata_column, dimensions=dimensions, embedder=embedder),
     )
+
+
+class IvfKnn(_KnnInnerIndex):
+    """Approximate KNN via IVF-Flat on the TPU — the reference's ANN slot
+    (``USearchKnn`` over HNSW, ``usearch_integration.rs:20``) filled with a
+    coarse-quantizer design that maps to the MXU (``ops/knn_ivf.py``).
+    ``n_probe`` trades recall for candidate volume; ``n_probe == n_clusters``
+    degenerates to exact search."""
+
+    _device_queries = True
+
+    def __init__(
+        self,
+        data_column: expr.ColumnReference,
+        metadata_column: expr.ColumnReference | None = None,
+        *,
+        dimensions: int,
+        reserved_space: int = 1024,
+        n_clusters: int = 64,
+        n_probe: int = 8,
+        metric: BruteForceKnnMetricKind = BruteForceKnnMetricKind.L2SQ,
+        embedder: Any = None,
+    ):
+        from pathway_tpu.ops.knn import IvfKnnIndex
+
+        metric_s = _metric_str(metric)
+        super().__init__(
+            data_column,
+            metadata_column,
+            dimensions,
+            metric_s,
+            embedder,
+            make_index=lambda: IvfKnnIndex(
+                dimensions,
+                metric=metric_s,
+                initial_capacity=max(16, reserved_space),
+                n_clusters=n_clusters,
+                n_probe=n_probe,
+            ),
+        )
+
+
+class IvfKnnFactory(_KnnFactoryBase):
+    def __init__(
+        self,
+        *,
+        dimensions: int | None = None,
+        reserved_space: int = 1024,
+        n_clusters: int = 64,
+        n_probe: int = 8,
+        metric: BruteForceKnnMetricKind = BruteForceKnnMetricKind.L2SQ,
+        embedder: Any = None,
+    ):
+        super().__init__(dimensions, reserved_space, metric, embedder, IvfKnn)
+        self.n_clusters = n_clusters
+        self.n_probe = n_probe
+
+    def build_inner_index(
+        self,
+        data_column: expr.ColumnReference,
+        metadata_column: expr.ColumnReference | None = None,
+    ) -> InnerIndex:
+        dims = self.dimensions
+        if dims is None and self.embedder is not None:
+            dims = _probe_embedder_dims(self.embedder)
+        assert dims is not None, "dimensions required (or an embedder to probe)"
+        return IvfKnn(
+            data_column,
+            metadata_column,
+            dimensions=dims,
+            reserved_space=self.reserved_space,
+            n_clusters=self.n_clusters,
+            n_probe=self.n_probe,
+            metric=self.metric,
+            embedder=self.embedder,
+        )

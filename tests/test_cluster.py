@@ -238,12 +238,6 @@ def test_python_connector_reads_on_process_zero_only(tmp_path, monkeypatch):
 
     monkeypatch.setenv("PATHWAY_PROCESSES", "2")
     monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
-    from pathway_tpu.internals import config as cfg_mod
-
-    cfg_mod.get_pathway_config.cache_clear() if hasattr(
-        cfg_mod.get_pathway_config, "cache_clear"
-    ) else None
-
     import pathway_tpu.internals.parse_graph as pg_mod
 
     pg_mod.G.clear()
@@ -255,8 +249,6 @@ def test_python_connector_reads_on_process_zero_only(tmp_path, monkeypatch):
 
     # process 0 DOES read
     monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
-    if hasattr(cfg_mod.get_pathway_config, "cache_clear"):
-        cfg_mod.get_pathway_config.cache_clear()
     pg_mod.G.clear()
     t0 = read(Subj(), schema=Sch)
     node0 = next(n for n in pg_mod.G._current.nodes if n.kind == "input")
@@ -264,8 +256,6 @@ def test_python_connector_reads_on_process_zero_only(tmp_path, monkeypatch):
 
     # a parallelized subject reads everywhere
     monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
-    if hasattr(cfg_mod.get_pathway_config, "cache_clear"):
-        cfg_mod.get_pathway_config.cache_clear()
 
     class ShardedSubj(Subj):
         parallelized = True
@@ -280,11 +270,8 @@ def test_multiprocess_kafka_requires_consumer_group(monkeypatch):
     import pytest
 
     import pathway_tpu as pw
-    from pathway_tpu.internals import config as cfg_mod
 
     monkeypatch.setenv("PATHWAY_PROCESSES", "2")
-    if hasattr(cfg_mod.get_pathway_config, "cache_clear"):
-        cfg_mod.get_pathway_config.cache_clear()
     import pathway_tpu.internals.parse_graph as pg_mod
 
     pg_mod.G.clear()

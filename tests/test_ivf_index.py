@@ -1,0 +1,101 @@
+"""IVF-Flat approximate index (the reference's ANN slot — USearch HNSW,
+``usearch_integration.rs:20`` — filled TPU-first: centroid matmul probing +
+padded inverted lists in one fused kernel, ``ops/knn_ivf.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.ops.knn import BruteForceKnnIndex, IvfKnnIndex
+
+from .utils import T, capture_rows
+
+
+def _clustered(n, dim, n_clusters, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=5.0, size=(n_clusters, dim)).astype(np.float32)
+    labels = rng.integers(0, n_clusters, n)
+    docs = (centers[labels] + rng.normal(size=(n, dim))).astype(np.float32)
+    return centers, docs
+
+
+def test_ivf_recall_against_brute_force():
+    centers, docs = _clustered(4000, 32, 16)
+    keys = [f"d{i}" for i in range(len(docs))]
+    bf = BruteForceKnnIndex(32, initial_capacity=8192)
+    ivf = IvfKnnIndex(32, initial_capacity=8192, n_clusters=16, n_probe=4)
+    bf.add_many(keys, list(docs))
+    ivf.add_many(keys, list(docs))
+    rng = np.random.default_rng(1)
+    queries = (
+        centers[rng.integers(0, 16, 50)] + rng.normal(size=(50, 32))
+    ).astype(np.float32)
+    bf_res = bf.search_many(list(queries), [10] * 50)
+    ivf_res = ivf.search_many(list(queries), [10] * 50)
+    hits = sum(
+        len({k for k, _ in b} & {k for k, _ in v}) for b, v in zip(bf_res, ivf_res)
+    )
+    assert hits / 500 >= 0.95  # clustered data, 4/16 probes
+
+
+def test_ivf_full_probe_is_exact():
+    _, docs = _clustered(500, 16, 8, seed=2)
+    keys = [f"d{i}" for i in range(len(docs))]
+    bf = BruteForceKnnIndex(16, initial_capacity=1024)
+    ivf = IvfKnnIndex(16, initial_capacity=1024, n_clusters=8, n_probe=8)
+    bf.add_many(keys, list(docs))
+    ivf.add_many(keys, list(docs))
+    qs = list(docs[:20])
+    bf_res = bf.search_many(qs, [5] * 20)
+    ivf_res = ivf.search_many(qs, [5] * 20)
+    for b, v in zip(bf_res, ivf_res):
+        assert {k for k, _ in b} == {k for k, _ in v}  # n_probe == n_clusters
+
+
+def test_ivf_incremental_adds_and_removals():
+    _, docs = _clustered(600, 16, 8, seed=3)
+    keys = [f"d{i}" for i in range(len(docs))]
+    ivf = IvfKnnIndex(16, initial_capacity=1024, n_clusters=8, n_probe=8)
+    ivf.add_many(keys[:300], list(docs[:300]))
+    _ = ivf.search_many([docs[0]], [1])  # trains on the first half
+    ivf.add_many(keys[300:], list(docs[300:]))  # triggers retrain (size doubled)
+    res = ivf.search_many([docs[450]], [1])
+    assert res[0][0][0] == "d450"  # post-retrain rows are findable
+    ivf.remove("d450")
+    res = ivf.search_many([docs[450]], [1])
+    assert res[0][0][0] != "d450"
+
+
+def test_ivf_through_data_index():
+    """Factory + DataIndex + engine: the full as-of-now query path."""
+    from pathway_tpu.stdlib.indexing import IvfKnnFactory
+
+    @pw.udf
+    def embed(text: str) -> np.ndarray:
+        v = np.zeros(8, dtype=np.float32)
+        v[hash(text) % 8] = 1.0
+        v[len(text) % 8] += 0.5
+        return v
+
+    docs = T(
+        """
+        text
+        alpha
+        beta
+        gamma
+        delta
+        """
+    )
+    factory = IvfKnnFactory(dimensions=8, n_clusters=2, n_probe=2, embedder=embed)
+    index = factory.build_index(docs.text, docs)
+    queries = T(
+        """
+        q
+        alpha
+        """
+    )
+    res = index.query_as_of_now(queries.q, number_of_matches=1, collapse_rows=True)
+    rows = capture_rows(res)
+    assert len(rows) == 1
+    assert rows[0]["text"] == ("alpha",)  # exact self-match through the engine
