@@ -244,6 +244,11 @@ class StreamingDataSource(DataSource):
             now < getattr(self, "_next_commit_at", 0.0)
             and not self._finished.is_set()
             and self.events.qsize() < self._MAX_EVENTS_PER_COMMIT
+            # quiescence bypass: the FIRST event after an empty drain releases
+            # immediately even inside the window — a serving request must not
+            # pay the tick its own completion bookkeeping (e.g. the rest
+            # connector's delete-completed retraction commit) re-armed
+            and not (getattr(self, "_quiescent", False) and self.events.qsize() > 0)
         ):
             # inside the autocommit window: let events coalesce (the reference's
             # commit tick); eof and overfull queues release immediately
@@ -318,7 +323,11 @@ class StreamingDataSource(DataSource):
             if time_mod.monotonic() > deadline and rows:
                 break
         if not rows:
+            # reached the drain and found nothing: the source is quiescent, so
+            # the next arriving event bypasses the coalescing window
+            self._quiescent = True
             return Delta.empty(column_names)
+        self._quiescent = False
         # a released batch opens the next coalescing window: the FIRST event after
         # an idle period commits immediately (serving latency), sustained streams
         # batch at the autocommit tick (reference commit_duration semantics)

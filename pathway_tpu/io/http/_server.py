@@ -322,10 +322,12 @@ def rest_connector(
     route: str = "/",
     schema: sch.SchemaMetaclass | None = None,
     methods: Sequence[str] = ("POST",),
-    # serving path: a small commit tick keeps request latency at wake+commit while
-    # still coalescing request bursts (the engine releases the first event after an
-    # idle period immediately — see StreamingDataSource.next_batch)
-    autocommit_duration_ms: int | None = 5,
+    # serving path: a 1 ms commit tick makes per-request latency wake+commit.
+    # Bursts still batch naturally — while one commit processes, arriving
+    # requests queue and drain together in the next batch — so the tick only
+    # throttles tiny-commit storms, it is not the batching mechanism (see
+    # StreamingDataSource.next_batch).
+    autocommit_duration_ms: int | None = 1,
     keep_queries: bool | None = None,
     delete_completed_queries: bool = False,
     request_validator: Any = None,

@@ -167,7 +167,11 @@ class DenseKNNStore(SlotIngestMixin):
             [self._norms, jnp.zeros((extra,), dtype=jnp.float32)]
         )
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
-        self.capacity = new_capacity
+        old_capacity, self.capacity = self.capacity, new_capacity
+        self._after_grow(old_capacity, extra)
+
+    def _after_grow(self, old_capacity: int, extra: int) -> None:
+        """Subclass hook: capacity geometry just changed."""
 
     def _flush(self) -> None:
         # staged batches pad to power-of-two buckets so the scatter kernels compile
@@ -183,6 +187,7 @@ class DenseKNNStore(SlotIngestMixin):
             self._norms = self._norms.at[slots].set(jnp.sum(vecs * vecs, axis=1))
             self._valid = self._valid.at[slots].set(True)
             self._staged_slots, self._staged_vecs = [], []
+            self._after_flush_adds(slots_np, vecs)
         if self._staged_invalid:
             inv = sorted(set(self._staged_invalid))
             flags_np = np.array([s in self.key_of for s in inv], dtype=bool)
@@ -190,6 +195,14 @@ class DenseKNNStore(SlotIngestMixin):
             slots_np, _, flags_np = pad_pow2(slots_np, extras=flags_np)
             self._valid = self._valid.at[jnp.asarray(slots_np)].set(jnp.asarray(flags_np))
             self._staged_invalid = []
+            self._after_flush_removals()
+
+    def _after_flush_adds(self, padded_slots: np.ndarray, vecs: jax.Array) -> None:
+        """Subclass hook: a staged add batch just scattered into the device
+        arrays (IVF assigns the new rows to centroids here)."""
+
+    def _after_flush_removals(self) -> None:
+        """Subclass hook: staged invalidations just applied."""
 
     def search_batch(self, queries: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Returns (scores (q,k), slots (q,k), valid_mask (q,k)); slots map via key_of."""
@@ -233,11 +246,16 @@ class BruteForceKnnIndex:
         metric: str = "l2sq",
         initial_capacity: int = 1024,
         mesh: Any = None,
+        _store: Any = None,
     ):
-        if mesh is not None:
+        if _store is not None:
+            # subclass-provided store (IvfKnnIndex): every other attribute
+            # initializes here so subclasses never copy this tail
+            self.store: Any = _store
+        elif mesh is not None:
             from pathway_tpu.parallel.knn_sharded import ShardedKNNStore
 
-            self.store: Any = ShardedKNNStore(
+            self.store = ShardedKNNStore(
                 mesh, dim, metric=metric, initial_capacity=initial_capacity
             )
         else:
@@ -433,11 +451,15 @@ class IvfKnnIndex(BruteForceKnnIndex):
     ):
         from pathway_tpu.ops.knn_ivf import IvfKnnStore
 
-        self.store = IvfKnnStore(
+        super().__init__(
             dim,
             metric=metric,
             initial_capacity=initial_capacity,
-            n_clusters=n_clusters,
-            n_probe=n_probe,
+            _store=IvfKnnStore(
+                dim,
+                metric=metric,
+                initial_capacity=initial_capacity,
+                n_clusters=n_clusters,
+                n_probe=n_probe,
+            ),
         )
-        self.filter_data = {}

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from pathway_tpu.ops.knn import SlotIngestMixin, pad_pow2, pow2_target
+from pathway_tpu.ops.knn import DenseKNNStore, pad_pow2
 
 
 @functools.partial(jax.jit, static_argnames=("n_iters",))
@@ -99,8 +99,10 @@ def _ivf_search_kernel(
     return top_scores, top_slots
 
 
-class IvfKnnStore(SlotIngestMixin):
-    """Keyed IVF-Flat store with the same surface as ``DenseKNNStore``."""
+class IvfKnnStore(DenseKNNStore):
+    """Keyed IVF-Flat store: ``DenseKNNStore``'s storage management (staged
+    scatters, capacity doubling, slot recycling) plus centroid assignments and
+    device-resident inverted lists maintained through the flush/grow hooks."""
 
     def __init__(
         self,
@@ -111,75 +113,38 @@ class IvfKnnStore(SlotIngestMixin):
         n_probe: int = 8,
         train_iters: int = 8,
     ):
-        assert metric in ("l2sq", "cos", "ip")
-        self.dim = dim
-        self.metric = metric
+        super().__init__(
+            dim, metric=metric, initial_capacity=initial_capacity
+        )
         self.n_clusters = n_clusters
         self.n_probe = min(n_probe, n_clusters)
         self.train_iters = train_iters
-        self.capacity = initial_capacity
-        self._data = jnp.zeros((self.capacity, dim), dtype=jnp.float32)
-        self._valid = jnp.zeros((self.capacity,), dtype=bool)
-        self._norms = jnp.zeros((self.capacity,), dtype=jnp.float32)
-        self.slot_of: Dict[Any, int] = {}
-        self.key_of: Dict[int, Any] = {}
-        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
-        self._staged_vecs: List[np.ndarray] = []
-        self._staged_slots: List[int] = []
-        self._staged_invalid: List[int] = []
         self._centroids: jax.Array | None = None
         self._assign = np.full(self.capacity, -1, dtype=np.int32)  # host mirror
         self._buckets: jax.Array | None = None
         self._trained_at = 0  # corpus size at last (re)train
 
-    def __len__(self) -> int:
-        return len(self.slot_of)
+    # -- DenseKNNStore hooks -------------------------------------------------
 
-    def _grow(self, target: int | None = None) -> None:
-        new_capacity = pow2_target(self.capacity, target)
-        self._flush_data()
-        extra = new_capacity - self.capacity
-        self._data = jnp.concatenate(
-            [self._data, jnp.zeros((extra, self.dim), dtype=jnp.float32)]
-        )
-        self._valid = jnp.concatenate([self._valid, jnp.zeros((extra,), dtype=bool)])
-        self._norms = jnp.concatenate(
-            [self._norms, jnp.zeros((extra,), dtype=jnp.float32)]
-        )
+    def _after_grow(self, old_capacity: int, extra: int) -> None:
         self._assign = np.concatenate(
             [self._assign, np.full(extra, -1, dtype=np.int32)]
         )
-        self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
-        self.capacity = new_capacity
         self._buckets = None  # geometry changed; rebuild lazily
 
-    def _flush_data(self) -> None:
-        if self._staged_slots:
-            slots_np = np.array(self._staged_slots, dtype=np.int32)
-            vecs_np = np.stack(self._staged_vecs).astype(np.float32)
-            p_slots, p_vecs, _ = pad_pow2(slots_np, vecs_np)
-            slots_j = jnp.asarray(p_slots)
-            vecs_j = jnp.asarray(p_vecs)
-            self._data = self._data.at[slots_j].set(vecs_j)
-            self._norms = self._norms.at[slots_j].set(jnp.sum(vecs_j * vecs_j, axis=1))
-            self._valid = self._valid.at[slots_j].set(True)
-            # assign the new rows to centroids (one small device pass) unless a
-            # retrain below will re-assign everything anyway
-            if self._centroids is not None:
-                cn = jnp.sum(self._centroids * self._centroids, axis=1)
-                sim = 2.0 * vecs_j @ self._centroids.T - cn[None, :]
-                new_assign = np.asarray(jnp.argmax(sim, axis=1), dtype=np.int32)
-                self._assign[p_slots] = new_assign
-            self._staged_slots, self._staged_vecs = [], []
-            self._buckets = None
-        if self._staged_invalid:
-            inv = sorted(set(self._staged_invalid))
-            flags_np = np.array([s in self.key_of for s in inv], dtype=bool)
-            slots_np = np.array(inv, dtype=np.int32)
-            p_slots, _, p_flags = pad_pow2(slots_np, extras=flags_np)
-            self._valid = self._valid.at[jnp.asarray(p_slots)].set(jnp.asarray(p_flags))
-            self._staged_invalid = []
-            self._buckets = None
+    def _after_flush_adds(self, padded_slots: np.ndarray, vecs: jax.Array) -> None:
+        # assign the new rows to centroids (one small device pass) unless a
+        # retrain will re-assign everything anyway
+        if self._centroids is not None:
+            cn = jnp.sum(self._centroids * self._centroids, axis=1)
+            sim = 2.0 * vecs @ self._centroids.T - cn[None, :]
+            self._assign[padded_slots] = np.asarray(
+                jnp.argmax(sim, axis=1), dtype=np.int32
+            )
+        self._buckets = None
+
+    def _after_flush_removals(self) -> None:
+        self._buckets = None
 
     # training runs on a SAMPLE (faiss-style): k-means cost and its (n, C)
     # intermediates stay bounded however large the corpus grows
@@ -247,7 +212,7 @@ class IvfKnnStore(SlotIngestMixin):
         self._buckets = jnp.asarray(buckets)
 
     def search_batch(self, queries: Any, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        self._flush_data()
+        self._flush()
         self._maybe_train()
         if self._centroids is None:
             n = int(np.asarray(queries).shape[0]) if not isinstance(queries, jax.Array) else queries.shape[0]

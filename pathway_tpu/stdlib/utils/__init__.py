@@ -1,3 +1,3 @@
 """Utility stdlib (parity: reference ``stdlib/utils``)."""
 
-from pathway_tpu.stdlib.utils import col
+from pathway_tpu.stdlib.utils import bucketing, col, filtering

@@ -99,3 +99,44 @@ def groupby_reduce_majority(column: expr.ColumnReference, value_column: expr.Col
     return winners.select(
         winners[column.name], majority=winners[value_column.name]
     )
+
+
+def flatten_column(
+    column: expr.ColumnReference,
+    origin_id: "str | None" = "origin_id",
+) -> Table:
+    """Deprecated alias for ``Table.flatten`` (reference ``utils/col.py:16``)."""
+    import warnings
+
+    warnings.warn(
+        "pw.stdlib.utils.col.flatten_column() is deprecated, use "
+        "pw.Table.flatten() instead",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return column.table.flatten(column, origin_id=origin_id)
+
+
+def unpack_col_dict(column: expr.ColumnReference, schema: Any) -> Table:
+    """Json-object column -> typed columns per ``schema`` (reference
+    ``utils/col.py:143``); absent fields become None (optional dtypes)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals.json import Json
+
+    table = column.table
+    cols = {}
+    for name, cs in schema.columns().items():
+        target = cs.dtype
+
+        def getter(cell: Any, _n: str = name, _t: Any = target) -> Any:
+            obj = cell.value if isinstance(cell, Json) else cell
+            v = (obj or {}).get(_n)
+            if v is None:
+                return None
+            if _t.strip_optional() == dt.JSON:
+                return Json(v)
+            return v
+
+        cols[name] = pw.apply_with_type(getter, target, column)
+    return table.select(**cols)

@@ -254,3 +254,54 @@ def test_louvain_isolated_vertex():
     df = dbg.table_to_pandas(res, include_id=False)
     groups = sorted(df.groupby("c")["v"].apply(lambda s: tuple(sorted(s))).tolist())
     assert groups == [(0, 1), (2,)]
+
+
+def test_unpack_col_dict_typed_fields():
+    import pathway_tpu as pw
+    from pathway_tpu.internals.json import Json
+    from pathway_tpu.stdlib.utils.col import unpack_col_dict
+
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"data": Json}),
+        [
+            (Json({"field_a": 13, "field_b": "foo", "field_c": False}),),
+            (Json({"field_a": 17, "field_c": True, "field_d": 3.4}),),
+        ],
+    )
+
+    class DataSchema(pw.Schema):
+        field_a: int
+        field_b: str | None
+        field_c: bool
+        field_d: float | None
+
+    out = unpack_col_dict(t.data, schema=DataSchema)
+    df = pw.debug.table_to_pandas(out)
+    rows = sorted(
+        zip(df["field_a"], df["field_b"], df["field_c"], df["field_d"]),
+        key=lambda r: r[0],
+    )
+    assert rows[0][0] == 13 and rows[0][1] == "foo" and rows[0][2] == False  # noqa: E712
+    missing_b = rows[1][1]
+    assert rows[1][0] == 17 and (missing_b is None or missing_b != missing_b)
+    assert abs(rows[1][3] - 3.4) < 1e-9
+
+
+def test_flatten_column_and_bucketing():
+    import datetime
+    import warnings
+
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.utils.bucketing import truncate_to_minutes
+    from pathway_tpu.stdlib.utils.col import flatten_column
+
+    t = pw.debug.table_from_rows(pw.schema_builder({"pet": str}), [("Dog",), ("Cat",)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        flat = flatten_column(t.pet)
+    df = pw.debug.table_to_pandas(flat)
+    assert sorted(df["pet"]) == sorted("DogCat")
+    assert "origin_id" in df.columns
+
+    ts = datetime.datetime(2026, 7, 30, 12, 34, 56, 789000)
+    assert truncate_to_minutes(ts) == datetime.datetime(2026, 7, 30, 12, 34)
