@@ -244,14 +244,11 @@ class StreamingDataSource(DataSource):
             now < getattr(self, "_next_commit_at", 0.0)
             and not self._finished.is_set()
             and self.events.qsize() < self._MAX_EVENTS_PER_COMMIT
-            # quiescence bypass: the FIRST event after an empty drain releases
-            # immediately even inside the window — a serving request must not
-            # pay the tick its own completion bookkeeping (e.g. the rest
-            # connector's delete-completed retraction commit) re-armed
-            and not (getattr(self, "_quiescent", False) and self.events.qsize() > 0)
         ):
             # inside the autocommit window: let events coalesce (the reference's
-            # commit tick); eof and overfull queues release immediately
+            # commit tick); eof and overfull queues release immediately. Serving
+            # latency is bounded by the tick — the rest connector runs a 1 ms
+            # tick so per-request overhead is wake + <=1 ms.
             return Delta.empty(column_names)
         deadline = now + (self._autocommit_ms or 10) / 1000.0
         while len(rows) < self._MAX_EVENTS_PER_COMMIT:
@@ -323,13 +320,8 @@ class StreamingDataSource(DataSource):
             if time_mod.monotonic() > deadline and rows:
                 break
         if not rows:
-            # reached the drain and found nothing: the source is quiescent, so
-            # the next arriving event bypasses the coalescing window
-            self._quiescent = True
             return Delta.empty(column_names)
-        self._quiescent = False
-        # a released batch opens the next coalescing window: the FIRST event after
-        # an idle period commits immediately (serving latency), sustained streams
+        # a released batch opens the next coalescing window: sustained streams
         # batch at the autocommit tick (reference commit_duration semantics)
         self._next_commit_at = time_mod.monotonic() + (self._autocommit_ms or 10) / 1000.0
         n = len(rows)
