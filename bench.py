@@ -119,10 +119,17 @@ def bench_knn() -> dict:
         + rng.normal(size=(N_QUERIES, DIM)).astype(np.float32)
     ).astype(np.float32)
     ivf.search_batch(cqueries, K)  # train + compile off the clock
+    creps = [
+        (
+            centers[rng.integers(0, n_centers, N_QUERIES)]
+            + rng.normal(size=(N_QUERIES, DIM)).astype(np.float32)
+        ).astype(np.float32)
+        for _ in range(4)
+    ]
     ivf_lat = []
-    for _ in range(5):
+    for q in [cqueries] + creps:  # distinct batches, same protocol as dense KNN
         t1 = time.perf_counter()
-        ivf.search_batch(cqueries, K)
+        ivf.search_batch(q, K)
         ivf_lat.append(time.perf_counter() - t1)
     ivf_med = float(np.median(ivf_lat))
     cnorms = np.sum(cdata * cdata, axis=1)
@@ -204,11 +211,11 @@ def bench_vector_store(port: int = 18715) -> dict:
     doc_table = pw.debug.table_from_rows(
         pw.schema_builder({"data": str, "_metadata": str}), docs
     )
-    embedder = SentenceTransformerEmbedder(batch_size=1024)
+    embedder = SentenceTransformerEmbedder(batch_size=64 if SMOKE else 1024)
     # compile the production batch shape off the clock (the engine reuses one
     # compiled shape for every ingest batch; cold-start XLA compilation is a
     # per-process constant, not a per-document cost)
-    embedder.encoder.encode(["warm up"] * 1024)
+    embedder.encoder.encode(["warm up"] * (64 if SMOKE else 1024))
     server = VectorStoreServer(doc_table, embedder=embedder)
     t_start = time.perf_counter()
     server.run_server(host="127.0.0.1", port=port, threaded=True, terminate_on_error=False)
@@ -562,10 +569,44 @@ def bench_sharded() -> dict:
         return {"sharded_error": f"{type(exc).__name__}: {exc}"[:200]}
 
 
+def _ensure_reachable_backend() -> str | None:
+    """Probe TPU init in a SUBPROCESS with a timeout: a wedged device tunnel
+    (e.g. a dead client holding the single-tenant claim) hangs backend init
+    forever, which must degrade the bench to CPU — with an honest marker in the
+    output — rather than hang the round's measurement entirely."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return None
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=180,
+            capture_output=True,
+        )
+        if probe.returncode == 0:
+            return None
+    except subprocess.TimeoutExpired:
+        pass
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    return "tpu unreachable (backend init hung/failed); CPU fallback — numbers NOT comparable"
+
+
 def main() -> None:
+    fallback = _ensure_reachable_backend()
     import jax
 
     results: dict = {}
+    if fallback:
+        results["device_fallback"] = fallback
     # vectorstore runs late: its threaded server keeps living after the bench, which
     # must not skew the timed engine/window sub-benches (sharded runs in a subprocess)
     for name, fn in (
