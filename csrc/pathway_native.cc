@@ -728,6 +728,29 @@ void pwtpu_idx_remove(void* h, const uint64_t* keys, int64_t n,
   }
 }
 
+// Fused per-row fingerprint + KeyIndex upsert (the groupby hot pair): hashing
+// and slot assignment in one crossing, no intermediate interleaved key buffer —
+// the hash lands in out_hi/out_lo and upserts straight from there. Returns -1
+// on success, else the first unsupported row; on that failure the index is
+// UNTOUCHED (hashing runs to completion before any upsert).
+int64_t pwtpu_hash_upsert(const PwCol* cols, int32_t ncols, uint64_t n,
+                          const uint8_t* salt, uint64_t salt_len, PyObject* np_bool,
+                          PyObject* np_integer, void* idx_handle, uint64_t* out_hi,
+                          uint64_t* out_lo, int64_t* out_slots, uint8_t* out_is_new) {
+  int64_t status = pwtpu_hash_typed(cols, ncols, n, salt, salt_len, np_bool,
+                                    np_integer, out_hi, out_lo);
+  if (status != -1) return status;
+  KeyIndex* idx = static_cast<KeyIndex*>(idx_handle);
+  idx->reserve_for(n);
+  for (uint64_t i = 0; i < n; ++i) {
+    if (i + 8 < n) __builtin_prefetch(&idx->state[out_lo[i + 8] & idx->mask]);
+    uint8_t is_new = 0;
+    out_slots[i] = idx->upsert(out_hi[i], out_lo[i], &is_new);
+    out_is_new[i] = is_new;
+  }
+  return -1;
+}
+
 // Checkpoint-restore path: insert keys with EXPLICIT slot assignments (slot ids
 // index the caller's column arrays and must survive a pickle round-trip exactly),
 // then rebuild the free list from the gaps below next_slot.

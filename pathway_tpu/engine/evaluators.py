@@ -25,6 +25,7 @@ from pathway_tpu.internals.keys import (
     broadcast_key,
     key_bytes,
     combine_keys,
+    hash_upsert,
     keys_from_values,
     keys_to_pointers,
     pointer_from,
@@ -305,6 +306,16 @@ def _col_neq(old: np.ndarray, new: np.ndarray) -> np.ndarray:
     return np.frompyfunc(cell_neq, 2, 1)(old, new).astype(bool)
 
 
+def _group_stable(e: expr.ColumnExpression) -> bool:
+    """True when the expression is a deterministic function of grouping values
+    only — no reducer leaves, no non-deterministic applies anywhere in the tree."""
+    if isinstance(e, expr.ReducerExpression):
+        return False
+    if isinstance(e, expr.ApplyExpression) and not e._deterministic:
+        return False
+    return all(_group_stable(d) for d in e._deps())
+
+
 class GroupbyEvaluator(Evaluator):
     """Incremental groupby-reduce (reference ``reduce.rs`` + DD reduce), fully columnar.
 
@@ -338,6 +349,15 @@ class GroupbyEvaluator(Evaluator):
         self._collect_reducers(node.config["out_exprs"])
         self.leaf_states = [leaf._reducer.make_state() for leaf in self.reducer_leaves]
         self.seq = 0
+        # output columns that are pure functions of the grouping values (no
+        # reducer, no non-deterministic apply) CANNOT change while a group is
+        # alive — change detection skips comparing them (group keys fingerprint
+        # the grouping values, so equal key implies equal value)
+        self._stable_cols = {
+            name
+            for name, e in node.config["out_exprs"].items()
+            if _group_stable(e)
+        }
 
     def load_state_dict(self, state: Dict[str, bytes]) -> None:
         super().load_state_dict(state)
@@ -466,8 +486,12 @@ class GroupbyEvaluator(Evaluator):
             leaf_args.append(arrays)
         self.seq += n
 
-        gkeys = self._group_keys(grouping_vals, n, set_id)
-        slots, is_new = self.gindex.upsert(gkeys)
+        if grouping_vals and not set_id:
+            # fused native fingerprint + upsert: one crossing for the hot pair
+            gkeys, slots, is_new = hash_upsert(self.gindex, grouping_vals)
+        else:
+            gkeys = self._group_keys(grouping_vals, n, set_id)
+            slots, is_new = self.gindex.upsert(gkeys)
         self._ensure_capacity()
         new_slots = slots[is_new]
         if len(new_slots):
@@ -522,6 +546,8 @@ class GroupbyEvaluator(Evaluator):
             idx = np.nonzero(had_row_alive)[0]
             neq = np.zeros(len(idx), dtype=bool)
             for name in self.output_columns:
+                if name in self._stable_cols:
+                    continue  # pure grouping function: equal by construction
                 old = self.last_cols[name][alive_slots[idx]]
                 neq |= _col_neq(old, new_cols[name][idx])
             changed[idx] |= neq

@@ -154,16 +154,15 @@ def _classify_column(col: np.ndarray):
     return None
 
 
-def _native_keys(
+def _marshal_cols(
     columns: Sequence[np.ndarray],
-    n: int,
-    masks: Sequence[np.ndarray | None] | None = None,
-) -> np.ndarray | None:
+    masks: Sequence[np.ndarray | None] | None,
+) -> "tuple[Any, list] | None":
+    """(PwCol array, keepalive list) for the native hashers; None when any
+    column's dtype has no native kind. ONE home for the marshalling so the
+    plain and fused hash paths can never diverge."""
     from pathway_tpu import native as _native
 
-    lib = _native.get_lib()
-    if lib is None:
-        return None
     descs = []
     for col in columns:
         desc = _classify_column(np.asarray(col))
@@ -172,7 +171,7 @@ def _native_keys(
         descs.append(desc)
     import ctypes
 
-    mask_arrays = []  # keep alive over the call
+    keepalive: list = [data for _kind, data in descs]
     cols = (_native.PwCol * len(descs))()
     for i, (kind, data) in enumerate(descs):
         cols[i].kind = kind
@@ -183,14 +182,33 @@ def _native_keys(
             cols[i].mask = None
         else:
             m = np.ascontiguousarray(mask, dtype=np.uint8)
-            mask_arrays.append(m)
+            keepalive.append(m)
             cols[i].mask = m.ctypes.data_as(ctypes.c_void_p)
+    return cols, keepalive
+
+
+def _native_keys(
+    columns: Sequence[np.ndarray],
+    n: int,
+    masks: Sequence[np.ndarray | None] | None = None,
+) -> np.ndarray | None:
+    from pathway_tpu import native as _native
+
+    lib = _native.get_lib()
+    if lib is None:
+        return None
+    marshalled = _marshal_cols(columns, masks)
+    if marshalled is None:
+        return None
+    cols, _keepalive = marshalled
+    import ctypes
+
     hi = np.empty(n, dtype=np.uint64)
     lo = np.empty(n, dtype=np.uint64)
     u64p = ctypes.POINTER(ctypes.c_uint64)
     status = lib.pwtpu_hash_typed(
         ctypes.cast(cols, ctypes.c_void_p),
-        len(descs),
+        len(columns),
         n,
         _SALT,
         len(_SALT),
@@ -203,6 +221,24 @@ def _native_keys(
         return None  # unsupported value encountered: Python path handles the batch
     out = np.empty(n, dtype=KEY_DTYPE)
     out["hi"], out["lo"] = hi, lo
+    return out
+
+
+def _python_keys(
+    columns: Sequence[np.ndarray],
+    n: int,
+    masks: Sequence[np.ndarray | None] | None = None,
+) -> np.ndarray:
+    """Reference Python serializer path (the native hashers are byte-identical)."""
+    out = np.empty(n, dtype=KEY_DTYPE)
+    for i in range(n):
+        chunks: list[bytes] = [_SALT]
+        for j, col in enumerate(columns):
+            if masks is not None and masks[j] is not None and not masks[j][i]:
+                chunks.append(b"\x00")
+            else:
+                _serialize_value(col[i], chunks)
+        out["hi"][i], out["lo"][i] = _fingerprint_bytes(b"".join(chunks))
     return out
 
 
@@ -222,16 +258,62 @@ def keys_from_values(
         native_out = _native_keys(columns, n, masks)
         if native_out is not None:
             return native_out
-    out = np.empty(n, dtype=KEY_DTYPE)
-    for i in range(n):
-        chunks: list[bytes] = [_SALT]
-        for j, col in enumerate(columns):
-            if masks is not None and masks[j] is not None and not masks[j][i]:
-                chunks.append(b"\x00")
-            else:
-                _serialize_value(col[i], chunks)
-        out["hi"][i], out["lo"][i] = _fingerprint_bytes(b"".join(chunks))
-    return out
+    return _python_keys(columns, n, masks)
+
+
+def hash_upsert(
+    index: Any,
+    columns: Sequence[np.ndarray],
+    masks: Sequence[np.ndarray | None] | None = None,
+) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+    """Fused ``keys_from_values`` + ``KeyIndex.upsert`` (the groupby hot pair):
+    one native pass, one Python↔C crossing. Returns (keys, slots, is_new);
+    falls back for unsupported cell types or when either the native lib or a
+    native index is unavailable — and a native-hash failure goes STRAIGHT to the
+    Python serializer (the native attempt is already known to fail; no retry)."""
+    from pathway_tpu import native as _native
+    from pathway_tpu.engine.index import _NativeKeyIndex
+
+    lib = _native.get_lib()
+    n = len(columns[0]) if columns else 0
+    fused = getattr(lib, "pwtpu_hash_upsert", None) if lib is not None else None
+    if fused is not None and isinstance(index, _NativeKeyIndex) and n >= 64:
+        marshalled = _marshal_cols(columns, masks)
+        if marshalled is not None:
+            import ctypes
+
+            cols, _keepalive = marshalled
+            hi = np.empty(n, dtype=np.uint64)
+            lo = np.empty(n, dtype=np.uint64)
+            slots = np.empty(n, dtype=np.int64)
+            is_new = np.empty(n, dtype=np.uint8)
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            status = fused(
+                ctypes.cast(cols, ctypes.c_void_p),
+                len(columns),
+                n,
+                _SALT,
+                len(_SALT),
+                np.bool_,
+                np.integer,
+                index._h,
+                hi.ctypes.data_as(u64p),
+                lo.ctypes.data_as(u64p),
+                slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                is_new.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+            if status == -1:
+                keys = np.empty(n, dtype=KEY_DTYPE)
+                keys["hi"], keys["lo"] = hi, lo
+                return keys, slots, is_new.astype(bool)
+            # unsupported value mid-batch: the index is untouched (the native
+            # function hashes fully before any upsert); don't re-try native
+            keys = _python_keys(columns, n, masks)
+            slots, is_new_b = index.upsert(keys)
+            return keys, slots, is_new_b
+    keys = keys_from_values(columns, masks)
+    slots, is_new = index.upsert(keys)
+    return keys, slots, is_new
 
 
 def combine_keys(

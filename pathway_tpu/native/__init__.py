@@ -97,6 +97,24 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib = ctypes.PyDLL(path)
     except OSError:
         return None
+    if not hasattr(lib, "pwtpu_hash_upsert"):
+        # stale prebuilt .so from older source (mtime comparisons can lie across
+        # archive extraction / layer caching): force one rebuild; if the symbol
+        # is still absent, disable the native path instead of crashing later on
+        # a missing attribute
+        try:
+            os.unlink(_SO)
+        except OSError:
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.PyDLL(path)
+        except OSError:
+            return None
+        if not hasattr(lib, "pwtpu_hash_upsert"):
+            return None
 
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.pwtpu_hash_typed.argtypes = [
@@ -111,6 +129,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
         u64p,
     ]
     lib.pwtpu_hash_typed.restype = ctypes.c_int64
+    lib.pwtpu_hash_upsert.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_uint64,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.py_object,
+        ctypes.py_object,
+        ctypes.c_void_p,
+        u64p,
+        u64p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.pwtpu_hash_upsert.restype = ctypes.c_int64
     lib.pwtpu_hash_serialized.argtypes = [
         ctypes.c_char_p,
         u64p,

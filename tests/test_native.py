@@ -178,3 +178,62 @@ def test_multibyte_delimiter_falls_back(tmp_path):
     schema = pw.schema_from_types(a=int, b=int)
     rows = fs._parse_file(str(path), "csv", schema, False, csv_settings=Settings())
     assert rows == [{"a": 1, "b": 2}]
+
+
+def test_hash_upsert_fused_matches_two_step():
+    """The fused native fingerprint+upsert must produce byte-identical keys and
+    identical slot assignments to the two-step path."""
+    import numpy as np
+
+    from pathway_tpu.engine.index import KeyIndex
+    from pathway_tpu.internals.keys import hash_upsert, keys_from_values
+
+    rng = np.random.default_rng(0)
+    words = np.array([f"w{i % 500}" for i in range(5000)], dtype=object)
+    nums = rng.integers(0, 100, 5000).astype(np.int64)
+
+    idx_a, idx_b = KeyIndex(), KeyIndex()
+    keys_f, slots_f, new_f = hash_upsert(idx_a, [words, nums])
+    keys_t = keys_from_values([words, nums])
+    slots_t, new_t = idx_b.upsert(keys_t)
+    assert keys_f.tobytes() == keys_t.tobytes()
+    assert (slots_f == slots_t).all()
+    assert (new_f == new_t).all()
+    # second batch reuses existing slots identically
+    keys_f2, slots_f2, new_f2 = hash_upsert(idx_a, [words, nums])
+    assert not new_f2.any()
+    assert (slots_f2 == slots_f).all()
+
+
+def test_hash_upsert_unsupported_value_leaves_index_untouched():
+    """A native-unsupported cell mid-batch must fall back to the Python
+    serializer WITHOUT having partially upserted (the native function hashes
+    fully before any index mutation)."""
+    import numpy as np
+
+    from pathway_tpu.engine.index import KeyIndex
+    from pathway_tpu.internals.keys import hash_upsert, keys_from_values
+
+    col = np.empty(200, dtype=object)
+    col[:] = [f"t{i}" for i in range(200)]
+    col[150] = ("tuple", "cell")  # not natively serializable
+
+    idx = KeyIndex()
+    keys, slots, is_new = hash_upsert(idx, [col])
+    assert keys.tobytes() == keys_from_values([col]).tobytes()
+    assert len(idx) == 200 and is_new.all()
+    assert sorted(slots.tolist()) == list(range(200))
+
+
+def test_hash_upsert_small_batch_and_python_index_fallbacks():
+    import numpy as np
+
+    from pathway_tpu.engine.index import _PyKeyIndex
+    from pathway_tpu.internals.keys import hash_upsert, keys_from_values
+
+    col = np.array(["a", "b", "a"], dtype=object)
+    idx = _PyKeyIndex()
+    keys, slots, is_new = hash_upsert(idx, [col])
+    assert keys.tobytes() == keys_from_values([col]).tobytes()
+    assert slots[0] == slots[2] and slots[0] != slots[1]
+    assert is_new.tolist() == [True, True, False]
