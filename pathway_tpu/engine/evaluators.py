@@ -221,13 +221,111 @@ class InputEvaluator(Evaluator):
 
 
 class RowwiseEvaluator(Evaluator):
+    """select/with_columns. Cross-table column references are LIVE dependencies
+    (reference: a read of another same-universe table is a dataflow edge — DD
+    re-derives downstream rows when the referenced arrangement changes): when a
+    referenced table emits a delta this commit, the affected rows of THIS table
+    re-evaluate and re-emit even though the primary input saw no delta."""
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        own = node.inputs[0]
+        cross: Dict[int, Any] = {}
+        for e in node.config["exprs"].values():
+            for ref in e._column_refs:
+                if ref.table is not own:
+                    cross[ref.table._node.id] = ref.table._node
+        self._cross_nodes = list(cross.values())
+
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
-        if len(delta) == 0:
-            return Delta.empty(self.output_columns)
         table = self.node.inputs[0]
-        columns = self._eval_exprs(self.node.config["exprs"], table, delta)
-        return Delta(delta.keys, delta.diffs, columns)
+        parts: List[Delta] = []
+        if len(delta):
+            columns = self._eval_exprs(self.node.config["exprs"], table, delta)
+            parts.append(Delta(delta.keys, delta.diffs, columns))
+        if self._cross_nodes:
+            refreshed = self._cross_refresh(delta)
+            if refreshed is not None:
+                parts.append(refreshed)
+        if not parts:
+            return Delta.empty(self.output_columns)
+        if len(parts) == 1:
+            return parts[0]
+        return Delta.concat(parts, self.output_columns)
+
+    def _cross_refresh(self, own_delta: Delta) -> Delta | None:
+        """Retract+reinsert rows whose cross-referenced values changed this
+        commit (keys from the referenced tables' deltas, restricted to this
+        table's universe, minus rows the primary delta already covers)."""
+        runner = self.runner
+        key_parts = []
+        for ref_node in self._cross_nodes:
+            d = runner.current_delta_of(ref_node)
+            if d is not None and len(d):
+                key_parts.append(d.keys)
+        if not key_parts:
+            return None
+        seen: set = set()
+        own_keys = set(key_bytes(own_delta.keys)) if len(own_delta) else set()
+        kept: List[np.void] = []
+        for arr in key_parts:
+            for i, kb in enumerate(key_bytes(arr)):
+                if kb in seen or kb in own_keys:
+                    continue
+                seen.add(kb)
+                kept.append(arr[i])
+        if not kept:
+            return None
+        keys = np.array(kept, dtype=KEY_DTYPE)
+        in_state = runner.state_of(self.node.inputs[0]._node)
+        slots = in_state.lookup(keys)
+        present = slots >= 0
+        if not present.any():
+            return None
+        keys = keys[present]
+        slots = slots[present]
+        in_cols = self.node.inputs[0].column_names()
+        synth = Delta(
+            keys,
+            np.ones(len(keys), dtype=np.int64),
+            {c: in_state.gather(c, slots) for c in in_cols},
+        )
+        new_cols = self._eval_exprs(self.node.config["exprs"], self.node.inputs[0], synth)
+        out_state = runner.state_of(self.node)
+        oslots = out_state.lookup(keys)
+        had = oslots >= 0
+        # suppress no-op rows: only emit where some output value actually moved
+        changed = ~had  # rows never emitted always emit
+        if had.any():
+            idx = np.nonzero(had)[0]
+            neq = np.zeros(len(idx), dtype=bool)
+            for name in self.output_columns:
+                old = out_state.gather(name, oslots[idx])
+                neq |= _col_neq(old, new_cols[name][idx])
+            changed[idx] |= neq
+        if not changed.any():
+            return None
+        ch = np.nonzero(changed)[0]
+        # batch-gather old values once per column, then assemble rows
+        ret_idx = ch[had[ch]]
+        old_cols = {
+            c: out_state.gather(c, oslots[ret_idx]) for c in self.output_columns
+        }
+        old_pos = {int(i): p for p, i in enumerate(ret_idx.tolist())}
+        out_keys: List[np.void] = []
+        out_diffs: List[int] = []
+        rows: List[dict] = []
+        for i in ch.tolist():
+            if had[i]:
+                p = old_pos[i]
+                rows.append({c: old_cols[c][p] for c in self.output_columns})
+                out_keys.append(keys[i])
+                out_diffs.append(-1)
+            rows.append({c: new_cols[c][i] for c in self.output_columns})
+            out_keys.append(keys[i])
+            out_diffs.append(1)
+        return _delta_from_rows(out_keys, out_diffs, rows, self.output_columns)
 
 
 class FilterEvaluator(Evaluator):

@@ -82,11 +82,40 @@ class GraphRunner:
                 for v in value:
                     walk_value(v, input_tables)
 
+        def has_cross_ref(node: pg.Node) -> bool:
+            found = [False]
+
+            def walk(value: Any) -> None:
+                if found[0]:
+                    return
+                if isinstance(value, ColumnExpression):
+                    for ref in value._column_refs:
+                        if all(ref.table is not t for t in node.inputs):
+                            found[0] = True
+                            return
+                elif isinstance(value, dict):
+                    for v in value.values():
+                        walk(v)
+                elif isinstance(value, (list, tuple)):
+                    for v in value:
+                        walk(v)
+
+            walk(node.config)
+            return found[0]
+
         for node in self._nodes:
             if isinstance(node, (pg.IterateNode, pg.IterateResultNode)):
                 return all_ids
             input_tables = list(node.inputs)
             walk_value(node.config, input_tables)
+            if isinstance(node, pg.RowwiseNode) and has_cross_ref(node):
+                # cross-table refs make this a LIVE dependency: the evaluator
+                # re-derives affected rows from its input's state and suppresses
+                # no-ops against its own output state — both must materialize
+                # (checked per node: the referenced table may already be in
+                # `needed` from another consumer)
+                needed.add(node.inputs[0]._node.id)
+                needed.add(node.id)
             if isinstance(node, pg.IxNode) and len(node.inputs) > 1:
                 needed.add(node.inputs[1]._node.id)
         return needed & all_ids
@@ -446,11 +475,18 @@ class GraphRunner:
                     for inp in node.inputs
                 ]
                 originates = neu and getattr(evaluator, "neu_pending", _no_pending)()
+                cross_nodes = getattr(evaluator, "_cross_nodes", None)
                 if (
                     all(len(d) == 0 for d in inputs)
                     and not originates
                     and not (not neu and _has_pending(evaluator))
                     and node.kind != "iterate_result"
+                    # a rowwise node's cross-table references are live deps:
+                    # run when any referenced table emitted this substep
+                    and not (
+                        cross_nodes
+                        and any(len(deltas.get(n.id, ())) for n in cross_nodes)
+                    )
                     # lockstep: exchange-point operators participate in every
                     # commit's all-to-all even with no local rows (peers block on
                     # our partitions)

@@ -254,10 +254,23 @@ def _rows_equal(a: dict, b: dict) -> bool:
     for k, va in a.items():
         vb = b.get(k)
         if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
-            if not np.array_equal(va, vb):
+            try:
+                eq = np.array_equal(va, vb, equal_nan=True)
+            except TypeError:  # non-numeric dtypes reject equal_nan
+                eq = np.array_equal(va, vb)
+            if not eq:
                 return False
         elif va != vb:
-            return False
+            # NaN must equal NaN for the FIXPOINT check: value semantics, not
+            # IEEE semantics — otherwise any iterated float column that ever
+            # holds NaN re-emits the same row forever and the loop never ends
+            if not (
+                isinstance(va, float)
+                and isinstance(vb, float)
+                and va != va
+                and vb != vb
+            ):
+                return False
     return True
 
 

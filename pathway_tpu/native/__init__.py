@@ -99,9 +99,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return None
     if not hasattr(lib, "pwtpu_hash_upsert"):
         # stale prebuilt .so from older source (mtime comparisons can lie across
-        # archive extraction / layer caching): force one rebuild; if the symbol
-        # is still absent, disable the native path instead of crashing later on
-        # a missing attribute
+        # archive extraction / layer caching): force one rebuild. The reload must
+        # use a FRESH path — glibc dedupes dlopen by pathname, so reloading the
+        # replaced file at the same path returns the stale handle.
         try:
             os.unlink(_SO)
         except OSError:
@@ -109,10 +109,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
         path = _build()
         if path is None:
             return None
+        import shutil
+
+        fresh = f"{_SO}.reload.{os.getpid()}"
         try:
-            lib = ctypes.PyDLL(path)
+            shutil.copyfile(path, fresh)
+            lib = ctypes.PyDLL(fresh)
         except OSError:
             return None
+        finally:
+            try:
+                os.unlink(fresh)  # the mapping survives the unlink on Linux
+            except OSError:
+                pass
         if not hasattr(lib, "pwtpu_hash_upsert"):
             return None
 

@@ -661,3 +661,87 @@ def test_join_frontier_still_evicts_retracted_rows():
     )
     # "a" evicted, "b" stays (commit-0 arranged), "c" never arranged
     assert len(join_ev.left.row_index) == 1
+
+
+def test_cross_table_reference_is_live():
+    """A select reading ANOTHER table's column must re-emit affected rows when
+    that table updates, even with no delta on its own input (reference: cross
+    reads are dataflow edges in DD, not snapshot lookups)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    base = pw.debug.table_from_rows(
+        pw.schema_builder({"k": str, "x": int}),
+        [("a", 1, 0, 1), ("b", 2, 0, 1)],
+        is_stream=True,
+    )
+    # companion (comp2 below) shares base's universe; its value for "b" flips
+    # at t=2 via update_cells from a late stream
+    late = pw.debug.table_from_rows(
+        pw.schema_builder({"k": str, "f": int}),
+        [("b", 99, 2, 1)],
+        is_stream=True,
+    )
+    keyed = late.with_id_from(late.k)
+    rekeyed_base = base.with_id_from(base.k)
+    comp2 = rekeyed_base.select(f=pw.this.x * 10).update_cells(
+        keyed.select(keyed.f)
+    )
+    out = rekeyed_base.select(rekeyed_base.x, y=comp2.f + 1)
+    events = []
+    pw.io.subscribe(
+        out,
+        on_batch=lambda keys, diffs, columns, time: events.extend(
+            (time, x, y, d)
+            for x, y, d in zip(
+                columns["x"].tolist(), columns["y"].tolist(), diffs.tolist()
+            )
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    # t=0: initial values; t=2: row b re-emits with the patched companion value
+    assert (0, 2, 21, 1) in events
+    assert (2, 2, 21, -1) in events and (2, 2, 100, 1) in events
+    # row a untouched at t=2 (no spurious churn from the refresh)
+    assert not any(t == 2 and x == 1 for t, x, _y, _d in events)
+
+
+def test_two_selects_sharing_cross_reference():
+    """Review repro: TWO selects referencing the same cross table must both
+    materialize their states (per-node cross-ref detection, not needed-set
+    growth) and both re-fire on the referenced table's update."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    base = pw.debug.table_from_rows(
+        pw.schema_builder({"k": str, "x": int}),
+        [("a", 1, 0, 1), ("b", 2, 0, 1)],
+        is_stream=True,
+    )
+    late = pw.debug.table_from_rows(
+        pw.schema_builder({"k": str, "f": int}), [("b", 99, 2, 1)], is_stream=True
+    )
+    rb = base.with_id_from(base.k)
+    comp = rb.select(f=pw.this.x * 10).update_cells(
+        late.with_id_from(late.k).select(f=pw.this.f)
+    )
+    out1 = rb.select(rb.x, y=comp.f + 1)
+    out2 = rb.select(rb.x, z=comp.f + 2)
+    got1, got2 = [], []
+    pw.io.subscribe(
+        out1,
+        on_batch=lambda keys, diffs, columns, time: got1.extend(
+            zip(columns["y"].tolist(), diffs.tolist())
+        ),
+    )
+    pw.io.subscribe(
+        out2,
+        on_batch=lambda keys, diffs, columns, time: got2.extend(
+            zip(columns["z"].tolist(), diffs.tolist())
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert (100, 1) in got1 and (21, -1) in got1
+    assert (101, 1) in got2 and (22, -1) in got2

@@ -305,3 +305,41 @@ def test_flatten_column_and_bucketing():
 
     ts = datetime.datetime(2026, 7, 30, 12, 34, 56, 789000)
     assert truncate_to_minutes(ts) == datetime.datetime(2026, 7, 30, 12, 34)
+
+
+def test_interpolate_across_none_runs():
+    """Consecutive missing cells must interpolate against the NEAREST known
+    neighbors (reference iterate-closed chains), not just adjacent rows."""
+    import pathway_tpu as pw
+
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        1 | 1.0
+        2 |
+        3 |
+        4 | 7.0
+        5 |
+        """
+    )
+    res = pw.statistical.interpolate(t, t.t, t.v)
+    df = pw.debug.table_to_pandas(res).sort_values("t")
+    assert df["v"].tolist() == [1.0, 3.0, 5.0, 7.0, 7.0]
+
+
+def test_iterate_fixpoint_converges_with_nan_columns():
+    """Engine regression: NaN in an iterated float column must not defeat the
+    fixpoint check (value semantics: NaN == NaN for convergence)."""
+    import pathway_tpu as pw
+
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"x": float}), [(float("nan"),), (2.0,)]
+    )
+
+    def step(state):
+        return dict(state=state.select(x=state.x))  # identity: 1 iteration
+
+    out = pw.iterate(step, state=t).state
+    df = pw.debug.table_to_pandas(out)
+    vals = sorted(df["x"].tolist(), key=repr)
+    assert len(vals) == 2 and 2.0 in vals
