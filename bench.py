@@ -572,10 +572,15 @@ def bench_sharded() -> dict:
 def _ensure_reachable_backend() -> str | None:
     """Probe TPU init in a SUBPROCESS with a timeout: a wedged device tunnel
     (e.g. a dead client holding the single-tenant claim) hangs backend init
-    forever, which must degrade the bench to CPU — with an honest marker in the
-    output — rather than hang the round's measurement entirely."""
+    forever, which must degrade to CPU — with an honest marker returned —
+    rather than hang the measurement (or the driver's compile check: shared by
+    ``__graft_entry__``) entirely."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return None
+    if "axon" not in os.environ.get("JAX_PLATFORMS", "") and not os.environ.get(
+        "PALLAS_AXON_POOL_IPS"
+    ):
+        return None  # no tunneled plugin in play: nothing to probe
     try:
         probe = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -595,6 +600,9 @@ def _ensure_reachable_backend() -> str | None:
         from jax._src import xla_bridge as _xb
 
         _xb._backend_factories.pop("axon", None)
+        # an axon backend that initialized BEFORE the tunnel wedged must be
+        # dropped too, or already-imported jax keeps dispatching to it
+        _xb._clear_backends()
     except Exception:
         pass
     return "tpu unreachable (backend init hung/failed); CPU fallback — numbers NOT comparable"
