@@ -810,6 +810,8 @@ struct MultiMap {
   std::vector<int64_t> head;  // first value in the bag
   std::vector<int64_t> cnt;   // bag size
   std::vector<int64_t> nxt, prv;  // intrusive links, indexed by value
+  std::vector<uint64_t> vhi, vlo;  // owning key per linked value (membership check)
+  std::vector<uint8_t> linked;     // 1 while the value sits in some bag
   uint64_t mask = 0;
   int64_t live = 0;
   int64_t filled = 0;
@@ -834,6 +836,9 @@ struct MultiMap {
       while (n <= static_cast<size_t>(v)) n *= 2;
       nxt.resize(n, -1);
       prv.resize(n, -1);
+      vhi.resize(n, 0);
+      vlo.resize(n, 0);
+      linked.resize(n, 0);
     }
   }
 
@@ -904,6 +909,9 @@ struct MultiMap {
     prv[v] = -1;
     if (h >= 0) prv[h] = v;
     head[pos] = v;
+    vhi[v] = hi;
+    vlo[v] = lo;
+    linked[v] = 1;
     ++cnt[pos];
     ++total_vals;
   }
@@ -914,9 +922,10 @@ struct MultiMap {
     uint64_t pos = find(hi, lo, &found);
     if (!found) return false;
     if (static_cast<size_t>(v) >= nxt.size()) return false;
-    // verify membership: v's chain must reach from head (prv==-1 means v is a head
-    // of SOME bag; confirm it's this one)
-    if (prv[v] < 0 && head[pos] != v) return false;
+    // O(1) membership check: v must currently be linked, and into THIS bag —
+    // a value mid-chain in a different bag would otherwise be unlinked from
+    // that bag while this bag's cnt is decremented (silent corruption)
+    if (!linked[v] || vhi[v] != hi || vlo[v] != lo) return false;
     if (prv[v] < 0 && head[pos] == v) {
       head[pos] = nxt[v];
       if (nxt[v] >= 0) prv[nxt[v]] = -1;
@@ -926,6 +935,7 @@ struct MultiMap {
     }
     nxt[v] = -1;
     prv[v] = -1;
+    linked[v] = 0;
     --total_vals;
     if (--cnt[pos] == 0) {
       state[pos] = 2;
@@ -995,7 +1005,11 @@ int64_t pwtpu_mm_count(void* h, const uint64_t* keys, int64_t n,
 }
 
 // CSR fill pass: out_values must hold the total from pwtpu_mm_count, laid out
-// row-major in probe order.
+// row-major in probe order. Within one key the values come out in
+// reverse-insertion (LIFO head-insert) order — deterministic for a given
+// insert/remove history, but NOT the insertion order the pre-intrusive-list
+// implementation produced; consumers needing a stable cross-version order
+// (goldens, checkpoint diffs) must sort.
 void pwtpu_mm_fill(void* h, const uint64_t* keys, int64_t n,
                    int64_t* out_values) {
   const MultiMap* mm = static_cast<const MultiMap*>(h);

@@ -233,7 +233,9 @@ class _TimedSource(StaticDataSource):
             sorted_t = times[order]
             bounds = np.nonzero(np.diff(sorted_t))[0] + 1
             for chunk in np.split(order, bounds):
-                self._time_rows[sorted_t[chunk[0]].item()] = chunk
+                # chunk holds ORIGINAL row indices: look the time up in `times`,
+                # not `sorted_t` (equal only when rows arrive pre-sorted by time)
+                self._time_rows[times[chunk[0]].item()] = chunk
         if self._pointers:
             self._all_keys = pointers_to_keys(self._pointers)
         else:

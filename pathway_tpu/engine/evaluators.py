@@ -83,9 +83,36 @@ class Evaluator:
     def process(self, input_deltas: List[Delta]) -> Delta:
         raise NotImplementedError
 
+    # -- multi-process placement (parallel/cluster.py) ------------------------
+    #
+    # Per-input routing policy applied by the runner before ``process`` when a
+    # spawn cluster is active (reference: timely Exchange pacts per operator,
+    # ``shard.rs`` routing; centralization ``time_column.rs:48-51``):
+    #   None        — rows stay where they were produced (stateless / row-local)
+    #   "rowkey"    — hash-exchange by row key: same-key rows of every such
+    #                 input meet on the key's owner process
+    #   "custom"    — hash-exchange by ``cluster_route_keys(idx, delta)``
+    #   "root"      — centralize the input on process 0 (global-order state)
+    #   "broadcast" — replicate the input on every process (replicated state)
+    # An evaluator with ANY non-None policy participates in the all-to-all
+    # barrier every commit, even with no local rows.
+
+    CLUSTER_POLICIES: Dict[int, str] = {}
+    _cluster_policies: tuple = ()  # resolved per-instance by GraphRunner.setup
+    _cluster_barrier: bool = False
+
+    def cluster_input_policy(self, idx: int) -> str | None:
+        return self.CLUSTER_POLICIES.get(idx)
+
+    def cluster_route_keys(self, idx: int, delta: Delta) -> np.ndarray:
+        raise NotImplementedError  # required for "custom" policies only
+
     # -- operator snapshots (reference ``operator_snapshot.rs``) -------------
 
-    _NON_STATE_ATTRS = ("node", "runner", "output_columns", "_memo_tokens")
+    _NON_STATE_ATTRS = (
+        "node", "runner", "output_columns", "_memo_tokens",
+        "_cluster_policies", "_cluster_barrier",
+    )
 
     def state_dict(self) -> Dict[str, bytes]:
         """Picklable per-attribute snapshot of this operator's incremental state.
@@ -732,6 +759,20 @@ class GroupbyEvaluator(Evaluator):
 
 
 class DeduplicateEvaluator(Evaluator):
+    # state is per INSTANCE: route rows to their instance's owner process
+    # (within-commit arrival order across processes is rank-merged, the same
+    # nondeterminism timely's exchange has)
+    CLUSTER_POLICIES = {0: "custom"}
+
+    def cluster_route_keys(self, idx: int, delta: Delta) -> np.ndarray:
+        instance_e = self.node.config.get("instance")
+        if instance_e is None:
+            # global dedup: a single logical instance — one owner (process of key 0)
+            return broadcast_key(pointer_from(), len(delta))
+        resolver = self._resolver_for(self.node.inputs[0], delta)
+        instances = ee.evaluate(instance_e, len(delta), resolver)
+        return keys_from_values([instances])
+
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.current: Dict[bytes, Tuple[np.void, dict, Any]] = {}  # instance -> (key,row,value)
@@ -1183,6 +1224,10 @@ class JoinEvaluator(Evaluator):
 
 
 class UpdateRowsEvaluator(Evaluator):
+    # base and patch relate rows BY ROW KEY: exchanging both means every key's
+    # base/patch pair meets on its owner process (exact under spawn -n N)
+    CLUSTER_POLICIES = {0: "rowkey", 1: "rowkey"}
+
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.base = StateTable(self.output_columns)
@@ -1231,6 +1276,8 @@ class UpdateRowsEvaluator(Evaluator):
 
 
 class UpdateCellsEvaluator(Evaluator):
+    CLUSTER_POLICIES = {0: "rowkey", 1: "rowkey"}
+
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         patch_cols = [
@@ -1300,6 +1347,10 @@ class UpdateCellsEvaluator(Evaluator):
 
 class _KeyPresenceMixin(Evaluator):
     """Shared machinery for intersect/difference/restrict/having."""
+
+    def cluster_input_policy(self, idx: int) -> str | None:
+        # presence is tested key-by-key: co-partition every input by row key
+        return "rowkey"
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
@@ -1374,6 +1425,19 @@ class HavingEvaluator(Evaluator):
 
     _NON_STATE_ATTRS = Evaluator._NON_STATE_ATTRS + ("indexers",)
 
+    def cluster_input_policy(self, idx: int) -> str | None:
+        # indexer rows route by the POINTER VALUE they carry (the key whose
+        # presence they assert), meeting the base row they reference
+        return "rowkey" if idx == 0 else "custom"
+
+    def cluster_route_keys(self, idx: int, delta: Delta) -> np.ndarray:
+        vals = delta.columns[self.indexers[idx - 1].name]
+        out = delta.keys.copy()  # non-pointer cells: route arbitrarily (ignored)
+        for i in range(len(delta)):
+            if isinstance(vals[i], Pointer):
+                out[i] = pointers_to_keys([vals[i]])[0]
+        return out
+
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.base = StateTable(self.output_columns)
@@ -1426,6 +1490,8 @@ class WithUniverseOfEvaluator(Evaluator):
     """Runtime enforcement of the promised universe equality (the reference's
     engine rekeys onto the other universe and fails on mismatch; here both key
     sets are tracked and verified once the stream is final)."""
+
+    CLUSTER_POLICIES = {0: "rowkey", 1: "rowkey"}
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
@@ -1598,6 +1664,10 @@ class IxEvaluator(Evaluator):
 class SortEvaluator(Evaluator):
     """prev/next pointers per instance (reference ``prev_next.rs:770``)."""
 
+    # global per-instance ordering: centralize on process 0 (the reference routes
+    # such operators to one worker, ``time_column.rs:48-51``)
+    CLUSTER_POLICIES = {0: "root"}
+
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.rows: Dict[bytes, tuple] = {}  # key -> (sort_val, instance, Pointer)
@@ -1746,6 +1816,11 @@ class _TimeThresholdEvaluator(Evaluator):
     the reference's frontier comparison). Ripeness scans use a min-heap on threshold so
     each commit pops only the ripe prefix (no full rescan of buffered state).
     """
+
+    # ``now`` is a GLOBAL watermark (max time over the whole stream): centralize
+    # on process 0, as the reference does for time-column operators
+    # (``time_column.rs:48-51`` — "we need to process all data in one worker")
+    CLUSTER_POLICIES = {0: "root"}
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
@@ -1932,6 +2007,11 @@ class ExternalIndexEvaluator(Evaluator):
     ``asof_now=False`` live queries are *re-answered* whenever the index changes: the old
     reply is retracted and the fresh one emitted (reference full differential semantics of
     ``DataIndex.query``)."""
+
+    # the data side replicates to every process (each holds the FULL index);
+    # queries stay local and answer exactly against the replicated state —
+    # the replicated-index pattern (queries never cross processes)
+    CLUSTER_POLICIES = {0: "broadcast"}
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)

@@ -125,15 +125,14 @@ class GraphRunner:
         Lets evaluators resolve retraction rows against retracted upstream values."""
         return self._substep_deltas.get(node.id)
 
-    # Operators whose per-key state cannot be hash-co-partitioned by the cluster
-    # exchange yet: running them multi-process would silently return per-process
-    # partial answers, so they fail loudly instead (VERDICT r2 item 3).
-    _CLUSTER_UNSUPPORTED = {
-        "ix", "sort", "deduplicate", "buffer", "forget", "freeze",
-        "external_index", "asof_now", "iterate", "iterate_result",
-        "update_rows", "update_cells", "intersect", "difference", "restrict",
-        "having", "with_universe_of", "row_transformer",
-    }
+    # Operators that still cannot run multi-process: ix reads another node's
+    # materialized state (not co-partitioned with its own rows), iterate nests a
+    # whole sub-runner, and row transformers chase pointers across arbitrary rows.
+    # Everything else either exchanges (rowkey/custom routing), centralizes on
+    # process 0, or replicates — see ``Evaluator.CLUSTER_POLICIES``. Running these
+    # four multi-process would silently return per-process partial answers, so
+    # they fail loudly instead.
+    _CLUSTER_UNSUPPORTED = {"ix", "iterate", "iterate_result", "row_transformer"}
 
     def setup(self, monitoring_level: Any = None, persistence_config: Any = None) -> None:
         # hot-path modules load now, not inside the first timed commit
@@ -173,13 +172,44 @@ class GraphRunner:
                 walk(node.config)
                 return found[0]
 
+            # operators that move rows off their producing process (exchange,
+            # centralize, instance routing) — and everything downstream of one
+            _REPARTITION_KINDS = {
+                "groupby", "join", "update_rows", "update_cells", "intersect",
+                "difference", "restrict", "having", "with_universe_of",
+                "deduplicate", "sort", "buffer", "forget", "freeze",
+                "external_index",
+            }
+            repartitioned: set = set()
             for node in self.graph.nodes:
-                if node.kind in ("groupby", "join") and cross_refs(node):
+                if node.kind in _REPARTITION_KINDS or any(
+                    inp._node.id in repartitioned for inp in node.inputs
+                ):
+                    repartitioned.add(node.id)
+            for node in self.graph.nodes:
+                if node.kind in _REPARTITION_KINDS and cross_refs(node):
                     raise NotImplementedError(
                         f"node {node.id} ({node.kind}) references another table's "
                         "materialized state; exchanged rows cannot resolve foreign "
                         "state across spawn processes — inline the referenced "
                         "columns (select them onto the input) or run single-process"
+                    )
+                if (
+                    isinstance(node, pg.RowwiseNode)
+                    and cross_refs(node)
+                    and (
+                        node.id in repartitioned
+                        or self._cross_ref_targets_repartitioned(node, repartitioned)
+                    )
+                ):
+                    # the referencing rows and the referenced state are no longer
+                    # co-located once either side crossed an exchange point
+                    raise NotImplementedError(
+                        f"node {node.id} (rowwise) cross-references a table on the "
+                        "far side of a cluster exchange point; the referenced state "
+                        "is partitioned differently from this node's rows — inline "
+                        "the referenced columns before the exchange (select/join "
+                        "them onto the input) or run single-process"
                     )
 
         self._nodes = list(self.graph.nodes)
@@ -192,6 +222,17 @@ class GraphRunner:
             self.evaluators[node.id] = evaluator_cls(node, self)
             columns = node.output.column_names() if node.output is not None else []
             self.states[node.id] = StateTable(columns)
+        if self._cluster is not None:
+            for node in self._nodes:
+                ev = self.evaluators[node.id]
+                ev._cluster_policies = tuple(
+                    ev.cluster_input_policy(i) for i in range(len(node.inputs))
+                )
+                # exchange/centralize/broadcast points are lockstep barriers:
+                # they participate in every commit even with no local rows
+                ev._cluster_barrier = node.kind in ("groupby", "join") or any(
+                    p is not None for p in ev._cluster_policies
+                )
         self._sources = [
             (node, self.evaluators[node.id])
             for node in self._nodes
@@ -211,6 +252,19 @@ class GraphRunner:
                 getattr(persistence_config, "snapshot_interval_ms", 0) or 0
             ) / 1000.0
             checkpoint = self._persistence.load_checkpoint(sig)
+            if checkpoint is not None and self._cluster is not None:
+                # Cluster resume is journal-only (snapshot writes are gated off
+                # under spawn): a checkpoint here comes from a single-process
+                # run. Its journal was compacted at an unsynchronized commit, so
+                # peers replaying the union of journaled ids would re-exchange
+                # rows this process's snapshot already contains — silent double
+                # counting. Refuse loudly.
+                raise NotImplementedError(
+                    "this persistence store contains an operator snapshot "
+                    "(written by a single-process run); resuming it under "
+                    "spawn -n N is not supported — restart single-process or "
+                    "start the cluster from a fresh store"
+                )
             replay_frames = self._persistence.load_journal(sig)
             self._persistence.open_for_append(sig)
             restore_frames = list(replay_frames)
@@ -248,24 +302,57 @@ class GraphRunner:
         # every operator's state, before any realtime stepping
         from pathway_tpu.internals.config import get_pathway_config
 
-        if replay_frames and get_pathway_config().persistence_mode == "batch":
-            # replay the whole recording as ONE commit (reference PersistenceMode::Batch)
-            merged: Dict[int, List[Delta]] = {}
-            for _cid, input_deltas, _offs in replay_frames:
-                for nid, delta in input_deltas.items():
-                    merged.setdefault(nid, []).append(delta)
-            combined = {
-                nid: Delta.concat(ds, list(ds[0].columns)) for nid, ds in merged.items()
-            }
-            replay_frames = [(replay_frames[-1][0], combined, replay_frames[-1][2])]
-        for commit_id, input_deltas, _offsets in replay_frames:
-            self._inject = input_deltas
-            self.step()
-        self._inject = None
-        if replay_frames:
-            # future frame ids must exceed every journaled id (checkpoint subsumption
-            # filters by id)
-            self._commit = max(self._commit, replay_frames[-1][0] + 1)
+        if self._cluster is not None and self._persistence is not None:
+            # Lockstep replay: journals differ after a mid-commit kill (one process
+            # recorded commit N, its peer died first), and a commit with data on
+            # only one process writes a frame only there. Exchange tags carry the
+            # commit id, so every process must replay the UNION of recorded ids at
+            # their ORIGINAL numbering — injecting an empty frame where it has no
+            # local data — or the all-to-all deadlocks. (Reference: timely workers
+            # replay a shared total order of timestamps.)
+            local_frames = {cid: deltas for cid, deltas, _offs in replay_frames}
+            id_lists = self._cluster.allgather(b"replay:ids", sorted(local_frames))
+            all_ids = sorted(set().union(*id_lists))
+            if all_ids and get_pathway_config().persistence_mode == "batch":
+                # batch mode, cluster flavor: collapse every local frame into ONE
+                # replay commit pinned at the globally-last journaled id, so the
+                # single replayed commit carries the same exchange tags everywhere
+                merged: Dict[int, List[Delta]] = {}
+                for deltas in local_frames.values():
+                    for nid, delta in deltas.items():
+                        merged.setdefault(nid, []).append(delta)
+                combined = {
+                    nid: Delta.concat(ds, list(ds[0].columns))
+                    for nid, ds in merged.items()
+                }
+                local_frames = {all_ids[-1]: combined}
+                all_ids = [all_ids[-1]]
+            for cid in all_ids:
+                self._commit = cid
+                self._inject = local_frames.get(cid, {})
+                self.step()
+            self._inject = None
+            if all_ids:
+                self._commit = all_ids[-1] + 1
+        else:
+            if replay_frames and get_pathway_config().persistence_mode == "batch":
+                # replay the whole recording as ONE commit (reference PersistenceMode::Batch)
+                merged: Dict[int, List[Delta]] = {}
+                for _cid, input_deltas, _offs in replay_frames:
+                    for nid, delta in input_deltas.items():
+                        merged.setdefault(nid, []).append(delta)
+                combined = {
+                    nid: Delta.concat(ds, list(ds[0].columns)) for nid, ds in merged.items()
+                }
+                replay_frames = [(replay_frames[-1][0], combined, replay_frames[-1][2])]
+            for commit_id, input_deltas, _offsets in replay_frames:
+                self._inject = input_deltas
+                self.step()
+            self._inject = None
+            if replay_frames:
+                # future frame ids must exceed every journaled id (checkpoint subsumption
+                # filters by id)
+                self._commit = max(self._commit, replay_frames[-1][0] + 1)
 
     def _load_checkpoint_state(self, blob: dict) -> None:
         """Restore operator + state-table snapshots (reference operator persistence,
@@ -411,6 +498,11 @@ class GraphRunner:
                 self._persistence.record_commit(self._commit, self._input_deltas, offsets)
                 if (
                     self._snapshot_interval_s > 0
+                    # operator snapshots are wall-clock-driven and therefore NOT
+                    # synchronized across spawn processes; an unsynchronized
+                    # checkpoint would subsume commits whose exchanges a peer
+                    # still needs to replay. Cluster resume is journal-only.
+                    and self._cluster is None
                     and time_mod.monotonic() - self._last_checkpoint
                     >= self._snapshot_interval_s
                 ):
@@ -490,20 +582,23 @@ class GraphRunner:
                     # lockstep: exchange-point operators participate in every
                     # commit's all-to-all even with no local rows (peers block on
                     # our partitions)
-                    and not (
-                        self._cluster is not None and node.kind in ("groupby", "join")
-                    )
+                    and not (self._cluster is not None and evaluator._cluster_barrier)
                 ):
                     delta = Delta.empty(self.output_columns_of(node))
-                elif originates:
-                    delta = evaluator.drain_neu(inputs)
                 else:
-                    try:
-                        delta = evaluator.process(inputs)
-                    except Exception as exc:
-                        from pathway_tpu.internals.trace import add_error_context
+                    if self._cluster is not None and any(
+                        p is not None for p in evaluator._cluster_policies
+                    ):
+                        inputs = self._route_cluster_inputs(node, evaluator, inputs)
+                    if originates:
+                        delta = evaluator.drain_neu(inputs)
+                    else:
+                        try:
+                            delta = evaluator.process(inputs)
+                        except Exception as exc:
+                            from pathway_tpu.internals.trace import add_error_context
 
-                        raise add_error_context(exc, node) from exc
+                            raise add_error_context(exc, node) from exc
                 if neu and len(delta):
                     delta.neu = True
             deltas[node.id] = delta
@@ -513,6 +608,59 @@ class GraphRunner:
                 if node.output is not None and node.id in self._materialized:
                     self.states[node.id].apply(delta)
         return any_output
+
+    @staticmethod
+    def _cross_ref_targets_repartitioned(node: pg.Node, repartitioned: set) -> bool:
+        """True when any cross-table ref in ``node.config`` points at a table that
+        sits downstream of a cluster exchange point."""
+        from pathway_tpu.internals.expression import ColumnExpression
+
+        found = [False]
+
+        def walk(value: Any) -> None:
+            if isinstance(value, ColumnExpression):
+                for ref in value._column_refs:
+                    if (
+                        all(ref.table is not t for t in node.inputs)
+                        and ref.table._node.id in repartitioned
+                    ):
+                        found[0] = True
+            elif isinstance(value, dict):
+                for v in value.values():
+                    walk(v)
+            elif isinstance(value, (list, tuple)):
+                for v in value:
+                    walk(v)
+
+        walk(node.config)
+        return found[0]
+
+    def _route_cluster_inputs(
+        self, node: pg.Node, evaluator: Any, inputs: List[Delta]
+    ) -> List[Delta]:
+        """Apply the evaluator's per-input cluster policies (all-to-all barriers;
+        every process reaches this point each commit — ``_cluster_barrier``)."""
+        routed: List[Delta] = []
+        for idx, delta in enumerate(inputs):
+            policy = evaluator._cluster_policies[idx]
+            tag = f"{self.current_time}:{node.id}:i{idx}".encode()
+            if policy is None:
+                routed.append(delta)
+            elif policy == "rowkey":
+                routed.append(self._cluster.exchange_delta(tag, delta, delta.keys))
+            elif policy == "custom":
+                route_keys = (
+                    delta.keys if len(delta) == 0
+                    else evaluator.cluster_route_keys(idx, delta)
+                )
+                routed.append(self._cluster.exchange_delta(tag, delta, route_keys))
+            elif policy == "root":
+                routed.append(self._cluster.exchange_to_root(tag, delta))
+            elif policy == "broadcast":
+                routed.append(self._cluster.broadcast_merge(tag, delta))
+            else:
+                raise AssertionError(f"unknown cluster policy {policy!r}")
+        return routed
 
     def output_columns_of(self, node: pg.Node) -> List[str]:
         return node.output.column_names() if node.output is not None else []

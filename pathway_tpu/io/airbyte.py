@@ -125,6 +125,18 @@ class _AirbyteSubject:
 
     def stop(self) -> None:
         self._stop = True
+        # a silent/hung connector never wakes the stdout loop; terminating the
+        # child delivers EOF to the reader so _one_sync can unwind
+        proc = getattr(self, "_proc", None)
+        if proc is not None:
+            for meth in ("terminate", "kill"):
+                stop_fn = getattr(proc, meth, None)
+                if stop_fn is not None:
+                    try:
+                        stop_fn()
+                    except Exception:
+                        pass
+                    break
 
     # -- protocol loop -------------------------------------------------------
 
@@ -158,6 +170,7 @@ class _AirbyteSubject:
             self.source_cfg, config_path, catalog_path, state_path, self.env_vars
         )
         proc = self.process_factory(cmd, self.env_vars)
+        self._proc = proc  # stop() terminates it so a silent child can't block shutdown
         # stderr drains on a side thread so a chatty source can't block on a full
         # pipe; its tail feeds failure diagnostics
         stderr_tail: list[str] = []
@@ -172,8 +185,14 @@ class _AirbyteSubject:
 
             threading.Thread(target=_drain, daemon=True).start()
         failed = False
+        stopped = False
         try:
             for line in proc.stdout:
+                if self._stop:
+                    # shutdown requested mid-sync: a long or hung connector read
+                    # must not block graph teardown indefinitely
+                    stopped = True
+                    break
                 line = line.strip()
                 if not line:
                     continue
@@ -198,7 +217,7 @@ class _AirbyteSubject:
                         raise RuntimeError(f"airbyte source error: {err}")
                 # LOG / CATALOG / CONNECTION_STATUS messages are ignored here
         finally:
-            if failed:
+            if failed or stopped:
                 # stop reading mid-stream: kill the child or wait() deadlocks on
                 # its blocked stdout writes (and a docker container would leak)
                 for meth in ("terminate", "kill"):
@@ -207,6 +226,8 @@ class _AirbyteSubject:
                         stop()
                         break
             rc = proc.wait()
+        if stopped:
+            return
         if rc not in (0, None) and not failed:
             tail = "".join(stderr_tail[-10:]).strip()
             raise RuntimeError(

@@ -151,6 +151,13 @@ class ExportedTable:
             else:
                 self._listeners.append(listener)
 
+    def unsubscribe(self, listener: Callable) -> None:
+        with self._advanced:
+            try:
+                self._listeners.remove(listener)
+            except ValueError:
+                pass
+
 
 def export_table(table: Any) -> ExportedTable:
     """Attach a live export handle to ``table`` (reference ``Graph::export_table``)."""
@@ -171,21 +178,32 @@ class _ImportSubject:
 
     def __init__(self, exported: ExportedTable):
         self.exported = exported
+        self._done = threading.Event()
+        self._listener: Any = None
 
     def run(self, source: Any) -> None:
-        done = threading.Event()
-
         def listener(events: Any, time: int) -> None:
             if events is None:
-                done.set()
+                self._done.set()
                 return
+            if self._done.is_set():
+                return  # stopped importer: drop late batches instead of pushing
             for ptr, row, diff in events:
                 source.push(dict(row), key=ptr, diff=diff)
 
+        self._listener = listener
         self.exported.subscribe(listener)
-        done.wait()
+        self._done.wait()
         if self.exported.failed():
             raise RuntimeError("exporting graph failed") from self.exported._failed
+
+    def stop(self) -> None:
+        """Graceful-shutdown hook (``GraphRunner.finish``): without it the import
+        thread parks forever on ``_done.wait()`` whenever the exporting graph
+        never closes."""
+        self._done.set()
+        if self._listener is not None:
+            self.exported.unsubscribe(self._listener)
 
 
 def import_table(exported: ExportedTable, *, autocommit_duration_ms: int | None = 50) -> Any:

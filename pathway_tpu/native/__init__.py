@@ -42,11 +42,11 @@ def _xxhash_include_dir() -> Optional[str]:
     return None
 
 
-def _build() -> Optional[str]:
+def _build(force: bool = False) -> Optional[str]:
     src = os.path.abspath(_SRC)
     if not os.path.exists(src):
         return None
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+    if not force and os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
         return _SO
     include = _xxhash_include_dir()
     if include is None:
@@ -99,14 +99,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return None
     if not hasattr(lib, "pwtpu_hash_upsert"):
         # stale prebuilt .so from older source (mtime comparisons can lie across
-        # archive extraction / layer caching): force one rebuild. The reload must
-        # use a FRESH path — glibc dedupes dlopen by pathname, so reloading the
-        # replaced file at the same path returns the stale handle.
-        try:
-            os.unlink(_SO)
-        except OSError:
-            return None
-        path = _build()
+        # archive extraction / layer caching): force one rebuild — compiled to a
+        # temp path and swapped in only on success, so a failed compile (e.g. no
+        # toolchain on the deployment host) leaves the existing library intact.
+        # The reload must use a FRESH path — glibc dedupes dlopen by pathname, so
+        # reloading the replaced file at the same path returns the stale handle.
+        path = _build(force=True)
         if path is None:
             return None
         import shutil

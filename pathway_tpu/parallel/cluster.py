@@ -230,6 +230,60 @@ class ClusterExchange:
             return mine
         return Delta.concat(merged, list(delta.columns))
 
+    @staticmethod
+    def _pack(delta: Any) -> bytes:
+        return pickle.dumps(
+            (delta.keys, delta.diffs, delta.columns, delta.neu),
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+
+    def exchange_to_root(self, tag: bytes, delta: Any) -> Any:
+        """Centralize: every process ships its whole delta to process 0 (the
+        reference routes temporal-behavior input to one worker,
+        ``time_column.rs:48-51``). Process 0 returns the rank-ordered merge;
+        everyone else returns an empty delta. Barrier."""
+        from pathway_tpu.engine.columnar import Delta
+
+        columns = list(delta.columns)
+        parts: Dict[int, bytes] = {p: b"" for p in self._conns}
+        if self.me != 0 and len(delta):
+            parts[0] = self._pack(delta)
+        received = self.exchange_parts(tag, parts)
+        if self.me != 0:
+            return Delta.empty(columns)
+        merged = [delta]
+        for peer in sorted(received):
+            payload = received[peer]
+            if payload:
+                keys, diffs, cols, neu = pickle.loads(payload)
+                merged.append(Delta(keys, diffs, cols, neu=neu))
+        if len(merged) == 1:
+            return delta
+        return Delta.concat(merged, columns)
+
+    def broadcast_merge(self, tag: bytes, delta: Any) -> Any:
+        """Replicate: every process contributes its delta; ALL processes return the
+        same rank-ordered merge (replicated-state operators, e.g. the external
+        index's data side — every process holds the full index, queries answer
+        locally). Barrier."""
+        from pathway_tpu.engine.columnar import Delta
+
+        columns = list(delta.columns)
+        blob = self._pack(delta) if len(delta) else b""
+        received = self.exchange_parts(tag, {p: blob for p in self._conns})
+        by_rank: List[Any] = [None] * self.n
+        by_rank[self.me] = delta
+        for peer, payload in received.items():
+            if payload:
+                keys, diffs, cols, neu = pickle.loads(payload)
+                by_rank[peer] = Delta(keys, diffs, cols, neu=neu)
+        merged = [d for d in by_rank if d is not None and len(d)]
+        if not merged:
+            return Delta.empty(columns)
+        if len(merged) == 1:
+            return merged[0]
+        return Delta.concat(merged, columns)
+
 
 _cluster: Optional[ClusterExchange] = None
 _cluster_tried = False

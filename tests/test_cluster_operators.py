@@ -1,0 +1,331 @@
+"""Multi-process coverage for the operators de-blocked in round 4: rowkey-exchanged
+(update_rows, intersect), instance-routed (deduplicate), and centralized
+(sort, buffer/forget behind windowby behaviors) — VERDICT r3 item 5.
+
+Reference model: every operator participates in timely's exchange
+(``src/engine/dataflow.rs``); temporal/ordering operators centralize on one worker
+(``src/engine/dataflow/operators/time_column.rs:48-51``)."""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(n: int, program: str, tmp_path, first_port: int) -> None:
+    prog = tmp_path / "prog.py"
+    prog.write_text(program)
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PATHWAY_TPU_TEST_DIR"] = str(tmp_path)
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "-n", str(n), "--first-port", str(first_port + os.getpid() % 500 * 4),
+            sys.executable, str(prog),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, f"spawn failed:\nstdout={out.stdout}\nstderr={out.stderr}"
+
+
+def _merge_counting(dumps: list[list]) -> dict:
+    """Merge per-process (row, diff) event lists into the net final multiset."""
+    net: collections.Counter = collections.Counter()
+    for events in dumps:
+        for *row, diff in events:
+            net[tuple(row)] += diff
+    return {k: v for k, v in net.items() if v != 0}
+
+
+SORT_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    vals = json.load(open(os.path.join(tmp, f"input_{pid}.json")))
+    tbl = pw.debug.table_from_rows(pw.schema_builder({"a": int}), [(v,) for v in vals])
+    s = tbl.sort(tbl.a)
+    sort_rows, base_rows = [], []
+    pw.io.subscribe(
+        s,
+        lambda key, row, time, is_addition: sort_rows.append(
+            [str(key), str(row["prev"]), str(row["next"]), 1 if is_addition else -1]
+        ),
+    )
+    pw.io.subscribe(
+        tbl,
+        lambda key, row, time, is_addition: base_rows.append(
+            [str(key), row["a"], 1 if is_addition else -1]
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(
+        {"sort": sort_rows, "base": base_rows},
+        open(os.path.join(tmp, f"out_{pid}.json"), "w"),
+    )
+    """
+)
+
+
+def test_spawn_sort_exact_global_chain(tmp_path):
+    """sort at -n 2 centralizes on process 0 and must produce ONE global
+    prev/next chain in value order spanning both processes' rows."""
+    shards = {0: [30, 10, 50, 70], 1: [20, 60, 40, 80]}
+    for pid, vals in shards.items():
+        (tmp_path / f"input_{pid}.json").write_text(json.dumps(vals))
+    _spawn(2, SORT_PROG, tmp_path, 23000)
+
+    outs = [json.loads((tmp_path / f"out_{p}.json").read_text()) for p in range(2)]
+    # base rows surface per producing process: map key -> value
+    key_to_val: dict = {}
+    for o in outs:
+        for key, a, d in o["base"]:
+            assert d == 1
+            key_to_val[key] = a
+    assert sorted(key_to_val.values()) == sorted(v for s in shards.values() for v in s)
+
+    # sort output lands ONLY on the centralizing process
+    assert outs[1]["sort"] == [], "sort output leaked to a non-root process"
+    links = _merge_counting([o["sort"] for o in outs])
+    assert len(links) == len(key_to_val)
+    chain = {key: (prev, nxt) for key, prev, nxt in links}
+    heads = [k for k, (p, _) in chain.items() if p == "None"]
+    assert len(heads) == 1, f"expected one global chain, got heads {heads}"
+    walked = []
+    cur = heads[0]
+    while cur != "None":
+        walked.append(key_to_val[cur])
+        cur = chain[cur][1]
+    assert walked == sorted(key_to_val.values())
+
+
+WINDOW_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    rows = [tuple(r) for r in json.load(open(os.path.join(tmp, f"input_{pid}.json")))]
+    tbl = pw.debug.table_from_rows(
+        pw.schema_builder({"sensor": int, "t": int, "value": int}), rows, is_stream=True
+    )
+    win = tbl.windowby(
+        tbl.t,
+        window=pw.temporal.tumbling(duration=25),
+        instance=tbl.sensor,
+        behavior=pw.temporal.common_behavior(delay=5, cutoff=40, keep_results=True),
+    ).reduce(
+        sensor=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.value),
+        n=pw.reducers.count(),
+    )
+    got = []
+    pw.io.subscribe(
+        win,
+        lambda key, row, time, is_addition: got.append(
+            [row["sensor"], row["start"], row["total"], row["n"], 1 if is_addition else -1]
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(got, open(os.path.join(tmp, f"out_{pid}.json"), "w"))
+    """
+)
+
+
+def test_spawn_windowed_aggregation_with_behavior_exact(tmp_path):
+    """A behavior-backed windowed aggregation (buffer + forget centralize on
+    process 0, groupby re-exchanges) at -n 2 must equal the single-process run
+    on the merged stream."""
+    # (sensor, t, value, commit_time, diff): same commit schedule on both shards
+    shards = {
+        0: [
+            (0, 3, 1, 0, 1), (1, 7, 2, 0, 1),
+            (0, 30, 3, 2, 1), (1, 28, 4, 2, 1),
+            (0, 55, 5, 4, 1), (0, 2, 7, 4, 1),   # late row for window 0
+            (1, 80, 6, 6, 1),
+        ],
+        1: [
+            (1, 5, 10, 0, 1), (0, 12, 20, 0, 1),
+            (1, 33, 30, 2, 1), (0, 44, 40, 2, 1),
+            (1, 58, 50, 4, 1), (1, 4, 70, 4, 1),  # late row for window 0
+            (0, 77, 60, 6, 1),
+        ],
+    }
+    for pid, rows in shards.items():
+        (tmp_path / f"input_{pid}.json").write_text(json.dumps(rows))
+    _spawn(2, WINDOW_PROG, tmp_path, 23200)
+    outs = [json.loads((tmp_path / f"out_{p}.json").read_text()) for p in range(2)]
+    got = _merge_counting(outs)
+
+    # single-process truth on the merged stream (same commit schedule)
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+
+    pg.G.clear()
+    merged_rows = sorted(
+        shards[0] + shards[1], key=lambda r: r[3]
+    )  # by commit time; within-commit order is irrelevant to the window result
+    tbl = pw.debug.table_from_rows(
+        pw.schema_builder({"sensor": int, "t": int, "value": int}),
+        merged_rows,
+        is_stream=True,
+    )
+    win = tbl.windowby(
+        tbl.t,
+        window=pw.temporal.tumbling(duration=25),
+        instance=tbl.sensor,
+        behavior=pw.temporal.common_behavior(delay=5, cutoff=40, keep_results=True),
+    ).reduce(
+        sensor=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.value),
+        n=pw.reducers.count(),
+    )
+    expected_events: list = []
+    pw.io.subscribe(
+        win,
+        lambda key, row, time, is_addition: expected_events.append(
+            [row["sensor"], row["start"], row["total"], row["n"], 1 if is_addition else -1]
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    expected = _merge_counting([expected_events])
+    assert got == expected
+    assert got, "window produced no output at all"
+
+
+UPDATE_ROWS_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    data = json.load(open(os.path.join(tmp, f"input_{pid}.json")))
+    schema = pw.schema_builder({
+        "k": pw.column_definition(dtype=str, primary_key=True),
+        "v": pw.column_definition(dtype=int),
+    })
+    base = pw.debug.table_from_rows(schema, [tuple(r) for r in data["base"]])
+    patch = pw.debug.table_from_rows(schema, [tuple(r) for r in data["patch"]])
+    upd = base.update_rows(patch)
+    inter = base.intersect(patch)
+    u_rows, i_rows = [], []
+    pw.io.subscribe(
+        upd,
+        lambda key, row, time, is_addition: u_rows.append(
+            [row["k"], row["v"], 1 if is_addition else -1]
+        ),
+    )
+    pw.io.subscribe(
+        inter,
+        lambda key, row, time, is_addition: i_rows.append(
+            [row["k"], row["v"], 1 if is_addition else -1]
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(
+        {"update": u_rows, "intersect": i_rows},
+        open(os.path.join(tmp, f"out_{pid}.json"), "w"),
+    )
+    """
+)
+
+
+def test_spawn_update_rows_and_intersect_exact(tmp_path):
+    """update_rows/intersect at -n 2: base and patch rows for the SAME primary key
+    live on different processes — the rowkey exchange must bring them together."""
+    # keys deliberately split so base(k) and patch(k) never share a process
+    shards = {
+        0: {"base": [["a", 1], ["b", 2], ["c", 3]], "patch": [["d", 40]]},
+        1: {"base": [["d", 4], ["e", 5]], "patch": [["a", 10], ["e", 50], ["x", 99]]},
+    }
+    for pid, data in shards.items():
+        (tmp_path / f"input_{pid}.json").write_text(json.dumps(data))
+    _spawn(2, UPDATE_ROWS_PROG, tmp_path, 23400)
+    outs = [json.loads((tmp_path / f"out_{p}.json").read_text()) for p in range(2)]
+
+    got_update = _merge_counting([o["update"] for o in outs])
+    # global truth: patch wins per key; patch-only keys appear too
+    assert got_update == {
+        ("a", 10): 1, ("b", 2): 1, ("c", 3): 1, ("d", 40): 1, ("e", 50): 1, ("x", 99): 1,
+    }
+    got_inter = _merge_counting([o["intersect"] for o in outs])
+    # intersect keeps base rows whose key exists in patch (base values)
+    assert got_inter == {("a", 1): 1, ("d", 4): 1, ("e", 5): 1}
+
+    # each surviving key must be owned by exactly one process
+    for section in ("update", "intersect"):
+        owners: collections.Counter = collections.Counter()
+        for p, o in enumerate(outs):
+            for k, _v, d in o[section]:
+                if d > 0:
+                    owners[k] += 0  # touch
+        # ownership check via positive net per process
+        per_proc = [
+            {k for k, v in _merge_counting([o[section]]).items()} for o in outs
+        ]
+        assert not (set(per_proc[0]) & set(per_proc[1]))
+
+
+DEDUP_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    rows = [tuple(r) for r in json.load(open(os.path.join(tmp, f"input_{pid}.json")))]
+    tbl = pw.debug.table_from_rows(
+        pw.schema_builder({"k": str, "v": int}), rows, is_stream=True
+    )
+    ded = tbl.deduplicate(
+        value=pw.this.v, instance=pw.this.k, acceptor=lambda new, old: new > old
+    )
+    got = []
+    pw.io.subscribe(
+        ded,
+        lambda key, row, time, is_addition: got.append(
+            [row["k"], row["v"], 1 if is_addition else -1]
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(got, open(os.path.join(tmp, f"out_{pid}.json"), "w"))
+    """
+)
+
+
+def test_spawn_deduplicate_instance_routed(tmp_path):
+    """deduplicate at -n 2 routes rows to their instance's owner: the running max
+    per instance must see BOTH processes' rows (commit order fixes the outcome)."""
+    # commits strictly increase per instance so the accepted value is
+    # order-independent within the exchange merge
+    shards = {
+        0: [("a", 1, 0, 1), ("b", 9, 0, 1), ("a", 5, 2, 1), ("b", 3, 4, 1)],
+        1: [("a", 3, 0, 1), ("b", 2, 2, 1), ("a", 7, 4, 1)],
+    }
+    for pid, rows in shards.items():
+        (tmp_path / f"input_{pid}.json").write_text(json.dumps(rows))
+    _spawn(2, DEDUP_PROG, tmp_path, 23600)
+    outs = [json.loads((tmp_path / f"out_{p}.json").read_text()) for p in range(2)]
+    got = _merge_counting(outs)
+    # per instance: max over ALL rows (acceptor keeps increases only)
+    assert got == {("a", 7): 1, ("b", 9): 1}
+    # each instance's output is owned by exactly one process
+    per_proc = [set(_merge_counting([o])) for o in outs]
+    assert not (per_proc[0] & per_proc[1])
