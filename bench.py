@@ -199,11 +199,34 @@ def bench_embedder() -> dict:
     for start in range(0, len(texts), bs):
         enc.encode(texts[start : start + bs])
     dt = time.perf_counter() - t0
+
+    # analytic matmul FLOPs per PADDED token (the shapes actually executed):
+    # per layer qkv+out = 4h^2, ffn = 2*h*ffn, x2 for multiply-add; attention
+    # scores/values add 4*s*h per token. MFU is quoted against v5e peak bf16
+    # (197 TFLOP/s) — the chip this bench targets.
+    cfg = enc.config
+    mm_flops_per_token = 2 * cfg.num_layers * (
+        4 * cfg.hidden_size**2 + 2 * cfg.hidden_size * cfg.intermediate_size
+    )
+    total_flops = 0
+    for start in range(0, len(texts), bs):
+        ids, _m = enc._tokenize(texts[start : start + bs])
+        p2 = 8
+        while p2 < ids.shape[1]:
+            p2 *= 2
+        b2 = 8
+        while b2 < min(bs, len(texts) - start):
+            b2 *= 2
+        attn_flops_per_token = cfg.num_layers * 4 * p2 * cfg.hidden_size
+        total_flops += b2 * p2 * (mm_flops_per_token + attn_flops_per_token)
+    tflops = total_flops / dt / 1e12
     return {
         "embed_docs_per_s": round(len(texts) / dt, 1),
         "embed_tokens_per_s": round(n_tokens / dt, 1),
         "embed_host_tokenize_ms_per_batch": round(tok_s / (len(texts) / bs) * 1000, 2),
         "embed_dim": enc.dim,
+        "embed_tflops_per_s": round(tflops, 2),
+        "embed_mfu_pct_v5e": round(100.0 * tflops / 197.0, 2),
     }
 
 
@@ -658,6 +681,10 @@ def _probe_backend() -> tuple[str | None, str]:
             "scale — NOT comparable",
             "cpu (requested)",
         )
+    if not pool and "axon" not in platforms:
+        # no tunneled plugin in play: nothing can wedge, skip the probe cost
+        # (the driver compile check calls this on every entry invocation)
+        return None, "local (unprobed)"
     timeout = 120 if pool else 60
     rc, out = _run_with_deadline(
         [sys.executable, "-c",

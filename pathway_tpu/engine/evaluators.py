@@ -1742,6 +1742,120 @@ def _hashable_scalar(v: Any) -> Any:
     return v
 
 
+class SortedIndexEvaluator(Evaluator):
+    """Sorted binary tree per instance (reference ``stdlib/indexing/sorting.py:92``).
+
+    The reference grows a treap through ``pw.iterate`` rounds of ix/groupby; here
+    the engine holds each instance's rows sorted and rebuilds the tree for touched
+    instances per commit as a CARTESIAN TREE (one O(n) stack pass): in-order =
+    key order, heap order = per-row priority. Priorities are the rows' xxh3 key
+    fingerprints — deterministic, uniform, independent of arrival order, matching
+    the reference's hash-as-priority treap shape."""
+
+    CLUSTER_POLICIES = {0: "root"}  # global per-instance ordering, like sort
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self.rows: Dict[bytes, tuple] = {}  # kb -> (sort_val, instance, ptr, key)
+        self.emitted: Dict[bytes, tuple] = {}  # kb -> row tuple
+        # per-instance membership so a commit touches only its instances'
+        # rows, not the whole table (incrementality)
+        self.members: Dict[Any, Dict[bytes, tuple]] = defaultdict(dict)
+
+    @staticmethod
+    def _tree_links(ordered: List[tuple]) -> List[tuple]:
+        """(left, right, parent) per position for the cartesian tree of
+        ``ordered`` = [(priority, ptr), ...] in key order; min-priority root."""
+        n = len(ordered)
+        left = [None] * n
+        right = [None] * n
+        parent = [None] * n
+        stack: List[int] = []
+        for i in range(n):
+            dethroned = None
+            while stack and ordered[stack[-1]][0] > ordered[i][0]:
+                dethroned = stack.pop()
+            if dethroned is not None:
+                left[i] = ordered[dethroned][1]
+                parent[dethroned] = ordered[i][1]
+            if stack:
+                right[stack[-1]] = ordered[i][1]
+                parent[i] = ordered[stack[-1]][1]
+            stack.append(i)
+        return list(zip(left, right, parent))
+
+    def process(self, input_deltas: List[Delta]) -> Delta:
+        (delta,) = input_deltas
+        if len(delta) == 0:
+            return Delta.empty(self.output_columns)
+        table = self.node.inputs[0]
+        resolver = self._resolver_for(table, delta)
+        n = len(delta)
+        keys_vals = ee.evaluate(self.node.config["key"], n, resolver)
+        instance_e = self.node.config.get("instance")
+        instances = (
+            ee.evaluate(instance_e, n, resolver)
+            if instance_e is not None
+            else np.zeros(n, dtype=object)
+        )
+        ptrs = keys_to_pointers(delta.keys)
+        touched = set()
+        for i in range(n):
+            kb = delta.keys[i].tobytes()
+            old = self.rows.get(kb)
+            if old is not None:
+                self.members[_hashable_scalar(old[1])].pop(kb, None)
+                touched.add(_hashable_scalar(old[1]))
+            if delta.diffs[i] > 0:
+                entry = (keys_vals[i], instances[i], ptrs[i], delta.keys[i])
+                self.rows[kb] = entry
+                self.members[_hashable_scalar(instances[i])][kb] = entry
+            else:
+                self.rows.pop(kb, None)
+            touched.add(_hashable_scalar(instances[i]))
+
+        fresh: Dict[bytes, tuple] = {}
+        for hi in touched:
+            members = [
+                (sv, ptr, kb, key, inst)
+                for kb, (sv, inst, ptr, key) in self.members.get(hi, {}).items()
+            ]
+            members.sort(key=lambda r: (r[0], r[1]))
+            # priority = xxh3 fingerprint already inside the row key (lo word)
+            links = self._tree_links(
+                [(np.frombuffer(kb, dtype=KEY_DTYPE)[0]["lo"].item(), ptr) for _sv, ptr, kb, _k, _i in members]
+            )
+            for (sv, ptr, kb, key, inst), (lf, rt, par) in zip(members, links):
+                fresh[kb] = (key, {"key": sv, "left": lf, "right": rt, "parent": par, "instance": inst})
+
+        out_keys, out_diffs, out_rows = [], [], []
+        # removals come from the delta's negative rows, not a full emitted scan
+        for i in range(n):
+            if delta.diffs[i] >= 0:
+                continue
+            kb = delta.keys[i].tobytes()
+            if kb in self.rows:
+                continue  # replaced within this commit, not removed
+            old_row = self.emitted.pop(kb, None)
+            if old_row is not None:
+                out_keys.append(delta.keys[i])
+                out_diffs.append(-1)
+                out_rows.append(old_row)
+        for kb, (key, row) in fresh.items():
+            old = self.emitted.get(kb)
+            if old == row:
+                continue
+            if old is not None:
+                out_keys.append(key)
+                out_diffs.append(-1)
+                out_rows.append(old)
+            out_keys.append(key)
+            out_diffs.append(1)
+            out_rows.append(row)
+            self.emitted[kb] = row
+        return _delta_from_rows(out_keys, out_diffs, out_rows, self.output_columns)
+
+
 class RemoveErrorsEvaluator(Evaluator):
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
@@ -2338,6 +2452,7 @@ EVALUATORS: Dict[type, type] = {
     pg.FlattenNode: FlattenEvaluator,
     pg.IxNode: IxEvaluator,
     pg.SortNode: SortEvaluator,
+    pg.SortedIndexNode: SortedIndexEvaluator,
     pg.RemoveErrorsNode: RemoveErrorsEvaluator,
     pg.AsofNowUpdateNode: AsofNowEvaluator,
     pg.BufferNode: BufferEvaluator,

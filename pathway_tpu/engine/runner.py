@@ -173,21 +173,29 @@ class GraphRunner:
                 return found[0]
 
             # operators that move rows off their producing process (exchange,
-            # centralize, instance routing) — and everything downstream of one
-            _REPARTITION_KINDS = {
-                "groupby", "join", "update_rows", "update_cells", "intersect",
-                "difference", "restrict", "having", "with_universe_of",
-                "deduplicate", "sort", "buffer", "forget", "freeze",
-                "external_index",
-            }
+            # centralize, instance routing) — and everything downstream of one.
+            # Derived from the evaluator classes' cluster policies so a new
+            # policy-carrying evaluator can never be silently missed here.
+            from pathway_tpu.engine.evaluators import EVALUATORS, Evaluator
+
+            def _repartitions(node: pg.Node) -> bool:
+                if node.kind in ("groupby", "join"):
+                    return True
+                cls = EVALUATORS.get(type(node))
+                if cls is None:
+                    return False
+                return bool(cls.CLUSTER_POLICIES) or (
+                    cls.cluster_input_policy is not Evaluator.cluster_input_policy
+                )
+
             repartitioned: set = set()
             for node in self.graph.nodes:
-                if node.kind in _REPARTITION_KINDS or any(
+                if _repartitions(node) or any(
                     inp._node.id in repartitioned for inp in node.inputs
                 ):
                     repartitioned.add(node.id)
             for node in self.graph.nodes:
-                if node.kind in _REPARTITION_KINDS and cross_refs(node):
+                if _repartitions(node) and cross_refs(node):
                     raise NotImplementedError(
                         f"node {node.id} ({node.kind}) references another table's "
                         "materialized state; exchanged rows cannot resolve foreign "

@@ -104,6 +104,14 @@ class SortNode(Node):
     kind = "sort"
 
 
+class SortedIndexNode(Node):
+    """Sorted binary tree per instance (reference ``stdlib/indexing/sorting.py:92``
+    ``build_sorted_index`` — a treap with key-hash priorities). Emits one row per
+    input row with left/right/parent tree pointers."""
+
+    kind = "sorted_index"
+
+
 class OutputNode(Node):
     """A sink: subscribe callback, io writer, or debug capture."""
 

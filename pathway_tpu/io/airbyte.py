@@ -217,6 +217,9 @@ class _AirbyteSubject:
                         raise RuntimeError(f"airbyte source error: {err}")
                 # LOG / CATALOG / CONNECTION_STATUS messages are ignored here
         finally:
+            # stop() may have terminated the child while the read loop was
+            # blocked: EOF ends the loop without executing its _stop check
+            stopped = stopped or self._stop
             if failed or stopped:
                 # stop reading mid-stream: kill the child or wait() deadlocks on
                 # its blocked stdout writes (and a docker container would leak)

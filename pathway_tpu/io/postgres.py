@@ -2,12 +2,13 @@
 
 Parity: reference ``io/postgres`` over the Psql writer (``src/connectors/data_storage.rs:1080``)
 with the ``PsqlUpdates``/``PsqlSnapshot`` formatters (``data_format.rs:1625,1684``).
-Statement generation is pure (testable without a server); execution needs psycopg2/pg8000.
+Statement generation is pure (testable without a server); execution needs psycopg2/pg8000
+or an injected ``_connection_factory`` (any DB-API connection).
 """
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any, Callable, Sequence
 
 from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals.parse_graph import G
@@ -37,18 +38,22 @@ def updates_statement(table_name: str, row: dict, time: int, diff: int) -> tuple
 
 
 def snapshot_statement(
-    table_name: str, primary_key: Sequence[str], row: dict, diff: int
+    table_name: str, primary_key: Sequence[str], row: dict, time: int, diff: int
 ) -> tuple[str, Sequence[Any]]:
-    """Upsert/delete keeping only the current snapshot — the ``PsqlSnapshot`` format."""
+    """Upsert/delete keeping only the current snapshot — the ``PsqlSnapshot``
+    format (reference ``data_format.rs:1684``: inserts carry (time, diff) and
+    upsert on the primary key; deletions remove the key's row)."""
     if diff > 0:
-        cols = list(row.keys())
+        cols = [*row.keys(), "time", "diff"]
         placeholders = ", ".join(["%s"] * len(cols))
-        updates = ", ".join(f"{c}=EXCLUDED.{c}" for c in cols if c not in primary_key)
+        updates = ", ".join(
+            f"{c}=EXCLUDED.{c}" for c in cols if c not in primary_key
+        )
         sql = (
             f'INSERT INTO {table_name} ({", ".join(cols)}) VALUES ({placeholders}) '
             f'ON CONFLICT ({", ".join(primary_key)}) DO UPDATE SET {updates}'
         )
-        return sql, [_sql_value(v) for v in row.values()]
+        return sql, [*(_sql_value(v) for v in row.values()), time, diff]
     conds = " AND ".join(f"{c}=%s" for c in primary_key)
     sql = f"DELETE FROM {table_name} WHERE {conds}"
     return sql, [_sql_value(row[c]) for c in primary_key]
@@ -67,7 +72,8 @@ def _connect(postgres_settings: dict) -> Any:
         return pg8000.dbapi.connect(**postgres_settings)
     except ImportError:
         raise ImportError(
-            "no PostgreSQL driver (psycopg2 / pg8000) is available in this environment"
+            "no PostgreSQL driver (psycopg2 / pg8000) is available in this "
+            "environment; pass _connection_factory=... (any DB-API connection)"
         )
 
 
@@ -82,24 +88,63 @@ _PG_TYPES = {
 }
 
 
-def create_table_statement(table: Table, table_name: str, *, extra_columns: Sequence[str] = ()) -> str:
+def create_table_statement(table: Table, table_name: str, *, extra_columns: Sequence[str] = (), primary_key: Sequence[str] = ()) -> str:
     cols = []
     for name, column in table.schema.columns().items():
         base = column.dtype.strip_optional()
         cols.append(f"{name} {_PG_TYPES.get(repr(base).upper(), 'TEXT')}")
     cols.extend(extra_columns)
+    if primary_key:
+        cols.append(f'PRIMARY KEY ({", ".join(primary_key)})')
     return f'CREATE TABLE IF NOT EXISTS {table_name} ({", ".join(cols)})'
 
 
-def _apply_init_mode(connection: Any, cursor: Any, table: Table, table_name: str, init_mode: str, extra: Sequence[str]) -> None:
+def _apply_init_mode(
+    connection: Any,
+    cursor: Any,
+    table: Table,
+    table_name: str,
+    init_mode: str,
+    extra: Sequence[str],
+    primary_key: Sequence[str] = (),
+) -> None:
     if init_mode == "default":
         return
     if init_mode not in ("create_if_not_exists", "replace"):
         raise ValueError(f"unsupported init_mode {init_mode!r}")
     if init_mode == "replace":
         cursor.execute(f"DROP TABLE IF EXISTS {table_name}")
-    cursor.execute(create_table_statement(table, table_name, extra_columns=extra))
+    cursor.execute(
+        create_table_statement(
+            table, table_name, extra_columns=extra, primary_key=primary_key
+        )
+    )
     connection.commit()
+
+
+class _BatchingExecutor:
+    """Commit every ``max_batch_size`` statements (reference
+    ``max_batch_size``: bounds entries per transaction); ``flush`` commits the
+    tail at stream end."""
+
+    def __init__(self, connection: Any, max_batch_size: int | None):
+        self.connection = connection
+        self.cursor = connection.cursor()
+        self.max_batch_size = max_batch_size
+        self._pending = 0
+
+    def execute(self, sql: str, params: Sequence[Any]) -> None:
+        self.cursor.execute(sql, params)
+        self._pending += 1
+        if self.max_batch_size is None or self._pending >= self.max_batch_size:
+            self.connection.commit()
+            self._pending = 0
+
+    def close(self) -> None:
+        if self._pending:
+            self.connection.commit()
+            self._pending = 0
+        self.connection.close()
 
 
 def write(
@@ -109,22 +154,22 @@ def write(
     *,
     max_batch_size: int | None = None,
     init_mode: str = "default",
+    _connection_factory: Callable[[dict], Any] | None = None,
     **kwargs: Any,
 ) -> None:
     """Stream updates as ``(…, time, diff)`` INSERTs (reference ``io/postgres.write``)."""
-    connection = _connect(postgres_settings)
-    cursor = connection.cursor()
+    connection = (_connection_factory or _connect)(postgres_settings)
+    executor = _BatchingExecutor(connection, max_batch_size)
     _apply_init_mode(
-        connection, cursor, table, table_name, init_mode, ("time BIGINT", "diff BIGINT")
+        connection, executor.cursor, table, table_name, init_mode, ("time BIGINT", "diff BIGINT")
     )
 
     def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
         sql, params = updates_statement(table_name, row, time, 1 if is_addition else -1)
-        cursor.execute(sql, params)
-        connection.commit()
+        executor.execute(sql, params)
 
     G.add_node(
-        pg.OutputNode(inputs=[table], callback=callback, on_end=connection.close)
+        pg.OutputNode(inputs=[table], callback=callback, on_end=executor.close)
     )
 
 
@@ -133,17 +178,38 @@ def write_snapshot(
     postgres_settings: dict,
     table_name: str,
     primary_key: Sequence[str],
+    *,
+    max_batch_size: int | None = None,
+    init_mode: str = "default",
+    _connection_factory: Callable[[dict], Any] | None = None,
     **kwargs: Any,
 ) -> None:
-    """Maintain the current snapshot via upserts/deletes (reference ``write_snapshot``)."""
-    connection = _connect(postgres_settings)
-    cursor = connection.cursor()
+    """Maintain the current snapshot via upserts/deletes (reference
+    ``write_snapshot`` over the ``PsqlSnapshot`` formatter)."""
+    missing = [c for c in primary_key if c not in table.column_names()]
+    if missing:
+        raise ValueError(
+            f"write_snapshot: primary key column(s) {missing} not in table "
+            f"columns {table.column_names()}"
+        )
+    connection = (_connection_factory or _connect)(postgres_settings)
+    executor = _BatchingExecutor(connection, max_batch_size)
+    _apply_init_mode(
+        connection,
+        executor.cursor,
+        table,
+        table_name,
+        init_mode,
+        ("time BIGINT", "diff BIGINT"),
+        primary_key=primary_key,
+    )
 
     def callback(key: Any, row: dict, time: int, is_addition: bool) -> None:
-        sql, params = snapshot_statement(table_name, primary_key, row, 1 if is_addition else -1)
-        cursor.execute(sql, params)
-        connection.commit()
+        sql, params = snapshot_statement(
+            table_name, primary_key, row, time, 1 if is_addition else -1
+        )
+        executor.execute(sql, params)
 
     G.add_node(
-        pg.OutputNode(inputs=[table], callback=callback, on_end=connection.close)
+        pg.OutputNode(inputs=[table], callback=callback, on_end=executor.close)
     )

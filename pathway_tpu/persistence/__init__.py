@@ -11,6 +11,8 @@ from __future__ import annotations
 import os
 from typing import Any, List
 
+from pathway_tpu.persistence.cached_objects import CachedObjectStorage  # noqa: F401
+
 
 class Backend:
     kind = "none"

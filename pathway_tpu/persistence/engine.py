@@ -141,6 +141,18 @@ class PersistenceManager:
             self._journal_file.close()
             self._journal_file = None
 
+    def cached_objects(self) -> Any:
+        """The pipeline's durable URI -> (blob, metadata) store (reference
+        ``cached_object_storage.rs:377``), rooted under this manager's backend
+        directory; in-memory under mock/memory backends."""
+        from pathway_tpu.persistence.cached_objects import CachedObjectStorage
+
+        cache = getattr(self, "_cached_objects", None)
+        if cache is None:
+            cache = CachedObjectStorage(None if self._memory else self.root)
+            self._cached_objects = cache
+        return cache
+
     # -- operator snapshots (reference ``operator_snapshot.rs`` + compaction) --
 
     def dump_checkpoint(self, graph_sig: str, commit_id: int, blob: dict) -> None:

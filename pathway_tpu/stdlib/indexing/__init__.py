@@ -19,6 +19,12 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     default_usearch_knn_document_index,
 )
 from pathway_tpu.stdlib.indexing.retrievers import AbstractRetrieverFactory
+from pathway_tpu.stdlib.indexing.sorting import (
+    SortedIndex,
+    build_sorted_index,
+    retrieve_prev_next_values,
+    sort_from_index,
+)
 from pathway_tpu.stdlib.indexing.vector_document_index import (
     default_vector_document_index,
 )
@@ -39,6 +45,10 @@ __all__ = [
     "IvfKnnFactory",
     "LshKnn",
     "LshKnnFactory",
+    "SortedIndex",
+    "build_sorted_index",
+    "retrieve_prev_next_values",
+    "sort_from_index",
     "TantivyBM25",
     "TantivyBM25Factory",
     "USearchKnn",

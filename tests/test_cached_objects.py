@@ -1,0 +1,306 @@
+"""Cached-object storage (reference ``persistence/cached_object_storage.rs:377``)
+and the connector behavior it exists for: resume without refetching unchanged
+objects (VERDICT r3 item 10) — plus the snapshot-mode postgres sink."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.persistence.cached_objects import CachedObjectStorage
+
+
+# -- CachedObjectStorage unit behavior ---------------------------------------
+
+
+def test_place_lookup_remove(tmp_path):
+    cache = CachedObjectStorage(tmp_path)
+    v1 = cache.place_object("s3://b/a.csv", b"one", {"etag": "e1", "size": 3})
+    v2 = cache.place_object("s3://b/b.csv", b"two", {"etag": "e2"})
+    assert (v1, v2) == (1, 2)
+    assert cache.contains_object("s3://b/a.csv")
+    assert cache.get_object("s3://b/a.csv") == b"one"
+    assert cache.get_metadata("s3://b/a.csv") == {"etag": "e1", "size": 3}
+    assert cache.actual_key_set() == {"s3://b/a.csv", "s3://b/b.csv"}
+
+    cache.place_object("s3://b/a.csv", b"one-v2", {"etag": "e3"})
+    assert cache.get_object("s3://b/a.csv") == b"one-v2"
+    cache.remove_object("s3://b/b.csv")
+    assert not cache.contains_object("s3://b/b.csv")
+    assert cache.actual_key_set() == {"s3://b/a.csv"}
+
+
+def test_state_survives_restart(tmp_path):
+    cache = CachedObjectStorage(tmp_path)
+    cache.place_object("u1", b"alpha", {"m": 1})
+    cache.place_object("u2", b"beta", {"m": 2})
+    cache.remove_object("u1")
+    reopened = CachedObjectStorage(tmp_path)
+    assert reopened.actual_key_set() == {"u2"}
+    assert reopened.get_object("u2") == b"beta"
+    assert reopened.get_metadata("u2") == {"m": 2}
+    # appends continue after the surviving max version
+    v = reopened.place_object("u3", b"gamma")
+    assert v > 3
+
+
+def test_rewind_drops_newer_events_durably(tmp_path):
+    cache = CachedObjectStorage(tmp_path)
+    cache.place_object("u", b"v1", {"rev": 1})  # version 1
+    cache.place_object("u", b"v2", {"rev": 2})  # version 2
+    cache.place_object("w", b"w1", {"rev": 1})  # version 3
+    cache.rewind(2)
+    assert cache.get_metadata("u") == {"rev": 2}
+    assert not cache.contains_object("w")
+    # durably: a reload sees the rewound state, not the dropped events
+    reopened = CachedObjectStorage(tmp_path)
+    assert reopened.actual_key_set() == {"u"}
+    assert reopened.get_object("u") == b"v2"
+    # rewind(0) clears everything
+    reopened.rewind(0)
+    assert reopened.actual_key_set() == set()
+    assert CachedObjectStorage(tmp_path).actual_key_set() == set()
+
+
+def test_memory_backend_roundtrip():
+    cache = CachedObjectStorage(None)
+    cache.place_object("u", b"x", {"a": 1})
+    assert cache.get_object("u") == b"x"
+    cache.rewind(0)
+    assert not cache.contains_object("u")
+
+
+def test_manager_accessor(tmp_path):
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(tmp_path / "store"))
+    mgr = PersistenceManager(cfg)
+    cache = mgr.cached_objects()
+    cache.place_object("u", b"x")
+    assert mgr.cached_objects() is cache  # one instance per manager
+    assert (tmp_path / "store").exists()
+
+
+# -- resume without refetch ---------------------------------------------------
+
+
+class CountingS3Client:
+    """Minimal boto3 surface counting get_object calls per key."""
+
+    def __init__(self, objects: dict[str, bytes]):
+        self.objects = dict(objects)
+        self.fetches: dict[str, int] = {}
+
+    def list_objects_v2(self, Bucket, Prefix, ContinuationToken=None):
+        import hashlib
+
+        keys = sorted(k for k in self.objects if k.startswith(Prefix))
+        return {
+            "Contents": [
+                {
+                    "Key": k,
+                    "ETag": hashlib.md5(self.objects[k]).hexdigest(),
+                    "Size": len(self.objects[k]),
+                }
+                for k in keys
+            ],
+            "IsTruncated": False,
+        }
+
+    def get_object(self, Bucket, Key):
+        self.fetches[Key] = self.fetches.get(Key, 0) + 1
+
+        class Body:
+            def __init__(self, data):
+                self._data = data
+
+            def read(self):
+                return self._data
+
+        return {"Body": Body(self.objects[Key])}
+
+
+def _run_s3_pipeline(client, store) -> dict:
+    pg.G.clear()
+    t = pw.io.s3.read(
+        "s3://bucket/d/",
+        format="json",
+        schema=pw.schema_builder({"v": int}),
+        mode="static",
+        _client_factory=lambda settings: client,
+    )
+    counts = t.groupby(t.v).reduce(t.v, n=pw.reducers.count())
+    got: dict = {}
+    pw.io.subscribe(
+        counts,
+        lambda key, row, time, is_addition: got.__setitem__(row["v"], row["n"])
+        if is_addition
+        else got.pop(row["v"], None),
+    )
+    cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(store))
+    pw.run(persistence_config=cfg, monitoring_level=pw.MonitoringLevel.NONE)
+    return got
+
+
+def test_s3_resume_does_not_refetch_unchanged_objects(tmp_path):
+    """Second run over the same persistence store must not re-download objects
+    whose ETag is unchanged — the reference pins them in cached object storage;
+    here the journaled per-object state deltas carry the parsed rows."""
+    objects = {"d/a.jsonl": b'{"v": 1}\n{"v": 1}\n', "d/b.jsonl": b'{"v": 2}\n'}
+    client = CountingS3Client(objects)
+    store = tmp_path / "store"
+
+    got = _run_s3_pipeline(client, store)
+    assert got == {1: 2, 2: 1}
+    assert client.fetches == {"d/a.jsonl": 1, "d/b.jsonl": 1}
+
+    got = _run_s3_pipeline(client, store)
+    assert got == {1: 2, 2: 1}
+    assert client.fetches == {"d/a.jsonl": 1, "d/b.jsonl": 1}, (
+        "resume refetched unchanged objects"
+    )
+
+    # a changed object IS refetched (and only it) — streaming resume notices the
+    # new ETag on its rescan; static runs conclude from restored offsets
+    client.objects["d/b.jsonl"] = b'{"v": 3}\n'
+    pg.G.clear()
+    t = pw.io.s3.read(
+        "s3://bucket/d/",
+        format="json",
+        schema=pw.schema_builder({"v": int}),
+        mode="streaming",
+        autocommit_duration_ms=10,
+        _client_factory=lambda settings: client,
+    )
+    counts = t.groupby(t.v).reduce(t.v, n=pw.reducers.count())
+    got = {}
+    pw.io.subscribe(
+        counts,
+        lambda key, row, time, is_addition: got.__setitem__(row["v"], row["n"])
+        if is_addition
+        else got.pop(row["v"], None),
+    )
+    from pathway_tpu.engine.runner import GraphRunner
+
+    runner = GraphRunner(pg.G._current)
+    cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(store))
+    runner.setup(monitoring_level=pw.MonitoringLevel.NONE, persistence_config=cfg)
+    import time as time_mod
+
+    deadline = time_mod.monotonic() + 20
+    while time_mod.monotonic() < deadline and got != {1: 2, 3: 1}:
+        runner.step()
+        time_mod.sleep(0.02)
+    assert got == {1: 2, 3: 1}
+    assert client.fetches == {"d/a.jsonl": 1, "d/b.jsonl": 2}, (
+        "only the changed object may be refetched"
+    )
+
+
+# -- snapshot-mode postgres sink ----------------------------------------------
+
+
+class FakeCursor:
+    def __init__(self, log):
+        self.log = log
+
+    def execute(self, sql, params=None):
+        self.log.append(("execute", sql, list(params or [])))
+
+
+class FakeConnection:
+    def __init__(self):
+        self.log: list = []
+        self.closed = False
+
+    def cursor(self):
+        return FakeCursor(self.log)
+
+    def commit(self):
+        self.log.append(("commit",))
+
+    def close(self):
+        self.closed = True
+
+
+def test_postgres_write_snapshot_end_to_end():
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        word | n | __time__ | __diff__
+        cat  | 1 | 0        | 1
+        dog  | 2 | 0        | 1
+        cat  | 1 | 2        | -1
+        cat  | 5 | 2        | 1
+        dog  | 2 | 4        | -1
+        """
+    )
+    conn = FakeConnection()
+    pw.io.postgres.write_snapshot(
+        t,
+        {},
+        "tbl",
+        ["word"],
+        init_mode="create_if_not_exists",
+        _connection_factory=lambda settings: conn,
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    executes = [e for e in conn.log if e[0] == "execute"]
+    create = executes[0][1]
+    assert create.startswith("CREATE TABLE IF NOT EXISTS tbl")
+    assert "PRIMARY KEY (word)" in create and "time BIGINT" in create
+
+    upserts = [e for e in executes if e[1].startswith("INSERT")]
+    deletes = [e for e in executes if e[1].startswith("DELETE")]
+    assert all("ON CONFLICT (word) DO UPDATE" in e[1] for e in upserts)
+    # final state reachable from the statement stream: replay it
+    state: dict = {}
+    for e in executes[1:]:
+        if e[1].startswith("INSERT"):
+            word, n, _time, _diff = e[2]
+            state[word] = n
+        elif e[1].startswith("DELETE"):
+            state.pop(e[2][0], None)
+    assert state == {"cat": 5}
+    assert deletes, "retraction without replacement must DELETE"
+    assert conn.closed
+
+
+def test_postgres_write_snapshot_batching():
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        word | n
+        a    | 1
+        b    | 2
+        c    | 3
+        d    | 4
+        """
+    )
+    conn = FakeConnection()
+    pw.io.postgres.write_snapshot(
+        t, {}, "tbl", ["word"], max_batch_size=3,
+        _connection_factory=lambda settings: conn,
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    # 4 statements with batch size 3 -> a commit after 3, then the tail commit
+    kinds = [e[0] for e in conn.log]
+    assert kinds.count("commit") >= 2
+    first_commit = kinds.index("commit")
+    assert kinds[:first_commit].count("execute") == 3
+
+
+def test_postgres_write_snapshot_rejects_unknown_key():
+    pg.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        word | n
+        a    | 1
+        """
+    )
+    with pytest.raises(ValueError, match="primary key"):
+        pw.io.postgres.write_snapshot(
+            t, {}, "tbl", ["nope"], _connection_factory=lambda s: FakeConnection()
+        )

@@ -156,11 +156,13 @@ def test_postgres_statement_generation():
     assert sql == "INSERT INTO t (word, n, time, diff) VALUES (%s, %s, %s, %s)"
     assert params == ["cat", 1, 4, 1]
 
-    sql, params = snapshot_statement("t", ["word"], {"word": "cat", "n": 2}, 1)
+    sql, params = snapshot_statement("t", ["word"], {"word": "cat", "n": 2}, 6, 1)
+    # snapshot inserts carry (time, diff) like the reference PsqlSnapshot format
     assert "ON CONFLICT (word) DO UPDATE SET n=EXCLUDED.n" in sql
-    assert params == ["cat", 2]
+    assert "time=EXCLUDED.time" in sql and "diff=EXCLUDED.diff" in sql
+    assert params == ["cat", 2, 6, 1]
 
-    sql, params = snapshot_statement("t", ["word"], {"word": "cat", "n": 2}, -1)
+    sql, params = snapshot_statement("t", ["word"], {"word": "cat", "n": 2}, 6, -1)
     assert sql == "DELETE FROM t WHERE word=%s"
     assert params == ["cat"]
 

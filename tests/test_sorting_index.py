@@ -1,0 +1,235 @@
+"""Sorted-index subsystem tests, mirroring the reference's
+``python/pathway/tests/test_sorting.py`` plus the tree/retrieval APIs
+(``stdlib/indexing/sorting.py``): build_sorted_index structure invariants,
+sort_from_index on arbitrary trees, retrieve_prev_next_values chains, and
+incremental updates."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.internals import parse_graph as pg
+from pathway_tpu.stdlib.indexing import (
+    build_sorted_index,
+    retrieve_prev_next_values,
+    sort_from_index,
+)
+
+
+def _rows(table) -> dict:
+    captured = {}
+    pw.io.subscribe(
+        table,
+        lambda key, row, time, is_addition: (
+            captured.__setitem__(key, dict(row))
+            if is_addition
+            else captured.pop(key, None)
+        ),
+    )
+    from pathway_tpu.engine.runner import GraphRunner
+
+    GraphRunner(pg.G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
+    return captured
+
+
+def setup_function(_fn):
+    pg.G.clear()
+
+
+def test_prevnext_single_instance():
+    # reference test_sorting.py::test_prevnext_single_instance
+    nodes = pw.debug.table_from_markdown(
+        """
+          | key | instance
+        1 |  1  | 42
+        2 |  5  | 42
+        3 |  3  | 42
+        4 |  8  | 42
+        5 |  2  | 42
+        """
+    )
+    result = nodes.sort(key=nodes.key, instance=nodes.instance)
+    got = _rows(result.select(k=nodes.key, prev=result.prev, next=result.next))
+    key_of_ptr = {}
+    for ptr, r in got.items():
+        key_of_ptr[str(ptr)] = r["k"]
+    chain = {
+        r["k"]: (
+            key_of_ptr.get(str(r["prev"])) if r["prev"] is not None else None,
+            key_of_ptr.get(str(r["next"])) if r["next"] is not None else None,
+        )
+        for r in got.values()
+    }
+    assert chain == {
+        1: (None, 2),
+        2: (1, 3),
+        3: (2, 5),
+        5: (3, 8),
+        8: (5, None),
+    }
+
+
+def test_prevnext_many_instances():
+    nodes = pw.debug.table_from_markdown(
+        """
+          | key | instance
+        1 |  1  | 42
+        2 |  1  | 28
+        3 |  5  | 42
+        4 |  5  | 28
+        5 |  3  | 42
+        6 |  3  | 28
+        """
+    )
+    result = nodes.sort(key=nodes.key, instance=nodes.instance)
+    got = _rows(
+        result.select(k=nodes.key, inst=nodes.instance, prev=result.prev, next=result.next)
+    )
+    key_of_ptr = {str(ptr): (r["inst"], r["k"]) for ptr, r in got.items()}
+    for r in got.values():
+        for col in ("prev", "next"):
+            if r[col] is not None:
+                inst, _k = key_of_ptr[str(r[col])]
+                assert inst == r["inst"], "chain crossed instances"
+    chains = {}
+    for r in got.values():
+        chains.setdefault(r["inst"], {})[r["k"]] = (
+            key_of_ptr[str(r["prev"])][1] if r["prev"] is not None else None,
+            key_of_ptr[str(r["next"])][1] if r["next"] is not None else None,
+        )
+    for inst in (42, 28):
+        assert chains[inst] == {1: (None, 3), 3: (1, 5), 5: (3, None)}
+
+
+def _tree_invariants(index_rows: dict) -> None:
+    """Structural invariants of a sorted binary tree emitted by build_sorted_index."""
+    by_ptr = {str(ptr): r for ptr, r in index_rows.items()}
+    roots = [p for p, r in by_ptr.items() if r["parent"] is None]
+    instances = {r["instance"] for r in by_ptr.values()}
+    assert len(roots) == len(instances), "one root per instance"
+    for p, r in by_ptr.items():
+        for side, cmp in (("left", -1), ("right", 1)):
+            child = r[side]
+            if child is None:
+                continue
+            c = by_ptr[str(child)]
+            assert c["instance"] == r["instance"]
+            assert str(c["parent"]) == p, "child's parent pointer must point back"
+            if cmp < 0:
+                assert c["key"] < r["key"]
+            else:
+                assert c["key"] > r["key"]
+
+
+def test_build_sorted_index_structure_and_oracle():
+    nodes = pw.debug.table_from_markdown(
+        """
+          | key | instance
+        1 |  4  | 0
+        2 |  1  | 0
+        3 |  9  | 0
+        4 |  6  | 0
+        5 |  2  | 1
+        6 |  8  | 1
+        """
+    )
+    si = build_sorted_index(nodes)
+    index_rows = _rows(si["index"])
+    _tree_invariants(index_rows)
+    pg.G.clear()
+    nodes = pw.debug.table_from_markdown(
+        """
+          | key | instance
+        1 |  4  | 0
+        2 |  1  | 0
+        5 |  2  | 1
+        """
+    )
+    si = build_sorted_index(nodes)
+    oracle_rows = _rows(si["oracle"])
+    assert {r["instance"] for r in oracle_rows.values()} == {0, 1}
+
+
+def test_sort_from_index_matches_native_sort():
+    """In-order traversal of the built tree == the engine's native sort order."""
+    nodes = pw.debug.table_from_markdown(
+        """
+          | key | instance
+        1 |  10 | 7
+        2 |  3  | 7
+        3 |  7  | 7
+        4 |  1  | 7
+        5 |  5  | 7
+        6 |  12 | 7
+        """
+    )
+    si = build_sorted_index(nodes)
+    pn = sort_from_index(si["index"])
+    got = _rows(pn.select(k=nodes.key, prev=pn.prev, next=pn.next))
+    key_of_ptr = {str(ptr): r["k"] for ptr, r in got.items()}
+    heads = [r for r in got.values() if r["prev"] is None]
+    assert len(heads) == 1
+    walked, cur = [], heads[0]
+    while True:
+        walked.append(cur["k"])
+        if cur["next"] is None:
+            break
+        nxt = key_of_ptr[str(cur["next"])]
+        cur = next(r for r in got.values() if r["k"] == nxt)
+    assert walked == [1, 3, 5, 7, 10, 12]
+
+
+def test_retrieve_prev_next_values_chain():
+    # reference sorting.py:183 semantics: pointer to the nearest row (incl.
+    # itself) with a non-None value, along prev/next order
+    ordered = pw.debug.table_from_markdown(
+        """
+          | t | value
+        1 | 1 |
+        2 | 2 | 20.0
+        3 | 3 |
+        4 | 4 |
+        5 | 5 | 50.0
+        6 | 6 |
+        """
+    )
+    s = ordered.sort(ordered.t)
+    chained = ordered.select(prev=s.prev, next=s.next, value=ordered.value)
+    got = _rows(
+        retrieve_prev_next_values(chained).select(
+            t=ordered.t, prev_value=pw.this.prev_value, next_value=pw.this.next_value
+        )
+    )
+    t_of_ptr = {str(ptr): r["t"] for ptr, r in got.items()}
+    resolved = {
+        r["t"]: (
+            t_of_ptr.get(str(r["prev_value"])) if r["prev_value"] is not None else None,
+            t_of_ptr.get(str(r["next_value"])) if r["next_value"] is not None else None,
+        )
+        for r in got.values()
+    }
+    assert resolved == {
+        1: (None, 2),
+        2: (2, 2),
+        3: (2, 5),
+        4: (2, 5),
+        5: (5, 5),
+        6: (5, None),
+    }
+
+
+def test_sorted_index_incremental_updates():
+    """Streamed inserts + a retraction: the tree restructures and stays valid."""
+    nodes = pw.debug.table_from_markdown(
+        """
+        key | instance | __time__ | __diff__
+        4   | 0        | 0        | 1
+        1   | 0        | 0        | 1
+        9   | 0        | 2        | 1
+        6   | 0        | 4        | 1
+        1   | 0        | 6        | -1
+        """
+    )
+    si = build_sorted_index(nodes)
+    index_rows = _rows(si["index"])
+    _tree_invariants(index_rows)
+    assert sorted(r["key"] for r in index_rows.values()) == [4, 6, 9]
