@@ -204,6 +204,8 @@ def bench_embedder() -> dict:
     # per layer qkv+out = 4h^2, ffn = 2*h*ffn, x2 for multiply-add; attention
     # scores/values add 4*s*h per token. MFU is quoted against v5e peak bf16
     # (197 TFLOP/s) — the chip this bench targets.
+    from pathway_tpu.models.encoder import _next_pow2
+
     cfg = enc.config
     mm_flops_per_token = 2 * cfg.num_layers * (
         4 * cfg.hidden_size**2 + 2 * cfg.hidden_size * cfg.intermediate_size
@@ -211,12 +213,9 @@ def bench_embedder() -> dict:
     total_flops = 0
     for start in range(0, len(texts), bs):
         ids, _m = enc._tokenize(texts[start : start + bs])
-        p2 = 8
-        while p2 < ids.shape[1]:
-            p2 *= 2
-        b2 = 8
-        while b2 < min(bs, len(texts) - start):
-            b2 *= 2
+        # the same bucketing encode_device applies — the shapes actually executed
+        p2 = _next_pow2(ids.shape[1])
+        b2 = _next_pow2(min(bs, len(texts) - start))
         attn_flops_per_token = cfg.num_layers * 4 * p2 * cfg.hidden_size
         total_flops += b2 * p2 * (mm_flops_per_token + attn_flops_per_token)
     tflops = total_flops / dt / 1e12
@@ -256,6 +255,15 @@ def bench_vector_store(port: int = 18715) -> dict:
     # compiled shape for every ingest batch; cold-start XLA compilation is a
     # per-process constant, not a per-document cost)
     embedder.encoder.encode(["warm up"] * (64 if DEVICE_SCALE_DOWN else 1024))
+    # single-query model cost, measured BEFORE the server's commit loop can
+    # compete for the host (decomposes query p50 into embed vs engine+REST)
+    embed_times = []
+    embedder.encoder.encode(["warm single"])
+    for _ in range(10):
+        t1 = time.perf_counter()
+        embedder.encoder.encode(["a single query string"])
+        embed_times.append(time.perf_counter() - t1)
+    embed_ms = float(np.median(embed_times)) * 1000.0
     server = VectorStoreServer(doc_table, embedder=embedder)
     t_start = time.perf_counter()
     server.run_server(host="127.0.0.1", port=port, threaded=True, terminate_on_error=False)
@@ -309,12 +317,18 @@ def bench_vector_store(port: int = 18715) -> dict:
         rtts.append(time.perf_counter() - t1)
     rtt_ms = float(np.median(rtts)) * 1000.0
     p50_ms = float(np.median(lat)) * 1000.0
+    # decomposition: the single-query model forward (embed_ms, measured above
+    # pre-server) vs everything else (REST + engine + search). An instant-
+    # embedder probe puts the non-embed share at ~7 ms on CPU — the 15 ms
+    # BASELINE p50 target is the embed cost plus this floor.
     return {
         "vs_ingest_docs_per_s": round(n_docs / ingest_s, 1),
         "vs_query_p50_ms": round(p50_ms, 2),
         "vs_query_p95_ms": round(float(np.percentile(lat, 95)) * 1000.0, 2),
         "device_roundtrip_p50_ms": round(rtt_ms, 2),
         "vs_query_p50_minus_rtt_ms": round(p50_ms - rtt_ms, 2),
+        "vs_query_embed1_ms": round(embed_ms, 2),
+        "vs_query_nonembed_ms": round(p50_ms - embed_ms, 2),
     }
 
 
@@ -552,6 +566,142 @@ def bench_engine() -> dict:
     }
 
 
+def bench_scale() -> dict:
+    """Honest at-scale run (BASELINE north star): ~10M x 384 vectors with REAL
+    MiniLM embedding geometry through ingest -> index -> query.
+
+    Corpus construction is reported in the keys, not hidden: ``scale_real_docs``
+    texts are embedded with the production encoder; the remainder is
+    manifold-sampled from those embeddings (real vector + gaussian noise at 25%
+    of the measured mean nearest-neighbor distance, re-normalized) — the
+    distribution ANN indexes face, unlike gaussian-cluster toys. Vectors are
+    stored bfloat16 so the full corpus fits one v5e chip's HBM (10M x 384 x 2B
+    = 7.7 GB); recall@10 is IVF measured against the exact dense search over
+    the SAME corpus. At reduced scale (smoke/fallback) the numbers only prove
+    the code path."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.encoder import JaxSentenceEncoder
+    from pathway_tpu.ops.knn import DenseKNNStore
+    from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+    n_total = 50_000 if DEVICE_SCALE_DOWN else 10_000_000
+    n_real = 2_000 if DEVICE_SCALE_DOWN else 200_000
+    n_queries = 256 if DEVICE_SCALE_DOWN else 1024
+    dim = 384
+    k = 10
+    chunk = 10_000 if DEVICE_SCALE_DOWN else 100_000
+
+    enc = JaxSentenceEncoder()
+    rng = np.random.default_rng(7)
+    topics = [f"topic{i}" for i in range(997)]
+
+    def texts(start: int, count: int) -> list:
+        return [
+            f"document {start + i} about {topics[(start + i) % 997]} and "
+            f"{topics[(start + i * 31) % 997]} with detail {(start + i) % 89}"
+            for i in range(count)
+        ]
+
+    t0 = time.perf_counter()
+    bs = 512 if DEVICE_SCALE_DOWN else 2048
+    base_parts = []
+    for s in range(0, n_real, bs):
+        base_parts.append(enc.encode(texts(s, min(bs, n_real - s))))
+    base = np.concatenate(base_parts).astype(np.float32)
+    embed_s = time.perf_counter() - t0
+
+    # noise scale from the real corpus's own geometry: mean NN distance on a sample
+    sample = base[rng.choice(n_real, size=min(2048, n_real), replace=False)]
+    d2 = (
+        np.sum(sample * sample, axis=1)[:, None]
+        + np.sum(sample * sample, axis=1)[None, :]
+        - 2.0 * sample @ sample.T
+    )
+    np.fill_diagonal(d2, np.inf)
+    sigma = 0.25 * float(np.mean(np.sqrt(np.maximum(d2.min(axis=1), 0.0))))
+
+    def corpus_chunk(start: int, count: int) -> np.ndarray:
+        take = rng.integers(0, n_real, count)
+        out = base[take] + rng.normal(scale=sigma, size=(count, dim)).astype(np.float32)
+        out /= np.maximum(np.linalg.norm(out, axis=1, keepdims=True), 1e-9)
+        return out.astype(np.float32)
+
+    qtexts = texts(10_000_000_000, n_queries)
+    queries = np.concatenate(
+        [enc.encode(qtexts[s : s + bs]) for s in range(0, n_queries, bs)]
+    ).astype(np.float32)
+
+    results: dict = {
+        "scale_docs": n_total,
+        "scale_real_docs": n_real,
+        "scale_embed_docs_per_s": round(n_real / embed_s, 1),
+        "scale_nn_sigma": round(sigma, 4),
+    }
+
+    # corpus held on host in f16 (7.7 GB at full scale) so dense and IVF ingest
+    # the IDENTICAL vectors without doubling device HBM
+    corpus = np.empty((n_total, dim), dtype=np.float16)
+    for s in range(0, n_total, chunk):
+        corpus[s : s + chunk] = corpus_chunk(s, min(chunk, n_total - s))
+
+    store = DenseKNNStore(dim, metric="l2sq", initial_capacity=n_total, dtype=jnp.bfloat16)
+    t0 = time.perf_counter()
+    for s in range(0, n_total, chunk):
+        end = min(s + chunk, n_total)
+        store.add_many(list(range(s, end)), corpus[s:end].astype(np.float32))
+        store._flush()
+    jax.block_until_ready(store._data)
+    results["scale_ingest_docs_per_s"] = round(n_total / (time.perf_counter() - t0), 1)
+
+    store.search_batch(queries, k)  # compile off the clock
+    lat = []
+    for _ in range(5):
+        t1 = time.perf_counter()
+        dense_scores, dense_idx, _ = store.search_batch(queries, k)
+        lat.append(time.perf_counter() - t1)
+    med = float(np.median(lat))
+    results["scale_dense_qps"] = round(n_queries / med, 1)
+    results["scale_dense_p50_batch_ms"] = round(med * 1000.0, 2)
+    dense_keys = np.vectorize(lambda s_: store.key_of.get(int(s_), -1))(dense_idx)
+    del store  # free HBM before the IVF copy
+
+    n_clusters = min(4096, max(64, n_total // 1024))
+    ivf = IvfKnnStore(
+        dim, metric="l2sq", initial_capacity=n_total,
+        n_clusters=n_clusters, n_probe=max(8, n_clusters // 16),
+        dtype=jnp.bfloat16,
+    )
+    t0 = time.perf_counter()
+    for s in range(0, n_total, chunk):
+        end = min(s + chunk, n_total)
+        ivf.add_many(list(range(s, end)), corpus[s:end].astype(np.float32))
+    ivf.search_batch(queries, k)  # train + compile off the clock
+    results["scale_ivf_train_plus_ingest_s"] = round(time.perf_counter() - t0, 1)
+    lat = []
+    for _ in range(5):
+        t1 = time.perf_counter()
+        _sc, ivf_idx, _v = ivf.search_batch(queries, k)
+        lat.append(time.perf_counter() - t1)
+    med = float(np.median(lat))
+    results["scale_ivf_qps"] = round(n_queries / med, 1)
+    results["scale_ivf_p50_batch_ms"] = round(med * 1000.0, 2)
+    ivf_keys = np.vectorize(lambda s_: ivf.key_of.get(int(s_), -1))(ivf_idx)
+    results["scale_ivf_recall_at_10_vs_exact"] = round(
+        float(
+            np.mean(
+                [
+                    len(set(ivf_keys[r]) & set(dense_keys[r])) / k
+                    for r in range(n_queries)
+                ]
+            )
+        ),
+        4,
+    )
+    return results
+
+
 _SHARDED_CHILD = """
 import json, time
 import numpy as np
@@ -616,20 +766,21 @@ SUB_BENCHES: dict = {
     "engine": lambda: bench_engine(),
     "vectorstore": lambda: bench_vector_store(),
     "sharded": lambda: bench_sharded(),
+    "scale": lambda: bench_scale(),
 }
 
 # sections whose numbers require the device; everything else is a CPU-vs-CPU
 # comparison that stays honest (and full-scale) on any host
-DEVICE_BOUND = {"knn", "embedder", "vectorstore"}
+DEVICE_BOUND = {"knn", "embedder", "vectorstore", "scale"}
 
 # per-sub-bench wall deadlines (seconds): generous on device, tight at toy scale
 _DEADLINES_FULL = {
     "knn": 600, "embedder": 420, "window": 300,
-    "engine": 600, "vectorstore": 600, "sharded": 660,
+    "engine": 600, "vectorstore": 600, "sharded": 660, "scale": 1500,
 }
 _DEADLINES_SMALL = {
     "knn": 300, "embedder": 240, "window": 300,
-    "engine": 600, "vectorstore": 300, "sharded": 660,
+    "engine": 600, "vectorstore": 300, "sharded": 660, "scale": 420,
 }
 
 
@@ -681,10 +832,11 @@ def _probe_backend() -> tuple[str | None, str]:
             "scale — NOT comparable",
             "cpu (requested)",
         )
-    if not pool and "axon" not in platforms:
-        # no tunneled plugin in play: nothing can wedge, skip the probe cost
-        # (the driver compile check calls this on every entry invocation)
-        return None, "local (unprobed)"
+    # no tunneled plugin: nothing can wedge, but the probe must still run to
+    # learn whether an accelerator exists at all — a plain CPU host running the
+    # device-bound sections at full scale with no honesty marker would break
+    # this file's contract (the probe costs a few seconds there; the driver's
+    # env always has the tunnel and takes the long-timeout path anyway)
     timeout = 120 if pool else 60
     rc, out = _run_with_deadline(
         [sys.executable, "-c",

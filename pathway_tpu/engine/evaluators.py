@@ -385,9 +385,17 @@ class ReindexEvaluator(Evaluator):
 
 
 class ConcatEvaluator(Evaluator):
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        # net live multiplicity per key: concat is a DISJOINT union, so a key
+        # reaching multiplicity 2 is a collision and fails the run (reference
+        # raises on duplicate keys; reindex mode cannot collide)
+        self.live: Dict[bytes, int] = {}
+
     def process(self, input_deltas: List[Delta]) -> Delta:
         reindex = self.node.config.get("reindex", False)
         parts = []
+        net: Dict[bytes, tuple] = {}  # kb -> (net diff this commit, sample key)
         for i, delta in enumerate(input_deltas):
             if len(delta) == 0:
                 continue
@@ -397,7 +405,32 @@ class ConcatEvaluator(Evaluator):
                     p = pointer_from(Pointer(int(delta.keys[j]["hi"]), int(delta.keys[j]["lo"])), i)
                     new_keys[j]["hi"], new_keys[j]["lo"] = p.hi, p.lo
                 delta = Delta(new_keys, delta.diffs, delta.columns)
+            else:
+                for j in range(len(delta)):
+                    kb = delta.keys[j].tobytes()
+                    prev = net.get(kb)
+                    net[kb] = (
+                        (prev[0] if prev else 0) + int(delta.diffs[j]),
+                        delta.keys[j],
+                    )
             parts.append(delta)
+        # collision check on the NET per-commit count: a same-commit key handoff
+        # between inputs (one retracts, another inserts, any row order) is legal
+        for kb, (d, key) in net.items():
+            cnt = self.live.get(kb, 0) + d
+            if cnt > 1:
+                from pathway_tpu.internals.keys import keys_to_pointers
+
+                raise ValueError(
+                    "concat: duplicate key "
+                    f"{keys_to_pointers(np.array([key], dtype=KEY_DTYPE))[0]!r} — "
+                    "input universes must be disjoint (use concat_reindex for "
+                    "overlapping tables)"
+                )
+            if cnt:
+                self.live[kb] = cnt
+            else:
+                self.live.pop(kb, None)
         return Delta.concat(parts, self.output_columns)
 
 

@@ -529,9 +529,17 @@ class ExpressionEvaluator:
                     out[i] = Json(v) if isinstance(v, (dict, list)) else v
                 else:
                     out[i] = o[idx]
-            except (KeyError, IndexError, TypeError):
+            except (KeyError, IndexError, TypeError) as exc:
                 if e._check_if_exists:
                     out[i] = default[i]
+                elif get_runtime()["terminate_on_error"]:
+                    # checked [] access: a missing index fails the run unless
+                    # error poisoning was opted into (reference get_checked).
+                    # Keep the original exception type — a KeyError on a Json
+                    # dict must not read as a sequence-bounds problem
+                    raise type(exc)(
+                        f"cannot index {o!r} with {idx!r}"
+                    ) from exc
                 else:
                     out[i] = ERROR
         return _tidy(out)

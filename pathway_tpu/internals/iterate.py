@@ -59,6 +59,8 @@ def iterate(
     ``func`` receives proxy tables and returns a dict-like / namespace of tables; returned
     names matching argument names are fed back. Returns an object with the final tables.
     """
+    if iteration_limit is not None and iteration_limit < 1:
+        raise ValueError("iteration_limit must be a positive integer")
     table_args = {k: v for k, v in kwargs.items() if isinstance(v, Table)}
     const_args = {k: v for k, v in kwargs.items() if not isinstance(v, Table)}
 
@@ -175,6 +177,11 @@ class IterateEvaluator:
         while True:
             nested.step()
             iteration += 1
+            if limit is not None and iteration >= limit:
+                # the limit counts APPLICATIONS of func (reference
+                # ``test_iterate_with_limit``: limit N -> f^N(x)); stop before
+                # feeding the next round back
+                break
             changed = False
             for name in input_names:
                 if name not in result_map:
@@ -189,9 +196,6 @@ class IterateEvaluator:
                     changed = True
                     sources[name].feed(proxy_delta)
             if not changed:
-                break
-            if limit is not None and iteration >= limit:
-                nested.step()
                 break
 
         # diff nested outputs against previously emitted

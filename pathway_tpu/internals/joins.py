@@ -107,6 +107,19 @@ class JoinResult:
 
         out: Dict[str, expr.ColumnExpression] = {}
         for arg in args:
+            if isinstance(arg, thisclass.ThisWildcard):
+                # *pw.left / *pw.right: that side's columns; *pw.this: both
+                # sides' (left wins a name clash, as in the reference)
+                sides = {
+                    thisclass.left: [self._left],
+                    thisclass.right: [self._right],
+                    thisclass.this: [self._left, self._right],
+                }[arg._kind]
+                for side in sides:
+                    for n in side.column_names():
+                        if n not in arg._exclude and n not in out:
+                            out[n] = expr.smart_coerce(side[n])
+                continue
             resolved = thisclass.substitute(
                 arg,
                 {thisclass.this: _JoinThis(self), thisclass.left: self._left, thisclass.right: self._right},

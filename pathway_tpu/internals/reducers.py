@@ -484,7 +484,14 @@ class _UniqueAcc(_MultisetAcc):
     def value(self) -> Any:
         if len(self.items) != 1:
             from pathway_tpu.engine.columnar import ERROR
+            from pathway_tpu.engine.expression_evaluator import get_runtime
 
+            if get_runtime()["terminate_on_error"]:
+                # reference semantics: a unique() violation fails the run unless
+                # error poisoning was opted into (terminate_on_error=False)
+                raise ValueError(
+                    "unique reducer: group holds more than one distinct value"
+                )
             return ERROR
         return _unhash(next(iter(self.items)))
 

@@ -122,8 +122,24 @@ class Table(Joinable):
 
     def select(self, *args: Any, **kwargs: Any) -> "Table":
         """Project/compute columns; keys are preserved (reference ``table.py`` select)."""
+        from pathway_tpu.internals.thisclass import ThisWildcard
+
         exprs: Dict[str, expr.ColumnExpression] = {}
         for arg in args:
+            if isinstance(arg, ThisWildcard):
+                from pathway_tpu.internals import thisclass as _tc
+
+                if arg._kind is not _tc.this:
+                    raise TypeError(
+                        f"*pw.{arg._kind.__name__} wildcards only apply inside a "
+                        "join's select; use *pw.this on a plain table"
+                    )
+                # ``*pw.this`` / ``*pw.this.without(...)``: all columns except
+                # the exclusions; later kwargs may shadow individual names
+                for n in self.column_names():
+                    if n not in arg._exclude:
+                        exprs[n] = self[n]
+                continue
             exprs[_name_of(arg)] = self._resolve(arg)
         for out_name, e in kwargs.items():
             exprs[out_name] = self._resolve(e)
@@ -165,6 +181,18 @@ class Table(Joinable):
 
     def filter(self, filter_expression: Any) -> "Table":
         e = self._resolve(filter_expression)
+        for ref in e._column_refs:
+            if ref.table is self or ref.table._universe is self._universe:
+                continue
+            if universe_solver.query_are_equal(ref.table._universe, self._universe):
+                continue
+            # resolving a foreign-universe column per THIS table's row keys
+            # would silently produce misses (reference raises the same way)
+            raise ValueError(
+                f"filter: column {ref.name!r} belongs to a table with a "
+                "different universe; use promise_universes_are_equal or filter "
+                "on this table's own columns"
+            )
         node = G.add_node(pg.FilterNode(inputs=[self], expression=e))
         result = Table(node, self._schema, name="filter")
         universe_solver.register_subset(result._universe, self._universe)
@@ -306,9 +334,12 @@ class Table(Joinable):
         return result
 
     def ix_ref(self, *args: Any, optional: bool = False, context: Any = None, instance: Any = None) -> "Table":
-        raise NotImplementedError(
-            "ix_ref must be called through <table>.ix_ref inside select; "
-            "use table.ix(table.pointer_from(...)) instead"
+        """Row lookup by primary-key VALUES (reference ``table.ix_ref``):
+        ``t.ix_ref(q.key)`` re-keys through ``t.pointer_from`` — matching keys
+        assigned by ``with_id_from``/primary-key schemas. Constant args
+        broadcast the single looked-up row across the calling context."""
+        return self.ix(
+            self.pointer_from(*args, instance=instance), optional=optional, context=context
         )
 
     def _gradual_broadcast(
@@ -372,6 +403,13 @@ class Table(Joinable):
 
     def update_cells(self, other: "Table") -> "Table":
         """Update values of other's columns on matching keys (other ⊆ self)."""
+        unknown = [c for c in other.column_names() if c not in self.column_names()]
+        if unknown:
+            # silently ignoring them would make typos no-ops (reference raises)
+            raise ValueError(
+                f"update_cells: column(s) {unknown} do not exist in the updated "
+                f"table (columns: {self.column_names()})"
+            )
         node = G.add_node(pg.UpdateCellsNode(inputs=[self, other]))
         return Table(node, self._schema, universe=self._universe, name="update_cells")
 

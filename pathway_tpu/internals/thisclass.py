@@ -10,6 +10,19 @@ from typing import Any
 from pathway_tpu.internals import expression as expr
 
 
+class ThisWildcard:
+    """Deferred "all columns of this table" marker (minus exclusions); expanded
+    by ``Table.select`` (reference ``*pw.this`` / ``pw.this.without(...)``)."""
+
+    def __init__(self, kind: type, exclude: tuple = ()):
+        self._kind = kind
+        self._exclude = tuple(exclude)
+
+    def __iter__(self):
+        # ``select(*pw.this.without(x))`` unpacks the wildcard itself
+        return iter((self,))
+
+
 class ThisMetaclass(type):
     def __getattr__(cls, name: str) -> "ThisColumnReference":
         if name.startswith("__"):
@@ -22,7 +35,15 @@ class ThisMetaclass(type):
         return ThisColumnReference(cls, name)
 
     def __iter__(cls):
-        raise TypeError(f"{cls.__name__} is not iterable")
+        # ``select(*pw.this)``: every column of the operated-on table
+        return iter((ThisWildcard(cls),))
+
+    def without(cls, *columns: Any) -> ThisWildcard:
+        names = tuple(
+            c.name if hasattr(c, "name") and not isinstance(c, str) else str(c)
+            for c in columns
+        )
+        return ThisWildcard(cls, names)
 
 
 class this(metaclass=ThisMetaclass):

@@ -31,12 +31,15 @@ def _search_kernel(
     """Top-k over the full store: (q, cap) score matrix on the MXU, masked, top_k."""
     scores = jnp.dot(
         queries, data.T, preferred_element_type=jnp.float32
-    )  # (q, cap) — MXU path
+    )  # (q, cap) — MXU path (bf16 operands accumulate in f32)
+    # query norms in f32 regardless of storage dtype: a bf16 self-product loses
+    # ~3 decimal digits, which skews l2 distances near ties
+    qf = queries.astype(jnp.float32)
     if metric == "l2sq":
-        qn = jnp.sum(queries * queries, axis=1, keepdims=True)
+        qn = jnp.sum(qf * qf, axis=1, keepdims=True)
         scores = -(qn + norms[None, :] - 2.0 * scores)  # -(||q-d||^2), higher is better
     elif metric == "cos":
-        qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
+        qn = jnp.linalg.norm(qf, axis=1, keepdims=True)
         scores = scores / jnp.maximum(qn * jnp.sqrt(norms)[None, :], 1e-30)
     scores = jnp.where(valid[None, :], scores, -jnp.inf)
     top_scores, top_idx = lax.top_k(scores, k)
@@ -218,12 +221,24 @@ class DenseKNNStore(SlotIngestMixin):
         else:
             queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
         k_eff = max(1, min(k, self.capacity))
-        data = self._data if self._data.dtype == jnp.float32 else self._data.astype(jnp.float32)
+        q_dev = queries if isinstance(queries, jax.Array) else jnp.asarray(queries)
+        if self._data.dtype == jnp.bfloat16:
+            # bf16-resident corpus (HBM capacity: 10M x 384 fits one v5e chip):
+            # the MXU consumes bf16 natively with f32 accumulation — cast the
+            # QUERIES down instead of materializing an f32 copy of the corpus
+            q_dev = q_dev.astype(jnp.bfloat16)
+            data = self._data
+        else:
+            data = (
+                self._data
+                if self._data.dtype == jnp.float32
+                else self._data.astype(jnp.float32)
+            )
         top_scores, top_idx = _search_kernel(
             data,
             self._valid,
             self._norms,
-            queries if isinstance(queries, jax.Array) else jnp.asarray(queries),
+            q_dev,
             k_eff,
             self.metric,
         )

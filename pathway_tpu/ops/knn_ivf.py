@@ -84,13 +84,17 @@ def _ivf_search_kernel(
     safe = jnp.maximum(cand, 0)
     vecs = data[safe]  # (q, m, d)
     scores = jnp.einsum(
-        "qd,qmd->qm", queries, vecs, preferred_element_type=jnp.float32
+        "qd,qmd->qm", queries.astype(vecs.dtype), vecs,
+        preferred_element_type=jnp.float32,
     )
+    # query norms in f32 regardless of storage dtype (bf16 self-products skew
+    # l2 distances near ties)
+    qf = queries.astype(jnp.float32)
     if metric == "l2sq":
-        qn = jnp.sum(queries * queries, axis=1, keepdims=True)
+        qn = jnp.sum(qf * qf, axis=1, keepdims=True)
         scores = -(qn + norms[safe] - 2.0 * scores)
     elif metric == "cos":
-        qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
+        qn = jnp.linalg.norm(qf, axis=1, keepdims=True)
         scores = scores / jnp.maximum(qn * jnp.sqrt(norms[safe]), 1e-30)
     scores = jnp.where(cand_ok & valid[safe], scores, -jnp.inf)
     k_eff = min(k, scores.shape[1])
@@ -112,9 +116,10 @@ class IvfKnnStore(DenseKNNStore):
         n_clusters: int = 64,
         n_probe: int = 8,
         train_iters: int = 8,
+        dtype: Any = jnp.float32,
     ):
         super().__init__(
-            dim, metric=metric, initial_capacity=initial_capacity
+            dim, metric=metric, initial_capacity=initial_capacity, dtype=dtype
         )
         self.n_clusters = n_clusters
         self.n_probe = min(n_probe, n_clusters)
@@ -160,14 +165,15 @@ class IvfKnnStore(DenseKNNStore):
         rng = np.random.default_rng(0)
         live = np.fromiter(self.slot_of.values(), dtype=np.int64)
         seeds = rng.choice(live, size=self.n_clusters, replace=len(live) < self.n_clusters)
-        init = self._data[jnp.asarray(seeds)]
+        # k-means accumulates means: always train in f32 even over a bf16 corpus
+        init = self._data[jnp.asarray(seeds)].astype(jnp.float32)
         sample_cap = self.n_clusters * self._TRAIN_SAMPLE_PER_CLUSTER
         if len(live) > sample_cap:
             sample = rng.choice(live, size=sample_cap, replace=False)
-            train_vecs = self._data[jnp.asarray(np.sort(sample))]
+            train_vecs = self._data[jnp.asarray(np.sort(sample))].astype(jnp.float32)
             train_valid = jnp.ones((sample_cap,), dtype=bool)
         else:
-            train_vecs = self._data
+            train_vecs = self._data.astype(jnp.float32)
             train_valid = self._valid
         centroids, _ = _kmeans_kernel(
             train_vecs, train_valid, init, self.train_iters

@@ -99,3 +99,40 @@ def test_ivf_through_data_index():
     rows = capture_rows(res)
     assert len(rows) == 1
     assert rows[0]["text"] == ("alpha",)  # exact self-match through the engine
+
+
+def test_bf16_storage_matches_f32_results():
+    """bfloat16-resident corpora (the HBM-capacity mode for 10M x 384 on one
+    chip) must rank the same neighbors as f32 storage: MXU consumes bf16 with
+    f32 accumulation, query norms stay f32."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.knn import DenseKNNStore
+    from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+    rng = np.random.default_rng(3)
+    docs = rng.normal(size=(2000, 48)).astype(np.float32)
+    docs /= np.linalg.norm(docs, axis=1, keepdims=True)
+    queries = docs[rng.integers(0, 2000, 32)] + 0.01 * rng.normal(size=(32, 48)).astype(np.float32)
+
+    f32 = DenseKNNStore(48, metric="l2sq", initial_capacity=2048)
+    b16 = DenseKNNStore(48, metric="l2sq", initial_capacity=2048, dtype=jnp.bfloat16)
+    for store in (f32, b16):
+        store.add_many(list(range(2000)), docs)
+        store._flush()
+    _s1, i1, _ = f32.search_batch(queries.astype(np.float32), 10)
+    _s2, i2, _ = b16.search_batch(queries.astype(np.float32), 10)
+    overlap = np.mean([len(set(i1[r]) & set(i2[r])) / 10 for r in range(32)])
+    assert overlap >= 0.97, overlap  # bf16 quantization may swap distant ties only
+    # the nearest neighbor itself must never flip
+    assert (i1[:, 0] == i2[:, 0]).mean() >= 0.97
+
+    ivf = IvfKnnStore(
+        48, metric="l2sq", initial_capacity=2048, n_clusters=8, n_probe=8,
+        dtype=jnp.bfloat16,
+    )
+    ivf.add_many(list(range(2000)), docs)
+    _s3, i3, _ = ivf.search_batch(queries.astype(np.float32), 10)
+    # full probe (8/8): bf16 IVF is exact up to the same quantization
+    overlap = np.mean([len(set(i1[r]) & set(i3[r])) / 10 for r in range(32)])
+    assert overlap >= 0.97, overlap
