@@ -677,6 +677,7 @@ def bench_scale() -> dict:
     for s in range(0, n_total, chunk):
         end = min(s + chunk, n_total)
         ivf.add_many(list(range(s, end)), corpus[s:end].astype(np.float32))
+        ivf._flush()  # per-chunk: ONE staged mega-flush would pad 10M rows to 16M f32
     ivf.search_batch(queries, k)  # train + compile off the clock
     results["scale_ivf_train_plus_ingest_s"] = round(time.perf_counter() - t0, 1)
     lat = []

@@ -1608,7 +1608,14 @@ def _iter_flatten(value: Any) -> list:
 
 
 class IxEvaluator(Evaluator):
-    """source-keyed lookup into target (reference ``ix``/``ix_ref``)."""
+    """source-keyed lookup into target (reference ``ix``/``ix_ref``).
+
+    Multi-process: the TARGET side replicates (broadcast) into a private state
+    replica, so a lookup of any pointer answers locally wherever the source row
+    lives — the same replicated-state pattern as the external index. Source
+    rows (and therefore output rows) stay where they were produced."""
+
+    CLUSTER_POLICIES = {1: "broadcast"}
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
@@ -1616,12 +1623,23 @@ class IxEvaluator(Evaluator):
         self.reverse: Dict[bytes, set[bytes]] = defaultdict(set)
         self.src_rows: Dict[bytes, np.void] = {}
         self.emitted: Dict[bytes, dict] = {}  # source key -> last emitted output row
+        self._replica: Any = (
+            StateTable(node.inputs[1].column_names())
+            if getattr(runner, "_cluster", None) is not None
+            else None
+        )
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         source_delta, target_delta = input_deltas
         source_table, target_table = self.node.inputs
         optional = self.node.config.get("optional", False)
-        target_state = self.runner.state_of(target_table._node)
+        if self._replica is not None:
+            # broadcast target deltas feed the replica BEFORE lookups, matching
+            # the single-process ordering (target materializes before ix runs)
+            self._replica.apply(target_delta)
+            target_state = self._replica
+        else:
+            target_state = self.runner.state_of(target_table._node)
         out_keys, out_diffs, out_rows = [], [], []
 
         handled_sources: set[bytes] = set()
@@ -2299,6 +2317,11 @@ class GradualBroadcastEvaluator(Evaluator):
     (upper - lower) * frac(key) — and only re-emits when a threshold update moves
     the band past the row's stored value, so a drifting threshold updates rows
     progressively instead of retracting the whole table each tick."""
+
+    # rows are row-local (apx derives from the row's own key), but the
+    # threshold band typically comes from a GLOBAL reduce living on one owner
+    # process — replicate it so every process applies the same band
+    CLUSTER_POLICIES = {1: "broadcast"}
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)

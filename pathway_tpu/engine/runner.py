@@ -125,14 +125,13 @@ class GraphRunner:
         Lets evaluators resolve retraction rows against retracted upstream values."""
         return self._substep_deltas.get(node.id)
 
-    # Operators that still cannot run multi-process: ix reads another node's
-    # materialized state (not co-partitioned with its own rows), iterate nests a
-    # whole sub-runner, and row transformers chase pointers across arbitrary rows.
+    # Operators that still cannot run multi-process: iterate nests a whole
+    # sub-runner, and row transformers chase pointers across arbitrary rows.
     # Everything else either exchanges (rowkey/custom routing), centralizes on
-    # process 0, or replicates — see ``Evaluator.CLUSTER_POLICIES``. Running these
-    # four multi-process would silently return per-process partial answers, so
-    # they fail loudly instead.
-    _CLUSTER_UNSUPPORTED = {"ix", "iterate", "iterate_result", "row_transformer"}
+    # process 0, or replicates (ix/external_index broadcast their lookup side) —
+    # see ``Evaluator.CLUSTER_POLICIES``. Running these multi-process would
+    # silently return per-process partial answers, so they fail loudly instead.
+    _CLUSTER_UNSUPPORTED = {"iterate", "iterate_result", "row_transformer"}
 
     def setup(self, monitoring_level: Any = None, persistence_config: Any = None) -> None:
         # hot-path modules load now, not inside the first timed commit
@@ -184,8 +183,14 @@ class GraphRunner:
                 cls = EVALUATORS.get(type(node))
                 if cls is None:
                     return False
-                return bool(cls.CLUSTER_POLICIES) or (
-                    cls.cluster_input_policy is not Evaluator.cluster_input_policy
+                if cls.cluster_input_policy is not Evaluator.cluster_input_policy:
+                    return True  # custom routing (presence sets, instances)
+                # "broadcast" replicates evaluator STATE only — output rows stay
+                # with their producing side (ix, external_index, gradual
+                # broadcast); every other policy moves rows
+                return any(
+                    p in ("rowkey", "custom", "root")
+                    for p in cls.CLUSTER_POLICIES.values()
                 )
 
             repartitioned: set = set()

@@ -316,7 +316,10 @@ class Table(Joinable):
         """Reindex this table by pointers coming from another table's column."""
         key_expr = expr.smart_coerce(expression)
         refs = key_expr._column_refs
-        if refs:
+        if context is not None:
+            # constant-key lookups broadcast across an explicit calling table
+            source = context
+        elif refs:
             source = refs[0].table
         elif isinstance(key_expr, expr.PointerExpression):
             # zero-argument pointer_from still knows its origin table
@@ -337,7 +340,9 @@ class Table(Joinable):
         """Row lookup by primary-key VALUES (reference ``table.ix_ref``):
         ``t.ix_ref(q.key)`` re-keys through ``t.pointer_from`` — matching keys
         assigned by ``with_id_from``/primary-key schemas. Constant args
-        broadcast the single looked-up row across the calling context."""
+        broadcast the looked-up row across ``context``'s universe (pass
+        ``context=...`` when calling from another table; without it the
+        broadcast spans the target's own universe)."""
         return self.ix(
             self.pointer_from(*args, instance=instance), optional=optional, context=context
         )

@@ -173,8 +173,10 @@ class IvfKnnStore(DenseKNNStore):
             train_vecs = self._data[jnp.asarray(np.sort(sample))].astype(jnp.float32)
             train_valid = jnp.ones((sample_cap,), dtype=bool)
         else:
-            train_vecs = self._data.astype(jnp.float32)
-            train_valid = self._valid
+            # gather LIVE rows only: casting the whole preallocated buffer to
+            # f32 would materialize capacity x dim (multi-GB for a large store)
+            train_vecs = self._data[jnp.asarray(np.sort(live))].astype(jnp.float32)
+            train_valid = jnp.ones((len(live),), dtype=bool)
         centroids, _ = _kmeans_kernel(
             train_vecs, train_valid, init, self.train_iters
         )

@@ -146,15 +146,14 @@ def test_spawn_join_exact_global_result(tmp_path):
 
 
 def test_spawn_unsupported_operator_fails_loudly(tmp_path):
-    # ix reads another node's materialized state — one of the four kinds still
-    # refused under spawn (sort/dedup/behaviors now exchange or centralize)
+    # iterate nests a whole sub-runner — one of the kinds still refused under
+    # spawn (sort/dedup/behaviors/ix now exchange, centralize, or replicate)
     prog = textwrap.dedent(
         """
         import pathway_tpu as pw
-        t = pw.debug.table_from_rows(
-            pw.schema_builder({"k": str, "a": int}), [("x", 1), ("y", 2)]
-        )
-        s = t.select(b=t.ix(t.pointer_from(t.k)).a)
+        t = pw.debug.table_from_rows(pw.schema_builder({"a": int}), [(1,), (16,)])
+        halve = lambda t: dict(t=t.select(a=pw.if_else(t.a > 1, t.a // 2, t.a)))
+        s = pw.iterate(halve, t=t).t
         pw.io.subscribe(s, lambda **kw: None)
         pw.run(monitoring_level=pw.MonitoringLevel.NONE)
         """

@@ -329,3 +329,111 @@ def test_spawn_deduplicate_instance_routed(tmp_path):
     # each instance's output is owned by exactly one process
     per_proc = [set(_merge_counting([o])) for o in outs]
     assert not (per_proc[0] & per_proc[1])
+
+
+KNN_PROG = textwrap.dedent(
+    """
+    import json, os
+    import numpy as np
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    data = json.load(open(os.path.join(tmp, f"input_{pid}.json")))
+    docs = pw.debug.table_from_rows(
+        pw.schema_builder({"name": str, "vec": np.ndarray}),
+        [(n, np.asarray(v, dtype=np.float32)) for n, v in data["docs"]],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_builder({"qname": str, "qvec": np.ndarray}),
+        [(n, np.asarray(v, dtype=np.float32)) for n, v in data["queries"]],
+    )
+    res = KNNIndex(docs.vec, docs, n_dimensions=4).get_nearest_items(
+        queries.qvec, k=2
+    )
+    got = []
+    pw.io.subscribe(
+        res,
+        lambda key, row, time, is_addition: got.append(
+            [sorted(row["name"]), 1 if is_addition else -1]
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(got, open(os.path.join(tmp, f"out_{pid}.json"), "w"))
+    """
+)
+
+
+def test_spawn_external_index_replicated_exact(tmp_path):
+    """The external-index operator at -n 2: the data side is broadcast so each
+    process's local queries see the FULL corpus — a query on process 0 must
+    retrieve nearest neighbors ingested on process 1."""
+    # four distinct corners of the plane; docs split across processes
+    docs = {
+        0: [["n00", [10, 0, 0, 0]], ["n01", [0, 10, 0, 0]]],
+        1: [["n10", [0, 0, 10, 0]], ["n11", [0, 0, 0, 10]]],
+    }
+    # each process queries a corner owned by the OTHER process
+    queries = {
+        0: [["q0", [0, 0, 9, 1]]],   # nearest: n10 then n11 (both on p1)
+        1: [["q1", [9, 1, 0, 0]]],   # nearest: n00 then n01 (both on p0)
+    }
+    for pid in (0, 1):
+        (tmp_path / f"input_{pid}.json").write_text(
+            json.dumps({"docs": docs[pid], "queries": queries[pid]})
+        )
+    _spawn(2, KNN_PROG, tmp_path, 23800)
+    outs = [json.loads((tmp_path / f"out_{p}.json").read_text()) for p in range(2)]
+    # queries answer on their local process, against the replicated corpus
+    assert [g for g, d in outs[0] if d > 0] == [["n10", "n11"]]
+    assert [g for g, d in outs[1] if d > 0] == [["n00", "n01"]]
+
+
+IX_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    data = json.load(open(os.path.join(tmp, f"input_{pid}.json")))
+    target = pw.debug.table_from_rows(
+        pw.schema_builder({
+            "k": pw.column_definition(dtype=str, primary_key=True),
+            "v": pw.column_definition(dtype=int),
+        }),
+        [tuple(r) for r in data["target"]],
+    )
+    src = pw.debug.table_from_rows(
+        pw.schema_builder({"name": str, "ref": str}), [tuple(r) for r in data["src"]]
+    )
+    res = src.select(src.name, v=target.ix(target.pointer_from(src.ref)).v)
+    got = []
+    pw.io.subscribe(
+        res,
+        lambda key, row, time, is_addition: got.append(
+            [row["name"], row["v"], 1 if is_addition else -1]
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(got, open(os.path.join(tmp, f"out_{pid}.json"), "w"))
+    """
+)
+
+
+def test_spawn_ix_replicated_target_exact(tmp_path):
+    """ix at -n 2: the target side broadcasts into a per-process replica, so a
+    source row on process 0 resolves a pointer to a target row ingested on
+    process 1 (and vice versa), with output rows staying source-local."""
+    shards = {
+        0: {"target": [["a", 1], ["b", 2]], "src": [["s0", "c"], ["s1", "d"]]},
+        1: {"target": [["c", 3], ["d", 4]], "src": [["s2", "a"], ["s3", "b"]]},
+    }
+    for pid, data in shards.items():
+        (tmp_path / f"input_{pid}.json").write_text(json.dumps(data))
+    _spawn(2, IX_PROG, tmp_path, 24200)
+    outs = [json.loads((tmp_path / f"out_{p}.json").read_text()) for p in range(2)]
+    # source rows answer on their OWN process against the replicated target
+    assert _merge_counting([outs[0]]) == {("s0", 3): 1, ("s1", 4): 1}
+    assert _merge_counting([outs[1]]) == {("s2", 1): 1, ("s3", 2): 1}
