@@ -373,7 +373,15 @@ def bench_engine() -> dict:
 
     Fairness contract, both sides: data preparation (row lists / numpy arrays,
     sorted build sides) happens OFF the clock; the timed region is per-commit
-    incremental processing + delivery of the update batches. The engine delivers
+    incremental processing + delivery of the update batches.
+
+    Reading the ratios: wordcount/join (string keys) are the headline bars
+    (>= 1.0x). join_int is secondary and sits ~0.4x by design tradeoff: the
+    proxy is a non-incremental branchless binary search over sorted int64s —
+    near the memory-bandwidth floor — while the engine maintains a fully
+    incremental, retraction-capable arrangement. The join_churn metric is the
+    same workload once the build side actually churns: there incrementality
+    wins ~2.5x, which is the workload this engine exists for. The engine delivers
     through the vectorized ``pw.io.subscribe(on_batch=...)`` sink (columnar arrays,
     the TPU-native delivery path); the proxies consume by updating their own
     result state. Join keys are string entity ids (the representative ETL join);
@@ -458,6 +466,9 @@ def bench_engine() -> dict:
     probe_keys = build_keys[probe_pos]
 
     def proxy_join(build_k: np.ndarray, probe_k: np.ndarray) -> float:
+        import gc
+
+        gc.collect()
         order = np.argsort(build_k)
         sb, sn = build_k[order], build_names[order]
         t0 = time.perf_counter()
@@ -468,6 +479,9 @@ def bench_engine() -> dict:
         return time.perf_counter() - t0
 
     def engine_join(schema_k: type, build_vals: list, probe_vals: list) -> float:
+        import gc
+
+        gc.collect()  # isolate from the previous sub-measurement's garbage
         pg.G.clear()
         lrows = [(k, 2 * (i // per_j), 1) for i, k in enumerate(probe_vals)]
         lt = pw.debug.table_from_rows(

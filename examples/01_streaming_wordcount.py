@@ -1,0 +1,43 @@
+"""Streaming wordcount: the smallest end-to-end incremental pipeline.
+
+An update stream feeds a groupby/reduce; every commit delivers exactly the
+CHANGES to the counts (insertions and retractions), not a recomputation."""
+
+import pathway_tpu as pw
+
+# __time__ groups rows into commits; __diff__ = +1 insert / -1 retract
+words = pw.debug.table_from_markdown(
+    """
+    word | __time__ | __diff__
+    cat  | 0        | 1
+    dog  | 0        | 1
+    cat  | 2        | 1
+    dog  | 4        | -1
+    """
+)
+
+counts = words.groupby(pw.this.word).reduce(
+    pw.this.word, n=pw.reducers.count()
+)
+
+events = []
+pw.io.subscribe(
+    counts,
+    lambda key, row, time, is_addition: events.append(
+        (row["word"], row["n"], "+" if is_addition else "-")
+    ),
+)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+for word, n, sign in events:
+    print(f"{sign} {word}={n}")
+
+# final state: cat=2; dog was inserted then fully retracted
+final = {}
+for word, n, sign in events:
+    if sign == "+":
+        final[word] = n
+    elif final.get(word) == n:
+        del final[word]
+assert final == {"cat": 2}, final
+print("OK:", final)

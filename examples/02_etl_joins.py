@@ -1,0 +1,54 @@
+"""Incremental joins: probe-side streaming, build-side churn, asof joins.
+
+The defining obligation of an incremental join: when a build-side row changes,
+every previously-emitted joined row retracts and re-emits with the new value —
+without reprocessing the probe side."""
+
+import pathway_tpu as pw
+
+orders = pw.debug.table_from_markdown(
+    """
+    sku | qty | __time__ | __diff__
+    a   | 2   | 0        | 1
+    b   | 1   | 0        | 1
+    a   | 5   | 2        | 1
+    """
+)
+# the price of sku 'a' changes at time 4 — AFTER all its orders arrived
+prices = pw.debug.table_from_markdown(
+    """
+    psku | price | __time__ | __diff__
+    a    | 10    | 0        | 1
+    b    | 7     | 0        | 1
+    a    | 10    | 4        | -1
+    a    | 12    | 4        | 1
+    """
+)
+
+lines = orders.join(prices, orders.sku == prices.psku).select(
+    orders.sku, total=orders.qty * prices.price
+)
+pw.debug.compute_and_print_update_stream(lines)
+# the time-4 price change retracts both 'a' order lines and re-emits them at 12
+
+# asof join: each event picks the LATEST quote at-or-before its timestamp
+events = pw.debug.table_from_markdown(
+    """
+      | inst | t
+    1 | x    | 4
+    2 | x    | 9
+    """
+)
+quotes = pw.debug.table_from_markdown(
+    """
+      | qinst | qt | px
+    1 | x     | 1  | 100
+    2 | x     | 5  | 105
+    3 | x     | 8  | 103
+    """
+)
+priced = events.asof_join(
+    quotes, events.t, quotes.qt, events.inst == quotes.qinst
+).select(events.inst, events.t, px=quotes.px)
+pw.debug.compute_and_print(priced)  # t=4 -> 100, t=9 -> 103
+print("OK")

@@ -1,0 +1,50 @@
+"""Tumbling windows with temporal behaviors: late data, buffering, cutoffs.
+
+``common_behavior(delay, cutoff)`` postpones a window's emission until the
+stream's time passes start+delay (so early results don't churn) and drops rows
+arriving later than cutoff past the window (bounded memory — the engine can
+forget closed windows)."""
+
+import pathway_tpu as pw
+
+readings = pw.debug.table_from_markdown(
+    """
+    sensor | t  | value | __time__ | __diff__
+    1      | 2  | 10    | 0        | 1
+    1      | 7  | 20    | 0        | 1
+    2      | 3  | 5     | 0        | 1
+    1      | 13 | 40    | 2        | 1
+    1      | 4  | 30    | 2        | 1
+    2      | 25 | 9     | 4        | 1
+    1      | 38 | 1     | 6        | 1
+    """
+)
+
+stats = readings.windowby(
+    readings.t,
+    window=pw.temporal.tumbling(duration=10),
+    instance=readings.sensor,
+    behavior=pw.temporal.common_behavior(delay=2, cutoff=30, keep_results=True),
+).reduce(
+    sensor=pw.this._pw_instance,
+    start=pw.this._pw_window_start,
+    total=pw.reducers.sum(pw.this.value),
+    n=pw.reducers.count(),
+)
+
+got = {}
+pw.io.subscribe(
+    stats,
+    lambda key, row, time, is_addition: got.__setitem__(
+        (row["sensor"], row["start"]), (row["total"], row["n"])
+    )
+    if is_addition
+    else got.pop((row["sensor"], row["start"]), None),
+)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+print(got)
+# sensor 1 window [0,10): rows t=2,7 plus the LATE row t=4 (arrived while still
+# under the cutoff) -> total 60; window [10,20): t=13 -> 40; [30,40): t=38 -> 1
+assert got[(1, 0)] == (60, 3)
+assert got[(1, 10)] == (40, 1)
+print("OK")
