@@ -34,32 +34,62 @@ from jax import lax
 from pathway_tpu.ops.knn import DenseKNNStore, pad_pow2
 
 
+_KMEANS_CHUNK = 4096
+
+
 @functools.partial(jax.jit, static_argnames=("n_iters",))
 def _kmeans_kernel(vectors: jax.Array, valid: jax.Array, centroids: jax.Array, n_iters: int):
-    """Lloyd iterations fully on device: assign (matmul + argmax) then update
-    (segment-sum via one-hot matmul — MXU-friendly, no scatter)."""
+    """Lloyd iterations fully on device, memory-safe at large cluster counts:
+    each iteration scans (chunk, d) blocks accumulating per-centroid sums and
+    counts (one-hot matmul — MXU work, no scatter), so peak extra memory is
+    O(chunk * C) instead of O(n * C). Callers pad ``vectors``/``valid`` to a
+    multiple of ``_KMEANS_CHUNK`` with ``valid=False`` rows."""
+    n, d = vectors.shape
+    C = centroids.shape[0]
+    vb = vectors.reshape(n // _KMEANS_CHUNK, _KMEANS_CHUNK, d)
+    mb = valid.reshape(n // _KMEANS_CHUNK, _KMEANS_CHUNK)
 
-    def step(carry, _):
-        cents = carry
-        # assign: nearest centroid by L2 == argmax of (2 x.c - ||c||^2)
+    def step(cents, _):
         cn = jnp.sum(cents * cents, axis=1)
-        sim = 2.0 * vectors @ cents.T - cn[None, :]
-        sim = jnp.where(valid[:, None], sim, -jnp.inf)
-        assign = jnp.argmax(sim, axis=1)
-        onehot = jax.nn.one_hot(assign, cents.shape[0], dtype=vectors.dtype)
-        onehot = onehot * valid[:, None]
-        sums = onehot.T @ vectors
-        counts = jnp.sum(onehot, axis=0)
+        cb = cents.astype(jnp.bfloat16)
+
+        def acc(carry, blk):
+            sums, counts = carry
+            v, m = blk
+            sim = 2.0 * (v.astype(jnp.bfloat16) @ cb.T).astype(jnp.float32) - cn[None, :]
+            sim = jnp.where(m[:, None], sim, -jnp.inf)
+            a = jnp.argmax(sim, axis=1)
+            oh = jax.nn.one_hot(a, C, dtype=jnp.bfloat16) * m[:, None].astype(jnp.bfloat16)
+            sums = sums + jnp.einsum(
+                "nc,nd->cd", oh, v.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            counts = counts + jnp.sum(oh.astype(jnp.float32), axis=0)
+            return (sums, counts), None
+
+        init = (jnp.zeros((C, d), jnp.float32), jnp.zeros((C,), jnp.float32))
+        (sums, counts), _ = lax.scan(acc, init, (vb, mb))
         new = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), cents
         )
         return new, None
 
     centroids, _ = lax.scan(step, centroids, None, length=n_iters)
+    return centroids
+
+
+@jax.jit
+def _assign2_kernel(block: jax.Array, centroids: jax.Array) -> jax.Array:
+    """Top-2 nearest centroids per row (primary + spill candidate), bf16
+    affinity with f32 correction — near-ties may swap, which is harmless for
+    coarse quantization (both clusters are close)."""
     cn = jnp.sum(centroids * centroids, axis=1)
-    sim = 2.0 * vectors @ centroids.T - cn[None, :]
-    assign = jnp.argmax(sim, axis=1)
-    return centroids, assign
+    sim = (
+        2.0 * (block.astype(jnp.bfloat16) @ centroids.astype(jnp.bfloat16).T).astype(jnp.float32)
+        - cn[None, :]
+    )
+    _, idx = lax.top_k(sim, 2)
+    return idx.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probe", "metric"))
@@ -121,39 +151,60 @@ class IvfKnnStore(DenseKNNStore):
         super().__init__(
             dim, metric=metric, initial_capacity=initial_capacity, dtype=dtype
         )
-        self.n_clusters = n_clusters
-        self.n_probe = min(n_probe, n_clusters)
+        self.n_clusters = max(2, n_clusters)
+        self.n_probe = min(n_probe, self.n_clusters)
+        # configured cluster count: retrains restart from it — n_clusters grows
+        # via splits within ONE train, and must not compound across retrains
+        # (the probed fraction would silently shrink every corpus doubling).
+        # n_probe is NOT reset: it is the caller's tuning knob.
+        self._n_clusters_base = self.n_clusters
         self.train_iters = train_iters
         self._centroids: jax.Array | None = None
-        self._assign = np.full(self.capacity, -1, dtype=np.int32)  # host mirror
+        # host mirrors: primary assignment + spill candidate (2nd-nearest)
+        self._assign = np.full(self.capacity, -1, dtype=np.int32)
+        self._assign2 = np.full(self.capacity, -1, dtype=np.int32)
         self._buckets: jax.Array | None = None
+        self._bucket_cap: int | None = None  # set by _split_oversized at train
         self._trained_at = 0  # corpus size at last (re)train
+        self._host_cache: "tuple | None" = None  # f32 mirrors for the CPU path
 
     # -- DenseKNNStore hooks -------------------------------------------------
 
     def _after_grow(self, old_capacity: int, extra: int) -> None:
-        self._assign = np.concatenate(
-            [self._assign, np.full(extra, -1, dtype=np.int32)]
-        )
+        pad = np.full(extra, -1, dtype=np.int32)
+        self._assign = np.concatenate([self._assign, pad])
+        self._assign2 = np.concatenate([self._assign2, pad.copy()])
         self._buckets = None  # geometry changed; rebuild lazily
 
     def _after_flush_adds(self, padded_slots: np.ndarray, vecs: jax.Array) -> None:
-        # assign the new rows to centroids (one small device pass) unless a
+        # assign the new rows to centroids (chunked device passes) unless a
         # retrain will re-assign everything anyway
         if self._centroids is not None:
-            cn = jnp.sum(self._centroids * self._centroids, axis=1)
-            sim = 2.0 * vecs @ self._centroids.T - cn[None, :]
-            self._assign[padded_slots] = np.asarray(
-                jnp.argmax(sim, axis=1), dtype=np.int32
-            )
+            top2 = self._assign_rows(vecs)
+            self._assign[padded_slots] = top2[:, 0]
+            self._assign2[padded_slots] = top2[:, 1]
         self._buckets = None
+        self._host_cache = None
 
     def _after_flush_removals(self) -> None:
         self._buckets = None
+        self._host_cache = None
 
-    # training runs on a SAMPLE (faiss-style): k-means cost and its (n, C)
+    # training runs on a SAMPLE (faiss-style): k-means cost and its (chunk, C)
     # intermediates stay bounded however large the corpus grows
-    _TRAIN_SAMPLE_PER_CLUSTER = 64
+    _TRAIN_SAMPLE_PER_CLUSTER = 32
+
+    def _assign_rows(self, rows: jax.Array) -> np.ndarray:
+        """Top-2 centroid assignment for ``rows``, chunked so BOTH the
+        (chunk, C) affinity and the (chunk, dim) block stay within a fixed
+        memory budget at any cluster count / dimensionality."""
+        chunk = max(1024, (1 << 28) // max(self.n_clusters, self.dim, 1))
+        parts = []
+        for start in range(0, rows.shape[0], chunk):
+            parts.append(
+                np.asarray(_assign2_kernel(rows[start : start + chunk], self._centroids))
+            )
+        return np.concatenate(parts) if parts else np.zeros((0, 2), dtype=np.int32)
 
     def _maybe_train(self) -> None:
         n = len(self.slot_of)
@@ -162,6 +213,7 @@ class IvfKnnStore(DenseKNNStore):
         needs = self._centroids is None or n >= 2 * max(self._trained_at, 1)
         if not needs:
             return
+        self.n_clusters = self._n_clusters_base
         rng = np.random.default_rng(0)
         live = np.fromiter(self.slot_of.values(), dtype=np.int64)
         seeds = rng.choice(live, size=self.n_clusters, replace=len(live) < self.n_clusters)
@@ -169,42 +221,116 @@ class IvfKnnStore(DenseKNNStore):
         init = self._data[jnp.asarray(seeds)].astype(jnp.float32)
         sample_cap = self.n_clusters * self._TRAIN_SAMPLE_PER_CLUSTER
         if len(live) > sample_cap:
-            sample = rng.choice(live, size=sample_cap, replace=False)
-            train_vecs = self._data[jnp.asarray(np.sort(sample))].astype(jnp.float32)
-            train_valid = jnp.ones((sample_cap,), dtype=bool)
+            sample = np.sort(rng.choice(live, size=sample_cap, replace=False))
         else:
             # gather LIVE rows only: casting the whole preallocated buffer to
             # f32 would materialize capacity x dim (multi-GB for a large store)
-            train_vecs = self._data[jnp.asarray(np.sort(live))].astype(jnp.float32)
-            train_valid = jnp.ones((len(live),), dtype=bool)
-        centroids, _ = _kmeans_kernel(
-            train_vecs, train_valid, init, self.train_iters
-        )
-        self._centroids = centroids
-        # assign the FULL corpus to the trained centroids, chunked so the
-        # (chunk, C) affinity stays small
-        assign = np.full(self.capacity, -1, dtype=np.int32)
-        cn = jnp.sum(centroids * centroids, axis=1)
-        chunk = max(1, (1 << 22) // max(self.n_clusters, 1))
-        for start in range(0, self.capacity, chunk):
-            block = self._data[start : start + chunk]
-            sim = 2.0 * block @ centroids.T - cn[None, :]
-            assign[start : start + chunk] = np.asarray(
-                jnp.argmax(sim, axis=1), dtype=np.int32
+            sample = np.sort(live)
+        train_vecs = self._data[jnp.asarray(sample)].astype(jnp.float32)
+        n_train = len(sample)
+        pad = (-n_train) % _KMEANS_CHUNK
+        if pad:
+            train_vecs = jnp.concatenate(
+                [train_vecs, jnp.zeros((pad, self.dim), jnp.float32)]
             )
-        self._assign = assign
+        train_valid = jnp.arange(n_train + pad) < n_train
+        self._centroids = _kmeans_kernel(train_vecs, train_valid, init, self.train_iters)
+        # assign the FULL corpus to the trained centroids (chunked device passes)
+        top2 = self._assign_rows(self._data)
+        self._assign = top2[:, 0].copy()
+        self._assign2 = top2[:, 1].copy()
+        self._split_oversized(live)
         self._trained_at = n
         self._buckets = None
+
+    @staticmethod
+    def _cap_for(n_live: int, n_clusters: int) -> int:
+        """Target per-cluster occupancy: ~1.5x the mean, rounded up to pow2 —
+        the padded bucket width search pays for."""
+        mean = max(1, n_live // max(n_clusters, 1))
+        cap = 8
+        while cap < (3 * mean + 1) // 2:
+            cap *= 2
+        return cap
+
+    def _split_oversized(self, live: np.ndarray) -> None:
+        """Bound the bucket width by SPLITTING oversized clusters instead of
+        letting the padded (C, B) matrix track the most bloated one: each
+        cluster past the cap gets a host-side 2-means over its members, the
+        centroid is replaced by the pair, and siblings cross-link as each
+        other's spill target. k-means over manifold-clustered corpora routinely
+        leaves a handful of clusters at 3-4x the mean; without splits the whole
+        inverted-list matrix doubles its width for them."""
+        if not len(live):
+            return
+        cap = self._cap_for(len(live), self.n_clusters)
+        self._bucket_cap = cap
+        limit = 2 * self.n_clusters  # at most double the cluster count
+        cents = np.array(self._centroids, dtype=np.float32)
+        for _ in range(6):  # each round halves offenders; 6 covers 64x skew
+            al = self._assign[live]
+            counts = np.bincount(al, minlength=self.n_clusters)
+            over = np.where(counts > cap)[0]
+            if not len(over) or self.n_clusters + len(over) > limit:
+                break
+            order = np.argsort(al, kind="stable")
+            starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+            new_rows: List[np.ndarray] = []
+            for c in over:
+                mem = live[order[starts[c] : starts[c] + counts[c]]]
+                vecs = np.asarray(
+                    self._data[jnp.asarray(mem)].astype(jnp.float32)
+                )
+                # 2-means, host-side (members are a few thousand rows at most)
+                c0, c1 = vecs[0], vecs[len(vecs) // 2]
+                for _it in range(6):
+                    d0 = np.sum((vecs - c0) ** 2, axis=1)
+                    d1 = np.sum((vecs - c1) ** 2, axis=1)
+                    g1 = d1 < d0
+                    if g1.all() or (~g1).all():
+                        break
+                    c0 = vecs[~g1].mean(axis=0)
+                    c1 = vecs[g1].mean(axis=0)
+                new_id = self.n_clusters
+                self.n_clusters += 1
+                self._assign[mem[g1]] = new_id
+                self._assign2[mem[g1]] = c
+                self._assign2[mem[~g1]] = new_id
+                cents[c] = c0
+                new_rows.append(c1[None, :])
+            if new_rows:
+                cents = np.concatenate([cents] + new_rows)
+        self._centroids = jnp.asarray(cents)
+        self.n_probe = min(self.n_probe, self.n_clusters)
 
     def _rebuild_buckets(self) -> None:
         """Pack live slots into the padded (C, B) inverted-list matrix — one
         vectorized sort + fancy-index pass (this reruns after every mutation
-        batch, so it must not walk the corpus in Python)."""
+        batch, so it must not walk the corpus in Python).
+
+        The padded width B is what search pays for (candidates per probe =
+        n_probe * B), so oversized clusters are rebalanced first: overflow
+        members past ~1.5x the mean spill to their 2nd-nearest centroid. A
+        spilled point sits in a cluster whose centroid is nearly as close, so
+        probes still find it; the win is a bounded B instead of B tracking the
+        most bloated cluster."""
         live = np.fromiter(self.slot_of.values(), dtype=np.int64)
         counts = np.zeros(self.n_clusters, dtype=np.int64)
+        a = np.zeros(0, dtype=np.int32)
         if len(live):
-            a = self._assign[live]
+            a = self._assign[live].copy()
+            a2 = self._assign2[live]
             counts = np.bincount(a, minlength=self.n_clusters)
+            cap = self._bucket_cap or self._cap_for(len(live), self.n_clusters)
+            over = np.where(counts > cap)[0]
+            if len(over):
+                order = np.argsort(a, kind="stable")
+                starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+                for c in over:
+                    tail = order[starts[c] + cap : starts[c] + counts[c]]
+                    mv = tail[a2[tail] != c]
+                    a[mv] = a2[mv]
+                counts = np.bincount(a, minlength=self.n_clusters)
         width = max(8, int(counts.max()) if len(live) else 8)
         bucket_width = 8
         while bucket_width < width:
@@ -219,6 +345,51 @@ class IvfKnnStore(DenseKNNStore):
             buckets[sorted_a, pos] = sorted_slots
         self._buckets = jnp.asarray(buckets)
 
+    def _search_numpy(
+        self, queries: np.ndarray, k_eff: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Host BLAS path for CPU backends: XLA's gather on CPU is orders of
+        magnitude slower than numpy fancy-indexing + batched matmul, and the
+        algorithm (probe -> gather -> exact score -> top-k) is identical."""
+        if self._host_cache is None:
+            self._host_cache = (
+                np.asarray(self._data.astype(jnp.float32)),
+                np.asarray(self._valid),
+                np.asarray(self._norms),
+            )
+        data, valid, norms = self._host_cache
+        cents = np.asarray(self._centroids)
+        buckets = np.asarray(self._buckets)
+        cn = np.sum(cents * cents, axis=1)
+        out_s: List[np.ndarray] = []
+        out_i: List[np.ndarray] = []
+        cand_per_q = self.n_probe * buckets.shape[1]
+        q_chunk = max(1, (1 << 27) // max(cand_per_q * self.dim, 1))
+        for start in range(0, queries.shape[0], q_chunk):
+            q = queries[start : start + q_chunk]
+            aff = 2.0 * q @ cents.T - cn[None, :]
+            probe = np.argpartition(aff, -self.n_probe, axis=1)[:, -self.n_probe :]
+            cand = buckets[probe].reshape(q.shape[0], -1)
+            ok = cand >= 0
+            safe = np.maximum(cand, 0)
+            vecs = data[safe]  # (q, m, d)
+            scores = np.matmul(vecs, q[:, :, None])[:, :, 0]
+            if self.metric == "l2sq":
+                qn = np.sum(q * q, axis=1, keepdims=True)
+                scores = -(qn + norms[safe] - 2.0 * scores)
+            elif self.metric == "cos":
+                qn = np.linalg.norm(q, axis=1, keepdims=True)
+                scores = scores / np.maximum(qn * np.sqrt(norms[safe]), 1e-30)
+            scores = np.where(ok & valid[safe], scores, -np.inf)
+            kk = min(k_eff, scores.shape[1])
+            part = np.argpartition(scores, -kk, axis=1)[:, -kk:]
+            psc = np.take_along_axis(scores, part, axis=1)
+            order = np.argsort(-psc, axis=1)
+            top_pos = np.take_along_axis(part, order, axis=1)
+            out_s.append(np.take_along_axis(scores, top_pos, axis=1))
+            out_i.append(np.take_along_axis(cand, top_pos, axis=1).astype(np.int64))
+        return np.concatenate(out_s), np.concatenate(out_i), None  # type: ignore[return-value]
+
     def search_batch(self, queries: Any, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         self._flush()
         self._maybe_train()
@@ -231,6 +402,17 @@ class IvfKnnStore(DenseKNNStore):
             )
         if self._buckets is None:
             self._rebuild_buckets()
+        k_eff = max(1, k)
+        if jax.default_backend() == "cpu":
+            q_np = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
+            scores, idx, _ = self._search_numpy(q_np, k_eff)
+            valid = np.isfinite(scores)
+            if scores.shape[1] < k_eff:
+                pad = k_eff - scores.shape[1]
+                scores = np.pad(scores, ((0, 0), (0, pad)), constant_values=-np.inf)
+                idx = np.pad(idx, ((0, 0), (0, pad)), constant_values=-1)
+                valid = np.pad(valid, ((0, 0), (0, pad)), constant_values=False)
+            return scores, idx, valid
         if isinstance(queries, jax.Array):
             if queries.dtype != jnp.float32:
                 queries = queries.astype(jnp.float32)
@@ -240,7 +422,6 @@ class IvfKnnStore(DenseKNNStore):
             queries = jnp.asarray(
                 np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
             )
-        k_eff = max(1, k)
         # chunk the query batch so the (chunk, n_probe * bucket_width, dim)
         # candidate gather stays within a fixed HBM budget
         cand_per_q = self.n_probe * int(self._buckets.shape[1])

@@ -27,16 +27,39 @@ class Backend:
         return b
 
     @classmethod
-    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+    def s3(
+        cls,
+        root_path: str,
+        bucket_settings: Any = None,
+        *,
+        _client_factory: Any = None,
+    ) -> "Backend":
         b = cls(root_path)
         b.kind = "s3"
+        b.bucket_settings = bucket_settings
+        b._client_factory = _client_factory
         return b
 
     @classmethod
-    def azure(cls, root_path: str, account: Any = None, **kw: Any) -> "Backend":
+    def azure(
+        cls,
+        root_path: str,
+        account: Any = None,
+        *,
+        _client_factory: Any = None,
+        **kw: Any,
+    ) -> "Backend":
         b = cls(root_path)
         b.kind = "azure"
+        b.account = account
+        b._client_factory = _client_factory
+        b.kwargs = kw
         return b
+
+    def make_object_store(self) -> Any:
+        from pathway_tpu.persistence.backends import make_object_store
+
+        return make_object_store(self)
 
     @classmethod
     def mock(cls, events: Any = None) -> "Backend":

@@ -1,0 +1,224 @@
+"""Object-store persistence backends: S3 / Azure / memory behind one surface.
+
+Parity: reference ``src/persistence/backends/mod.rs:50`` defines the
+``PersistenceBackend`` trait (``list_keys`` / ``get_value`` / ``put_value`` /
+``remove_key``) with filesystem, S3 (``backends/s3.rs``), Azure and mock
+implementations; the metadata and snapshot layers are written against the trait.
+
+Here the same contract is ``ObjectStore``. Journal frames become immutable
+numbered objects (object stores have no append — a PUT per commit gives the
+same crash guarantee as the fs backend's fsync-per-frame: a frame either fully
+exists or doesn't), checkpoints are single-PUT blobs (atomic per key), and
+compaction deletes subsumed frame objects. Clients are injectable the same way
+the S3 scanner's are (``io/s3.py``), so hermetic tests run the full engine
+against an in-memory or directory-backed fake.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+
+class ObjectStore:
+    """Minimal durable key -> bytes contract the persistence engine needs."""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> "bytes | None":
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
+        """Keys under ``prefix``, SORTED — journal replay order rides on it."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+
+def _is_not_found(exc: Exception) -> bool:
+    """Distinguish 'object does not exist' from transient store errors: a
+    throttle or network failure must NOT read as an absent checkpoint — the
+    runner would silently start fresh and later overwrite the good checkpoint."""
+    if isinstance(exc, (KeyError, FileNotFoundError)):
+        return True  # fakes / dict-backed clients
+    resp = getattr(exc, "response", None)  # botocore ClientError surface
+    if isinstance(resp, dict):
+        code = str(resp.get("Error", {}).get("Code", ""))
+        return code in ("NoSuchKey", "NoSuchBucket", "404", "NotFound")
+    return type(exc).__name__ in ("ResourceNotFoundError", "BlobNotFound")
+
+
+class PrefixedStore(ObjectStore):
+    """A namespaced view over another store (per-process shards, cached-object
+    subtrees) — every key gets the prefix applied on the way in/out."""
+
+    def __init__(self, inner: ObjectStore, prefix: str):
+        self._inner = inner
+        self._prefix = prefix.strip("/") + "/" if prefix.strip("/") else ""
+
+    def put(self, key: str, data: bytes) -> None:
+        self._inner.put(self._prefix + key, data)
+
+    def get(self, key: str) -> "bytes | None":
+        return self._inner.get(self._prefix + key)
+
+    def list(self, prefix: str) -> List[str]:
+        cut = len(self._prefix)
+        return [k[cut:] for k in self._inner.list(self._prefix + prefix)]
+
+    def delete(self, key: str) -> None:
+        self._inner.delete(self._prefix + key)
+
+
+class MemoryObjectStore(ObjectStore):
+    def __init__(self) -> None:
+        self.objects: Dict[str, bytes] = {}
+
+    def put(self, key: str, data: bytes) -> None:
+        self.objects[key] = bytes(data)
+
+    def get(self, key: str) -> "bytes | None":
+        return self.objects.get(key)
+
+    def list(self, prefix: str) -> List[str]:
+        return sorted(k for k in self.objects if k.startswith(prefix))
+
+    def delete(self, key: str) -> None:
+        self.objects.pop(key, None)
+
+
+class S3ObjectStore(ObjectStore):
+    """Over the boto3 S3 client surface (list_objects_v2 / get_object /
+    put_object / delete_object) — the exact surface ``io/s3.py`` readers use,
+    so the same injectable fakes exercise both paths."""
+
+    def __init__(self, client: Any, bucket: str, prefix: str):
+        self._client = client
+        self._bucket = bucket
+        self._prefix = prefix.strip("/")
+
+    def _full(self, key: str) -> str:
+        return f"{self._prefix}/{key}" if self._prefix else key
+
+    def put(self, key: str, data: bytes) -> None:
+        self._client.put_object(Bucket=self._bucket, Key=self._full(key), Body=bytes(data))
+
+    def get(self, key: str) -> "bytes | None":
+        try:
+            resp = self._client.get_object(Bucket=self._bucket, Key=self._full(key))
+        except Exception as exc:
+            if _is_not_found(exc):
+                return None
+            raise
+        return resp["Body"].read()
+
+    def list(self, prefix: str) -> List[str]:
+        from pathway_tpu.io.s3 import _list_objects
+
+        cut = len(self._prefix) + 1 if self._prefix else 0
+        return [
+            o["Key"][cut:]
+            for o in _list_objects(self._client, self._bucket, self._full(prefix))
+        ]
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.delete_object(Bucket=self._bucket, Key=self._full(key))
+        except Exception as exc:
+            # deleting an absent object is fine; a transient failure is NOT —
+            # compaction/rewind callers rely on the object actually going away
+            if not _is_not_found(exc):
+                raise
+
+
+class AzureObjectStore(ObjectStore):
+    """Over the azure-storage-blob ContainerClient surface (upload_blob /
+    download_blob / list_blob_names / delete_blob)."""
+
+    def __init__(self, container_client: Any, prefix: str):
+        self._client = container_client
+        self._prefix = prefix.strip("/")
+
+    def _full(self, key: str) -> str:
+        return f"{self._prefix}/{key}" if self._prefix else key
+
+    def put(self, key: str, data: bytes) -> None:
+        self._client.upload_blob(self._full(key), bytes(data), overwrite=True)
+
+    def get(self, key: str) -> "bytes | None":
+        try:
+            return self._client.download_blob(self._full(key)).readall()
+        except Exception as exc:
+            if _is_not_found(exc):
+                return None
+            raise
+
+    def list(self, prefix: str) -> List[str]:
+        full = self._full(prefix)
+        names = self._client.list_blob_names(name_starts_with=full)
+        cut = len(self._prefix) + 1 if self._prefix else 0
+        return sorted(str(n)[cut:] for n in names)
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.delete_blob(self._full(key))
+        except Exception as exc:
+            if not _is_not_found(exc):
+                raise
+
+
+def _default_azure_factory(account: Any, root_path: str, kw: dict) -> Any:
+    try:
+        from azure.storage.blob import ContainerClient  # type: ignore
+    except ImportError as exc:
+        raise ImportError(
+            "no Azure client library (azure-storage-blob) in this environment; pass "
+            "_client_factory=... (any object with the ContainerClient upload_blob/"
+            "download_blob/list_blob_names/delete_blob surface)"
+        ) from exc
+    container = kw.get("container") or root_path.split("/", 1)[0]
+    return ContainerClient(
+        account_url=f"https://{account}.blob.core.windows.net", container_name=container,
+        credential=kw.get("credential"),
+    )
+
+
+def make_object_store(backend: Any) -> ObjectStore:
+    """Build the ObjectStore for a ``persistence.Backend`` (s3/azure kinds)."""
+    root = str(backend.root or "")
+    if backend.kind == "s3":
+        from pathway_tpu.io.s3 import _default_client_factory, _split_uri
+
+        factory: "Callable[[Any], Any]" = (
+            getattr(backend, "_client_factory", None) or _default_client_factory
+        )
+        settings = getattr(backend, "bucket_settings", None)
+        client = factory(settings)
+        if root.startswith("s3://"):
+            bucket, prefix = _split_uri(root, settings)
+        else:
+            bucket = getattr(settings, "bucket_name", None) or ""
+            prefix = root
+            if not bucket:
+                raise ValueError(
+                    "S3 persistence root must be s3://bucket/prefix or "
+                    "bucket_settings must carry bucket_name"
+                )
+        return S3ObjectStore(client, bucket, prefix)
+    if backend.kind == "azure":
+        factory = getattr(backend, "_client_factory", None)
+        account = getattr(backend, "account", None)
+        kw = getattr(backend, "kwargs", {})
+        if factory is not None:
+            client = factory(account)
+        else:
+            client = _default_azure_factory(account, root, kw)
+        # container from kwargs -> the WHOLE root is the blob prefix; otherwise
+        # the root's first segment names the container and the rest prefixes
+        if kw.get("container") or factory is not None:
+            prefix = root
+        else:
+            prefix = root.split("/", 1)[1] if "/" in root else ""
+        return AzureObjectStore(client, prefix)
+    raise ValueError(f"no object store for backend kind {backend.kind!r}")

@@ -37,16 +37,20 @@ class CachedObjectStorage:
     the surviving events, so the state survives restarts.
     """
 
-    def __init__(self, root: str | os.PathLike | None):
-        # root=None -> in-memory only (the mock/memory persistence backends)
-        self._dir = None if root is None else os.path.join(str(root), _OBJECTS_DIR)
+    def __init__(self, root: "str | os.PathLike | None", store: Any = None):
+        # root=None, store=None -> in-memory only (mock/memory persistence
+        # backends); store=ObjectStore -> durable over S3/Azure-style objects
+        self._store = store
+        self._dir = (
+            None if (root is None or store is not None) else os.path.join(str(root), _OBJECTS_DIR)
+        )
         if self._dir is not None:
             os.makedirs(self._dir, exist_ok=True)
         self._events: Dict[int, tuple] = {}  # version -> (uri, meta | None=delete)
         self._blobs: Dict[int, bytes] = {}  # in-memory blobs (root=None)
         self._latest: Dict[str, int] = {}  # uri -> version of its live event
         self._version = 0
-        if self._dir is not None:
+        if self._dir is not None or self._store is not None:
             self._reload()
 
     # -- event persistence ----------------------------------------------------
@@ -57,15 +61,34 @@ class CachedObjectStorage:
     def _blob_path(self, version: int) -> str:
         return os.path.join(self._dir, f"{version}{_BLOB_EXT}")
 
-    def _reload(self) -> None:
+    def _meta_key(self, version: int) -> str:
+        return f"{_OBJECTS_DIR}/{version}{_META_EXT}"
+
+    def _blob_key(self, version: int) -> str:
+        return f"{_OBJECTS_DIR}/{version}{_BLOB_EXT}"
+
+    def _iter_meta_payloads(self) -> "Iterable[bytes]":
+        if self._store is not None:
+            for key in self._store.list(f"{_OBJECTS_DIR}/"):
+                if key.endswith(_META_EXT):
+                    blob = self._store.get(key)
+                    if blob is not None:
+                        yield blob
+            return
         for name in os.listdir(self._dir):
-            if not name.endswith(_META_EXT):
-                continue
+            if name.endswith(_META_EXT):
+                try:
+                    with open(os.path.join(self._dir, name), "rb") as f:
+                        yield f.read()
+                except OSError:
+                    continue
+
+    def _reload(self) -> None:
+        for payload in self._iter_meta_payloads():
             try:
-                with open(os.path.join(self._dir, name)) as f:
-                    event = json.load(f)
+                event = json.loads(payload)
                 version = int(event["version"])
-            except (ValueError, KeyError, OSError):
+            except (ValueError, KeyError):
                 continue  # torn write: a partial event never becomes state
             self._events[version] = (
                 event["uri"],
@@ -87,7 +110,22 @@ class CachedObjectStorage:
         self._version += 1
         version = self._version
         self._events[version] = (uri, meta)
-        if self._dir is None:
+        if self._store is not None:
+            if blob is not None:
+                self._store.put(self._blob_key(version), blob)
+            # metadata written AFTER the blob: an event exists once its meta does
+            self._store.put(
+                self._meta_key(version),
+                json.dumps(
+                    {
+                        "uri": uri,
+                        "version": version,
+                        "type": "update" if meta is not None else "delete",
+                        "metadata": meta,
+                    }
+                ).encode(),
+            )
+        elif self._dir is None:
             if blob is not None:
                 self._blobs[version] = blob
         else:
@@ -114,7 +152,10 @@ class CachedObjectStorage:
     def _drop_event(self, version: int) -> None:
         self._events.pop(version, None)
         self._blobs.pop(version, None)
-        if self._dir is not None:
+        if self._store is not None:
+            self._store.delete(self._meta_key(version))
+            self._store.delete(self._blob_key(version))
+        elif self._dir is not None:
             for path in (self._meta_path(version), self._blob_path(version)):
                 try:
                     os.unlink(path)
@@ -139,6 +180,11 @@ class CachedObjectStorage:
 
     def get_object(self, uri: str) -> bytes:
         version = self._latest[uri]
+        if self._store is not None:
+            blob = self._store.get(self._blob_key(version))
+            if blob is None:
+                raise KeyError(uri)
+            return blob
         if self._dir is None:
             return self._blobs[version]
         with open(self._blob_path(version), "rb") as f:
@@ -177,7 +223,10 @@ class CachedObjectStorage:
 
     def clear(self) -> None:
         self.rewind(0)
-        if self._dir is not None:
+        if self._store is not None:
+            for key in self._store.list(f"{_OBJECTS_DIR}/"):
+                self._store.delete(key)
+        elif self._dir is not None:
             shutil.rmtree(self._dir, ignore_errors=True)
             os.makedirs(self._dir, exist_ok=True)
 

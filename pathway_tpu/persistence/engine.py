@@ -56,28 +56,43 @@ class PersistenceManager:
 
     def __init__(self, config: Any):
         backend = config.backend
-        if backend is None or backend.kind not in ("filesystem", "memory", "mock"):
+        if backend is None or backend.kind not in (
+            "filesystem", "memory", "mock", "s3", "azure"
+        ):
             raise ValueError(
                 f"persistence backend {getattr(backend, 'kind', None)!r} not supported; "
-                "use pw.persistence.Backend.filesystem(path)"
+                "use pw.persistence.Backend.filesystem/s3/azure(...)"
             )
         self.config = config
         self.root = backend.root
-        self._memory = backend.kind in ("memory", "mock") or self.root is None
-        if not self._memory:
-            from pathway_tpu.internals.config import get_pathway_config
+        self._object_store: Any = None
+        self._object_prefix = ""
+        self._next_seq = 0
+        if backend.kind in ("s3", "azure"):
+            # object-store mode: journal frames are immutable numbered objects —
+            # object stores have no append, and a PUT per commit frame gives the
+            # fs backend's fsync-per-frame crash guarantee (a frame either fully
+            # exists or doesn't; no torn tails)
+            self._object_store = backend.make_object_store()
+            self._memory = False
+        else:
+            self._memory = backend.kind in ("memory", "mock") or self.root is None
+        from pathway_tpu.internals.config import get_pathway_config
 
-            cfg = get_pathway_config()
-            if cfg.processes > 1:
-                # spawned replicas each own a journal shard; a shared file would
-                # interleave frames from different processes into garbage
+        cfg = get_pathway_config()
+        if cfg.processes > 1 and (self._object_store is not None or not self._memory):
+            # spawned replicas each own a journal shard; a shared file would
+            # interleave frames from different processes into garbage
+            if self._object_store is not None:
+                self._object_prefix = f"process-{cfg.process_id}/"
+            else:
                 self.root = os.path.join(str(self.root), f"process-{cfg.process_id}")
         self._mem_journal: io.BytesIO = io.BytesIO()
         self._journal_file: Any = None
         # byte offset of the last complete frame, set by load_journal; open_for_append
         # truncates torn tail bytes past it so new frames never land after garbage
         self._valid_end: Optional[int] = None
-        if not self._memory:
+        if not self._memory and self._object_store is None:
             os.makedirs(self.root, exist_ok=True)
 
     def _journal_path(self) -> str:
@@ -85,8 +100,30 @@ class PersistenceManager:
 
     # -- journal write path --------------------------------------------------
 
+    # -- object-store mode helpers -------------------------------------------
+
+    def _meta_key(self) -> str:
+        return f"{self._object_prefix}meta"
+
+    def _frame_key(self, seq: int) -> str:
+        return f"{self._object_prefix}journal/{seq:010d}.frame"
+
+    def _checkpoint_key(self) -> str:
+        return f"{self._object_prefix}{_CHECKPOINT}"
+
     def open_for_append(self, graph_sig: str) -> None:
         header = _HEADER_MAGIC + graph_sig.encode() + b"\n"
+        if self._object_store is not None:
+            if self._object_store.get(self._meta_key()) is None:
+                self._object_store.put(self._meta_key(), header)
+            existing = self._object_store.list(f"{self._object_prefix}journal/")
+            seqs = [
+                int(k.rsplit("/", 1)[-1].split(".")[0])
+                for k in existing
+                if k.endswith(".frame")
+            ]
+            self._next_seq = max(seqs) + 1 if seqs else 0
+            return
         if self._memory:
             if self._valid_end is not None:
                 self._mem_journal.truncate(self._valid_end)
@@ -128,6 +165,10 @@ class PersistenceManager:
             ),
             protocol=pickle.HIGHEST_PROTOCOL,
         )
+        if self._object_store is not None:
+            self._object_store.put(self._frame_key(self._next_seq), frame)
+            self._next_seq += 1
+            return
         buf = _FRAME_HEADER.pack(len(frame)) + frame
         if self._memory:
             self._mem_journal.write(buf)
@@ -149,7 +190,19 @@ class PersistenceManager:
 
         cache = getattr(self, "_cached_objects", None)
         if cache is None:
-            cache = CachedObjectStorage(None if self._memory else self.root)
+            if self._object_store is not None:
+                from pathway_tpu.persistence.backends import PrefixedStore
+
+                # share the journal's per-process namespace: replicas must not
+                # interleave cached-object versions in one objects/ tree
+                store = (
+                    PrefixedStore(self._object_store, self._object_prefix)
+                    if self._object_prefix
+                    else self._object_store
+                )
+                cache = CachedObjectStorage(None, store=store)
+            else:
+                cache = CachedObjectStorage(None if self._memory else self.root)
             self._cached_objects = cache
         return cache
 
@@ -163,6 +216,17 @@ class PersistenceManager:
             {"sig": graph_sig, "commit_id": commit_id, "state": blob},
             protocol=pickle.HIGHEST_PROTOCOL,
         )
+        if self._object_store is not None:
+            # single-PUT checkpoint is atomic per key; then compact by deleting
+            # the subsumed frame objects. A crash between the two steps leaves
+            # stale frames <= commit_id, which load skips by id.
+            self._object_store.put(self._checkpoint_key(), payload)
+            for key in self._object_store.list(f"{self._object_prefix}journal/"):
+                if key.endswith(".frame"):
+                    seq = int(key.rsplit("/", 1)[-1].split(".")[0])
+                    if seq < self._next_seq:
+                        self._object_store.delete(key)
+            return
         if self._memory:
             self._mem_checkpoint = payload
             self._mem_journal = io.BytesIO()
@@ -182,7 +246,11 @@ class PersistenceManager:
         os.fsync(self._journal_file.fileno())
 
     def load_checkpoint(self, graph_sig: str) -> Optional[Tuple[int, dict]]:
-        if self._memory:
+        if self._object_store is not None:
+            payload = self._object_store.get(self._checkpoint_key())
+            if payload is None:
+                return None
+        elif self._memory:
             payload = getattr(self, "_mem_checkpoint", None)
             if payload is None:
                 return None
@@ -216,7 +284,47 @@ class PersistenceManager:
 
     def load_journal(self, graph_sig: str) -> List[Tuple[int, Dict[int, Delta], Dict[int, dict]]]:
         """All complete frames; a truncated tail frame (crash mid-write) is dropped and
-        marked for truncation by ``open_for_append``."""
+        marked for truncation by ``open_for_append``. Object-store mode has no
+        torn tails — PUTs are atomic — so every listed frame object is whole."""
+        if self._object_store is not None:
+            meta = self._object_store.get(self._meta_key())
+            if meta is not None:
+                if not meta.startswith(_HEADER_MAGIC):
+                    return []
+                stored_sig = meta[len(_HEADER_MAGIC) :].rstrip(b"\n").decode()
+                if stored_sig != graph_sig:
+                    raise ValueError(
+                        "persisted journal was written by a different dataflow graph; "
+                        "clear the persistence prefix or keep the program unchanged"
+                    )
+            frames_o: List[Tuple[int, Dict[int, Delta], Dict[int, dict]]] = []
+            # sorted() belt-and-braces: frame keys are zero-padded so lexicographic
+            # order IS replay order, but a custom store may list unsorted
+            for key in sorted(self._object_store.list(f"{self._object_prefix}journal/")):
+                if not key.endswith(".frame"):
+                    continue
+                blob = self._object_store.get(key)
+                if blob is None:
+                    continue
+                try:
+                    commit_id, payloads, offsets = pickle.loads(blob)
+                except Exception as exc:
+                    # PUTs are atomic, so a frame object is never torn — an
+                    # unreadable one means store-side corruption; truncating
+                    # here would silently drop every LATER committed frame
+                    raise ValueError(
+                        f"persisted journal frame {key!r} is unreadable; refusing to "
+                        "resume with missing commits — restore the object or clear "
+                        "the persistence prefix to start fresh"
+                    ) from exc
+                frames_o.append(
+                    (
+                        commit_id,
+                        {nid: _payload_to_delta(p) for nid, p in payloads.items()},
+                        offsets,
+                    )
+                )
+            return frames_o
         if self._memory:
             data = self._mem_journal.getvalue()
         else:

@@ -51,3 +51,82 @@ class FakeChat(UDF):
             return f"{self.prefix}{content[-80:]}"
 
         self.func = chat
+
+
+class _DirS3Body:
+    def __init__(self, data: bytes):
+        self._data = data
+
+    def read(self) -> bytes:
+        return self._data
+
+
+class DirS3Client:
+    """boto3 S3 client surface backed by a local directory — objects survive
+    process kills (PUT = atomic temp+rename), so cross-process persistence
+    torture tests can exercise the real S3 code path hermetically."""
+
+    def __init__(self, root: str, page_size: int = 100):
+        import os
+
+        self.root = str(root)
+        self.page_size = page_size
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, bucket: str, key: str) -> str:
+        import os
+
+        return os.path.join(self.root, bucket, key)
+
+    def put_object(self, Bucket: str, Key: str, Body: bytes) -> dict:
+        import os
+
+        path = self._path(Bucket, Key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp-put"
+        with open(tmp, "wb") as f:
+            f.write(Body if isinstance(Body, bytes) else Body.read())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return {}
+
+    def get_object(self, Bucket: str, Key: str) -> dict:
+        with open(self._path(Bucket, Key), "rb") as f:
+            return {"Body": _DirS3Body(f.read())}
+
+    def delete_object(self, Bucket: str, Key: str) -> dict:
+        import os
+
+        try:
+            os.unlink(self._path(Bucket, Key))
+        except OSError:
+            pass
+        return {}
+
+    def list_objects_v2(self, Bucket: str, Prefix: str, ContinuationToken=None) -> dict:
+        import os
+
+        base = os.path.join(self.root, Bucket)
+        keys = []
+        for dirpath, _dirs, files in os.walk(base):
+            for name in files:
+                if name.endswith(".tmp-put"):
+                    continue
+                full = os.path.join(dirpath, name)
+                key = os.path.relpath(full, base).replace(os.sep, "/")
+                if key.startswith(Prefix):
+                    keys.append(key)
+        keys.sort()
+        start = int(ContinuationToken) if ContinuationToken else 0
+        page = keys[start : start + self.page_size]
+        truncated = start + self.page_size < len(keys)
+        out = {
+            "Contents": [
+                {"Key": k, "Size": os.path.getsize(self._path(Bucket, k))} for k in page
+            ],
+            "IsTruncated": truncated,
+        }
+        if truncated:
+            out["NextContinuationToken"] = str(start + self.page_size)
+        return out
