@@ -229,6 +229,36 @@ def bench_embedder() -> dict:
     }
 
 
+def _vs_corpus(n_docs: int) -> list:
+    """The vector-store bench corpus — ONE construction shared by the main
+    serving bench and the non-embed floor bench (they must measure the same
+    workload for the decomposition to mean anything)."""
+    import json as _json
+
+    rng = np.random.default_rng(1)
+    words = [f"term{i}" for i in range(500)]
+    return [
+        (" ".join(words[j] for j in rng.integers(0, 500, 12)), _json.dumps({"path": f"doc{i}"}))
+        for i in range(n_docs)
+    ]
+
+
+def _vs_poster(port: int):
+    import json as _json
+    import urllib.request
+
+    def post(route: str, payload: dict, timeout: float = 60.0) -> dict:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{route}",
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return _json.loads(resp.read())
+
+    return post
+
+
 def bench_vector_store(port: int = 18715) -> dict:
     """BASELINE #3: VectorStoreServer end-to-end over REST (ingest + query p50)."""
     import json as _json
@@ -241,12 +271,7 @@ def bench_vector_store(port: int = 18715) -> dict:
 
     pg.G.clear()
     n_docs = 2_000 if DEVICE_SCALE_DOWN else 20_000
-    rng = np.random.default_rng(1)
-    words = [f"term{i}" for i in range(500)]
-    docs = [
-        (" ".join(words[j] for j in rng.integers(0, 500, 12)), _json.dumps({"path": f"doc{i}"}))
-        for i in range(n_docs)
-    ]
+    docs = _vs_corpus(n_docs)
     doc_table = pw.debug.table_from_rows(
         pw.schema_builder({"data": str, "_metadata": str}), docs
     )
@@ -267,15 +292,7 @@ def bench_vector_store(port: int = 18715) -> dict:
     server = VectorStoreServer(doc_table, embedder=embedder)
     t_start = time.perf_counter()
     server.run_server(host="127.0.0.1", port=port, threaded=True, terminate_on_error=False)
-
-    def post(route: str, payload: dict, timeout: float = 60.0) -> dict:
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{port}{route}",
-            data=_json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return _json.loads(resp.read())
+    post = _vs_poster(port)
 
     # ingest time: until statistics reports the corpus indexed
     deadline = time.perf_counter() + 600
@@ -298,68 +315,6 @@ def bench_vector_store(port: int = 18715) -> dict:
         t1 = time.perf_counter()
         post("/v1/retrieve", {"query": f"term{i} term{i+40} term{i+80}", "k": 3})
         lat.append(time.perf_counter() - t1)
-
-    # MEASURED non-embed serving floor (r4 verdict: a residual computed as
-    # p50 - batched_embed_amortization is not a measurement): the IDENTICAL
-    # REST -> engine -> KNN path on a second server whose embedder is an
-    # instant deterministic hash — no model forward anywhere in the loop, so
-    # this p50 IS the REST + engine + search floor.
-    import hashlib
-
-    pg.G.clear()
-
-    @pw.udf
-    def _instant_embed(text: str) -> np.ndarray:
-        # same 384-dim as the production encoder: the KNN matmul/norm cost
-        # scales with dim, so a smaller floor embedding would understate the
-        # search share of the floor
-        h = np.frombuffer(
-            hashlib.md5(text.encode()).digest() * 24, dtype=np.uint8
-        ).astype(np.float32)
-        return h / (np.linalg.norm(h) + 1e-9)
-
-    doc_table2 = pw.debug.table_from_rows(
-        pw.schema_builder({"data": str, "_metadata": str}), docs
-    )
-    floor_server = VectorStoreServer(doc_table2, embedder=_instant_embed)
-    floor_port = port + 1
-    floor_server.run_server(
-        host="127.0.0.1", port=floor_port, threaded=True, terminate_on_error=False
-    )
-
-    def post_floor(route: str, payload: dict, timeout: float = 60.0) -> dict:
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{floor_port}{route}",
-            data=_json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return _json.loads(resp.read())
-
-    floor_deadline = time.perf_counter() + 300
-    floor_ready = False
-    while time.perf_counter() < floor_deadline:
-        try:
-            stats = post_floor("/v1/statistics", {}, timeout=5)
-            if int(stats.get("file_count", 0)) >= 1:
-                floor_ready = True
-                break
-        except Exception:
-            pass
-        time.sleep(0.25)
-    nonembed_p50_ms = None
-    if floor_ready:
-        # a floor-server failure must not discard the already-measured numbers
-        try:
-            post_floor("/v1/retrieve", {"query": "term1 term2", "k": 3})  # warmup
-            floor_lat = []
-            for i in range(30):
-                t1 = time.perf_counter()
-                post_floor("/v1/retrieve", {"query": f"term{i} term{i+11}", "k": 3})
-                floor_lat.append(time.perf_counter() - t1)
-            nonembed_p50_ms = float(np.median(floor_lat)) * 1000.0
-        except Exception:
-            pass
 
     # latency floor diagnostic: one device round-trip (a trivial jit + fetch).
     # On a tunneled TPU (axon) every RPC costs ~65 ms regardless of compute; the
@@ -391,9 +346,71 @@ def bench_vector_store(port: int = 18715) -> dict:
         "vs_query_p50_minus_rtt_ms": round(p50_ms - rtt_ms, 2),
         "vs_query_embed1_ms": round(embed_ms, 2),
         "vs_query_nonembed_ms": round(p50_ms - embed_ms, 2),
-        "vs_query_nonembed_p50_ms": (
-            round(nonembed_p50_ms, 2) if nonembed_p50_ms is not None else "floor-server timeout"
-        ),
+    }
+
+
+def bench_vs_floor(port: int = 18731) -> dict:
+    """MEASURED non-embed serving floor (r4 verdict: a residual computed as
+    p50 - batched_embed_amortization is not a measurement): the IDENTICAL
+    REST -> engine -> KNN serving path with an instant deterministic hash
+    embedder — no model forward anywhere in the loop, so this p50 IS the
+    REST + engine + search floor. Runs as its own section/subprocess so the
+    model server's background threads don't inflate it."""
+    import hashlib
+    import json as _json
+    import urllib.request
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    pg.G.clear()
+    n_docs = 2_000 if DEVICE_SCALE_DOWN else 20_000
+    rng = np.random.default_rng(1)
+    words = [f"term{i}" for i in range(500)]
+    docs = [
+        (" ".join(words[j] for j in rng.integers(0, 500, 12)), _json.dumps({"path": f"doc{i}"}))
+        for i in range(n_docs)
+    ]
+
+    @pw.udf
+    def _instant_embed(text: str) -> np.ndarray:
+        # same 384-dim as the production encoder: the KNN matmul/norm cost
+        # scales with dim, so a smaller floor embedding would understate the
+        # search share of the floor
+        h = np.frombuffer(
+            hashlib.md5(text.encode()).digest() * 24, dtype=np.uint8
+        ).astype(np.float32)
+        return h / (np.linalg.norm(h) + 1e-9)
+
+    doc_table = pw.debug.table_from_rows(
+        pw.schema_builder({"data": str, "_metadata": str}), docs
+    )
+    server = VectorStoreServer(doc_table, embedder=_instant_embed)
+    server.run_server(host="127.0.0.1", port=port, threaded=True, terminate_on_error=False)
+    post = _vs_poster(port)
+
+    deadline = time.perf_counter() + 240
+    while time.perf_counter() < deadline:
+        try:
+            stats = post("/v1/statistics", {}, timeout=5)
+            if int(stats.get("file_count", 0)) >= 1:
+                break
+        except Exception:
+            pass
+        time.sleep(0.25)
+    else:
+        return {"vsfloor_error": "ingest timeout"}
+
+    post("/v1/retrieve", {"query": "term1 term2", "k": 3})  # warmup
+    lat = []
+    for i in range(50):
+        t1 = time.perf_counter()
+        post("/v1/retrieve", {"query": f"term{i} term{i+11}", "k": 3})
+        lat.append(time.perf_counter() - t1)
+    return {
+        "vs_query_nonembed_p50_ms": round(float(np.median(lat)) * 1000.0, 2),
+        "vs_query_nonembed_p95_ms": round(float(np.percentile(lat, 95)) * 1000.0, 2),
     }
 
 
@@ -872,6 +889,7 @@ SUB_BENCHES: dict = {
     "window": lambda: bench_streaming_window(),
     "engine": lambda: bench_engine(),
     "vectorstore": lambda: bench_vector_store(),
+    "vsfloor": lambda: bench_vs_floor(),
     "sharded": lambda: bench_sharded(),
     "scale": lambda: bench_scale(),
 }
@@ -883,11 +901,11 @@ DEVICE_BOUND = {"knn", "embedder", "vectorstore", "scale"}
 # per-sub-bench wall deadlines (seconds): generous on device, tight at toy scale
 _DEADLINES_FULL = {
     "knn": 600, "embedder": 420, "window": 300,
-    "engine": 600, "vectorstore": 600, "sharded": 660, "scale": 1500,
+    "engine": 600, "vectorstore": 600, "vsfloor": 300, "sharded": 660, "scale": 1500,
 }
 _DEADLINES_SMALL = {
     "knn": 300, "embedder": 240, "window": 300,
-    "engine": 600, "vectorstore": 300, "sharded": 660, "scale": 420,
+    "engine": 600, "vectorstore": 300, "vsfloor": 300, "sharded": 660, "scale": 420,
 }
 
 

@@ -40,6 +40,7 @@ class GraphRunner:
         self._output_rows_this_commit = 0
         self._http_server: Any = None
         self.replay_outputs = True
+        self._shared_nonroot = False  # transparent-threads worker with rank > 0
         self._substep_deltas: Dict[int, Delta] = {}
         self._materialized: set = set()
         self._materialize_all = False  # nested iterate runners read states directly
@@ -235,6 +236,9 @@ class GraphRunner:
             self.evaluators[node.id] = evaluator_cls(node, self)
             columns = node.output.column_names() if node.output is not None else []
             self.states[node.id] = StateTable(columns)
+        shared_threads = self._cluster is not None and getattr(
+            self._cluster, "shared_inputs", False
+        )
         if self._cluster is not None:
             for node in self._nodes:
                 ev = self.evaluators[node.id]
@@ -246,11 +250,24 @@ class GraphRunner:
                 ev._cluster_barrier = node.kind in ("groupby", "join") or any(
                     p is not None for p in ev._cluster_policies
                 )
+                if shared_threads and isinstance(node, pg.OutputNode):
+                    # transparent-threads mode: sinks live on rank 0 only, so
+                    # every worker ships its output partition to the root —
+                    # callbacks stay single-threaded and see ALL rows, in the
+                    # same per-commit batches a 1-thread run delivers
+                    ev._cluster_policies = tuple("root" for _ in node.inputs)
+                    ev._cluster_barrier = True
         self._sources = [
             (node, self.evaluators[node.id])
             for node in self._nodes
             if isinstance(node, pg.InputNode)
         ]
+        self._shared_nonroot = shared_threads and self._cluster.me != 0
+        if self._shared_nonroot:
+            # transparent-threads mode, rank > 0: the ONE shared set of source
+            # objects is polled by rank 0 alone (rows reach this rank through
+            # the key exchange); touching them here would double-ingest
+            self._sources = []
         replay_frames = []
         if persistence_config is not None and persistence_config.backend is not None:
             from pathway_tpu.persistence.engine import PersistenceManager
@@ -563,7 +580,7 @@ class GraphRunner:
                     len(deltas.get(inp._node.id, ())) for inp in node.inputs
                 )
             if isinstance(node, pg.InputNode):
-                if neu:
+                if neu or self._shared_nonroot:
                     delta = Delta.empty(self.output_columns_of(node))
                 elif self._inject is not None:
                     # journal replay: feed the persisted delta instead of the source
@@ -574,6 +591,17 @@ class GraphRunner:
                     delta = evaluator.process([])
                 if not neu:
                     self._input_deltas[node.id] = delta
+                if self._cluster is not None and getattr(
+                    self._cluster, "shared_inputs", False
+                ):
+                    # transparent-threads mode: scatter the freshly ingested rows
+                    # by row key so rowwise/filter/join work downstream runs on
+                    # ALL ranks, not just the ingesting rank 0 (stateful ops
+                    # re-exchange by their own keys as usual). Lockstep: every
+                    # rank reaches this exchange each commit (rank > 0 with an
+                    # empty delta).
+                    tag = f"{self.current_time}:{node.id}:scatter".encode()
+                    delta = self._cluster.exchange_delta(tag, delta, delta.keys)
             else:
                 inputs = [
                     deltas.get(inp._node.id, Delta.empty(inp.column_names()))
@@ -775,7 +803,10 @@ class GraphRunner:
         for node in self._nodes:
             evaluator = self.evaluators.get(node.id)
             if isinstance(evaluator, OutputEvaluator):
-                evaluator.finish()
+                if not self._shared_nonroot:
+                    # transparent-threads rank > 0 shares rank 0's sink objects;
+                    # only rank 0 may fire their on_end notifications
+                    evaluator.finish()
             elif isinstance(evaluator, WithUniverseOfEvaluator):
                 evaluator.verify_universes()
         if self._persistence is not None:
@@ -799,6 +830,35 @@ class GraphRunner:
         from pathway_tpu.internals.config import get_pathway_config
 
         env_cfg = get_pathway_config()
+        if env_cfg.threads > 1 and not self._ready:
+            from pathway_tpu.parallel.cluster import in_thread_worker
+
+            if not in_thread_worker():
+                # PATHWAY_THREADS lane: fan this run out over worker threads
+                # (one shared graph; sources rank 0, compute key-partitioned,
+                # outputs centralized — identical output to a 1-thread run)
+                if env_cfg.processes > 1:
+                    raise NotImplementedError(
+                        "PATHWAY_THREADS > 1 combined with PATHWAY_PROCESSES > 1 "
+                        "(thread workers inside each spawned process) needs a "
+                        "hierarchical exchange that is not built; use spawn -n "
+                        "for multi-process or -t for multi-thread"
+                    )
+                from pathway_tpu.parallel.threads import run_shared_graph
+
+                run_shared_graph(
+                    self.graph,
+                    env_cfg.threads,
+                    dict(
+                        monitoring_level=monitoring_level,
+                        with_http_server=with_http_server,
+                        terminate_on_error=terminate_on_error,
+                        max_commits=max_commits,
+                        persistence_config=persistence_config,
+                        **kwargs,
+                    ),
+                )
+                return
         if persistence_config is None and env_cfg.replay_storage:
             # `pathway_tpu spawn --record` / `replay` contract (reference cli.py:166-284)
             from pathway_tpu import persistence as _pers

@@ -14,6 +14,7 @@ JAX mesh in ``pathway_tpu.parallel`` instead of OS threads.
 from __future__ import annotations
 
 import os
+import threading as _threading
 from dataclasses import dataclass, field
 
 
@@ -65,5 +66,27 @@ class PathwayConfig:
         )
 
 
+_tls = _threading.local()
+
+
+def set_thread_config(config: "PathwayConfig | None") -> None:
+    """Install (or clear, with None) a per-thread config override. Thread
+    workers (``parallel.threads.run_threads``) use this to present themselves
+    as rank ``process_id`` of a ``processes``-worker cluster — all the
+    process-keyed machinery (cluster policies, key bases, persistence shards,
+    parallel-reader partitioning) follows without knowing about threads."""
+    _tls.override = config
+
+
+def current_thread_config_override() -> "PathwayConfig | None":
+    """The override active on THIS thread, if any — threads spawned on behalf
+    of a worker (connector reader threads) must re-install it, since
+    threading.local state does not inherit."""
+    return getattr(_tls, "override", None)
+
+
 def get_pathway_config() -> PathwayConfig:
+    override = getattr(_tls, "override", None)
+    if override is not None:
+        return override
     return PathwayConfig.from_env()

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import threading as _threading
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
 
@@ -255,10 +256,34 @@ class ParseGraph:
 
 
 class _GraphProxy:
-    """Delegates to the current graph; swapped during ``pw.iterate`` body construction."""
+    """Delegates to the current graph; swapped during ``pw.iterate`` body
+    construction. Thread workers (``parallel.threads.run_threads`` — the
+    in-process analogue of ``spawn -n``) each own a PRIVATE graph: after
+    ``enter_thread_graph()`` every read/write of ``_current`` on that thread
+    resolves to the worker's graph, so N workers build N independent dataflows
+    from the same program, exactly like N spawned processes would."""
 
     def __init__(self) -> None:
-        self._current = ParseGraph()
+        self._main = ParseGraph()
+        self._tls = _threading.local()
+
+    @property
+    def _current(self) -> ParseGraph:
+        g = getattr(self._tls, "graph", None)
+        return g if g is not None else self._main
+
+    @_current.setter
+    def _current(self, graph: ParseGraph) -> None:
+        if getattr(self._tls, "graph", None) is not None:
+            self._tls.graph = graph
+        else:
+            self._main = graph
+
+    def enter_thread_graph(self) -> None:
+        self._tls.graph = ParseGraph()
+
+    def exit_thread_graph(self) -> None:
+        self._tls.graph = None
 
     def __getattr__(self, name: str):
         return getattr(self._current, name)

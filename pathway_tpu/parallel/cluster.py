@@ -285,14 +285,172 @@ class ClusterExchange:
         return Delta.concat(merged, columns)
 
 
+class ThreadExchangeHub:
+    """Shared mailbox for the in-process worker-thread exchange: the timely
+    shared-memory allocator's slot, where ``spawn -n``'s TCP mesh is its
+    process allocator (``external/timely-dataflow/communication/src/initialize.rs:25-31``
+    distinguishes exactly these two)."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.boxes: Dict[tuple, bytes] = {}  # (dst, src, tag) -> payload
+        self.cv = threading.Condition()
+        self.closed = False
+        # transparent-threads mode (one shared graph): sources ingest on rank 0
+        # and outputs centralize there; compute partitions across all ranks
+        self.shared_inputs = False
+
+    def close(self) -> None:
+        with self.cv:
+            self.closed = True
+            self.cv.notify_all()
+
+
+class ThreadExchange(ClusterExchange):
+    """``ClusterExchange``'s collectives and delta routing over an in-memory
+    transport: worker THREADS in one process instead of spawned processes.
+    All the lockstep/barrier semantics are inherited — only ``_send``/``_recv``
+    change (a dict handoff under one condition variable; no sockets, no
+    serializing between address spaces beyond the pickle the routing layer
+    already does)."""
+
+    def __init__(self, hub: ThreadExchangeHub, me: int):
+        # deliberately NOT calling super().__init__ — no sockets to wire
+        self.n = hub.n
+        self.me = me
+        self._hub = hub
+        self._conns = {p: None for p in range(hub.n) if p != me}  # peer ranks
+
+    def _send(self, peer: int, tag: bytes, payload: bytes) -> None:
+        with self._hub.cv:
+            self._hub.boxes[(peer, self.me, tag)] = payload
+            self._hub.cv.notify_all()
+
+    def _recv(self, peer: int, tag: bytes, timeout: float = 300.0) -> bytes:
+        deadline = time.monotonic() + timeout
+        key = (self.me, peer, tag)
+        with self._hub.cv:
+            while key not in self._hub.boxes:
+                if self._hub.closed:
+                    raise ConnectionError(
+                        f"worker thread {peer} shut down while waiting for {tag!r}"
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"worker thread {self.me} timed out waiting for {tag!r} "
+                        f"from worker {peer}"
+                    )
+                self._hub.cv.wait(timeout=min(remaining, 1.0))
+            return self._hub.boxes.pop(key)
+
+    def close(self) -> None:
+        self._hub.close()
+
+    @property
+    def shared_inputs(self) -> bool:
+        return self._hub.shared_inputs
+
+    # -- zero-serialization delta collectives --------------------------------
+    # Worker threads share one address space: deltas cross the exchange as
+    # OBJECT handoffs (the partition slice the routing already makes), not
+    # pickled bytes. This is the in-memory allocator's whole advantage — the
+    # TCP lane pays serialization because it must, this lane must not.
+
+    def exchange_delta(self, tag: bytes, delta: Any, route_keys: np.ndarray) -> Any:
+        from pathway_tpu.engine.columnar import Delta
+        from pathway_tpu.internals.keys import shard_of
+
+        owners = shard_of(route_keys, self.n)
+        for peer in self._conns:
+            rows = np.nonzero(owners == peer)[0]
+            self._send(peer, tag, delta.select(rows) if len(rows) else None)
+        mine = delta.select(np.nonzero(owners == self.me)[0])
+        merged = [mine]
+        for peer in sorted(self._conns):
+            part = self._recv(peer, tag)
+            if part is not None and len(part):
+                merged.append(part)
+        if len(merged) == 1:
+            return mine
+        return Delta.concat(merged, list(delta.columns))
+
+    def exchange_to_root(self, tag: bytes, delta: Any) -> Any:
+        from pathway_tpu.engine.columnar import Delta
+
+        columns = list(delta.columns)
+        if self.me != 0:
+            self._send(0, tag, delta if len(delta) else None)
+            for peer in self._conns:
+                if peer != 0:
+                    self._send(peer, tag, None)
+        else:
+            for peer in self._conns:
+                self._send(peer, tag, None)
+        received = {peer: self._recv(peer, tag) for peer in self._conns}
+        if self.me != 0:
+            return Delta.empty(columns)
+        merged = [delta]
+        for peer in sorted(received):
+            part = received[peer]
+            if part is not None and len(part):
+                merged.append(part)
+        if len(merged) == 1:
+            return delta
+        return Delta.concat(merged, columns)
+
+    def broadcast_merge(self, tag: bytes, delta: Any) -> Any:
+        from pathway_tpu.engine.columnar import Delta
+
+        columns = list(delta.columns)
+        payload = delta if len(delta) else None
+        for peer in self._conns:
+            self._send(peer, tag, payload)
+        by_rank: List[Any] = [None] * self.n
+        by_rank[self.me] = delta if len(delta) else None
+        for peer in self._conns:
+            by_rank[peer] = self._recv(peer, tag)
+        merged = [d for d in by_rank if d is not None and len(d)]
+        if not merged:
+            return Delta.empty(columns)
+        if len(merged) == 1:
+            return merged[0]
+        return Delta.concat(merged, columns)
+
+
+_thread_ctx = threading.local()
+
+
+def in_thread_worker() -> bool:
+    """True on a thread already bound to a worker exchange (prevents nested
+    fan-out when a worker's own ``pw.run`` consults PATHWAY_THREADS)."""
+    return getattr(_thread_ctx, "hub", None) is not None
+
+
+def set_thread_exchange(hub: "ThreadExchangeHub | None", me: int = 0) -> None:
+    """Bind this thread to a worker-thread exchange (``run_threads`` launcher);
+    None unbinds."""
+    _thread_ctx.hub = hub
+    _thread_ctx.me = me
+    _thread_ctx.exchange = None
+
+
 _cluster: Optional[ClusterExchange] = None
 _cluster_tried = False
 
 
 def get_cluster() -> Optional[ClusterExchange]:
     """Process-wide exchange, created from the spawn env on first use; None when
-    running single-process."""
+    running single-process. Worker threads bound to a ThreadExchangeHub get
+    their in-memory exchange instead."""
     global _cluster, _cluster_tried
+    hub = getattr(_thread_ctx, "hub", None)
+    if hub is not None:
+        ex = getattr(_thread_ctx, "exchange", None)
+        if ex is None:
+            ex = ThreadExchange(hub, _thread_ctx.me)
+            _thread_ctx.exchange = ex
+        return ex
     if _cluster_tried:
         return _cluster
     from pathway_tpu.internals.config import get_pathway_config
