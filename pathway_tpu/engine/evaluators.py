@@ -2489,6 +2489,20 @@ def _delta_from_rows(
     return Delta(keys, np.array(diffs, dtype=np.int64), columns)
 
 
+def wire_cluster_defaults(cls: type, policy: "str | None" = None) -> None:
+    """Install the Evaluator cluster protocol on an evaluator class defined
+    outside this module (iterate, row transformers): plumbing defaults plus an
+    optional constant input policy for every input (e.g. ``"root"`` to
+    centralize). One place to extend when the protocol grows."""
+    cls._cluster_policies = ()
+    cls._cluster_barrier = False
+    cls.CLUSTER_POLICIES = {}
+    if policy is None:
+        cls.cluster_input_policy = Evaluator.cluster_input_policy
+    else:
+        cls.cluster_input_policy = lambda self, idx, _p=policy: _p
+
+
 EVALUATORS: Dict[type, type] = {
     pg.InputNode: InputEvaluator,
     pg.RowwiseNode: RowwiseEvaluator,

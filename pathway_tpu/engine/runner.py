@@ -126,13 +126,13 @@ class GraphRunner:
         Lets evaluators resolve retraction rows against retracted upstream values."""
         return self._substep_deltas.get(node.id)
 
-    # Operators that still cannot run multi-process: iterate nests a whole
-    # sub-runner, and row transformers chase pointers across arbitrary rows.
-    # Everything else either exchanges (rowkey/custom routing), centralizes on
-    # process 0, or replicates (ix/external_index broadcast their lookup side) —
-    # see ``Evaluator.CLUSTER_POLICIES``. Running these multi-process would
-    # silently return per-process partial answers, so they fail loudly instead.
-    _CLUSTER_UNSUPPORTED = {"iterate", "iterate_result", "row_transformer"}
+    # The cluster blocklist is EMPTY: every operator kind runs multi-process.
+    # Kinds either exchange (rowkey/custom routing), centralize on process 0
+    # (sort, time behaviors, and — since r5 — iterate's nested fixpoint and
+    # row transformers' pointer-chasing context, which recompute from full
+    # state that cannot be co-partitioned), or replicate (ix/external_index
+    # broadcast their lookup side) — see ``Evaluator.CLUSTER_POLICIES``.
+    _CLUSTER_UNSUPPORTED: set = set()
 
     def setup(self, monitoring_level: Any = None, persistence_config: Any = None) -> None:
         # hot-path modules load now, not inside the first timed commit
@@ -154,77 +154,143 @@ class GraphRunner:
                 )
             from pathway_tpu.internals.expression import ColumnExpression
 
-            def cross_refs(node: pg.Node) -> bool:
-                found = [False]
+            def refs_in(node: pg.Node, value: Any) -> list:
+                found: list = []
 
-                def walk(value: Any) -> None:
-                    if isinstance(value, ColumnExpression):
-                        for ref in value._column_refs:
+                def walk(v: Any) -> None:
+                    if isinstance(v, ColumnExpression):
+                        for ref in v._column_refs:
                             if all(ref.table is not t for t in node.inputs):
-                                found[0] = True
-                    elif isinstance(value, dict):
-                        for v in value.values():
-                            walk(v)
-                    elif isinstance(value, (list, tuple)):
-                        for v in value:
-                            walk(v)
+                                found.append(ref.table)
+                    elif isinstance(v, dict):
+                        for x in v.values():
+                            walk(x)
+                    elif isinstance(v, (list, tuple)):
+                        for x in v:
+                            walk(x)
 
-                walk(node.config)
-                return found[0]
+                walk(value)
+                return found
 
-            # operators that move rows off their producing process (exchange,
-            # centralize, instance routing) — and everything downstream of one.
-            # Derived from the evaluator classes' cluster policies so a new
-            # policy-carrying evaluator can never be silently missed here.
+            # PLACEMENT analysis: which process holds each node's rows. Cross-
+            # table references resolve against locally materialized state, so a
+            # reference is legal exactly when both sides are co-located:
+            #   ("own",)     — rows live at shard_of(row_key): outputs of
+            #                  row-key / group-key exchanges through
+            #                  key-preserving chains (two such tables with the
+            #                  same universe are co-located by construction)
+            #   ("ingest",)  — never exchanged: rows sit where they entered
+            #   ("root",)    — centralized on process 0
+            #   ("at", id)   — produced at exchange/key-derivation point `id`
+            #   ("mixed",id) — inputs disagree; matches nothing but itself
             from pathway_tpu.engine.evaluators import EVALUATORS, Evaluator
 
-            def _repartitions(node: pg.Node) -> bool:
-                if node.kind in ("groupby", "join"):
-                    return True
+            _dummy_cache: dict = {}
+
+            def class_policies(node: pg.Node) -> tuple:
                 cls = EVALUATORS.get(type(node))
                 if cls is None:
-                    return False
-                if cls.cluster_input_policy is not Evaluator.cluster_input_policy:
-                    return True  # custom routing (presence sets, instances)
-                # "broadcast" replicates evaluator STATE only — output rows stay
-                # with their producing side (ix, external_index, gradual
-                # broadcast); every other policy moves rows
-                return any(
-                    p in ("rowkey", "custom", "root")
-                    for p in cls.CLUSTER_POLICIES.values()
-                )
+                    return tuple(None for _ in node.inputs)
+                import types as _types
 
-            repartitioned: set = set()
+                dummy = _dummy_cache.get(cls)
+                if dummy is None:
+                    dummy = _types.SimpleNamespace(CLUSTER_POLICIES=cls.CLUSTER_POLICIES)
+                    _dummy_cache[cls] = dummy
+                out = []
+                for i in range(len(node.inputs)):
+                    try:
+                        out.append(cls.cluster_input_policy(dummy, i))
+                    except Exception:
+                        out.append("custom")  # stateful override: assume it routes
+                return tuple(out)
+
+            _KEY_PRESERVING = {
+                "rowwise", "filter", "update_rows", "update_cells", "intersect",
+                "difference", "restrict", "having", "with_universe_of",
+                "remove_errors", "concat", "output", "asof_now_update",
+            }
+            _placement_cache: dict = {}
+
+            def placement(node: pg.Node) -> tuple:
+                got = _placement_cache.get(node.id)
+                if got is not None:
+                    return got
+                if isinstance(node, pg.InputNode):
+                    p: tuple = ("ingest",)
+                else:
+                    pol = class_policies(node)
+                    if "root" in pol:
+                        p = ("root",)
+                    elif node.kind == "groupby":
+                        # routed by group key == output row key
+                        p = ("own",)
+                    elif node.kind == "join" or "custom" in pol:
+                        # exchanged by a non-output key (join key, instance):
+                        # rows land at that key's owner, a place all its own
+                        p = ("at", node.id)
+                    elif "rowkey" in pol:
+                        p = ("own",)
+                    else:
+                        contrib = [
+                            placement(inp._node)
+                            for i, inp in enumerate(node.inputs)
+                            if pol[i] != "broadcast"
+                        ] or [placement(inp._node) for inp in node.inputs]
+                        if not contrib:
+                            p = ("ingest",)
+                        elif all(c == contrib[0] for c in contrib):
+                            p = contrib[0]
+                        else:
+                            p = ("mixed", node.id)
+                        if p == ("own",) and node.kind not in _KEY_PRESERVING:
+                            # key-changing op over key-owned rows: rows stay put
+                            # but no longer sit at their (new) key's owner
+                            p = ("at", node.id)
+                _placement_cache[node.id] = p
+                return p
+
+            # nested-graph kinds hold inner-table expressions in their config;
+            # the whole nested graph runs where the evaluator runs (root), so
+            # those are not cross-process references
+            _NESTED_KINDS = {"iterate", "iterate_result", "row_transformer", "row_transformer_result"}
             for node in self.graph.nodes:
-                if _repartitions(node) or any(
-                    inp._node.id in repartitioned for inp in node.inputs
-                ):
-                    repartitioned.add(node.id)
-            for node in self.graph.nodes:
-                if _repartitions(node) and cross_refs(node):
-                    raise NotImplementedError(
-                        f"node {node.id} ({node.kind}) references another table's "
-                        "materialized state; exchanged rows cannot resolve foreign "
-                        "state across spawn processes — inline the referenced "
-                        "columns (select them onto the input) or run single-process"
-                    )
-                if (
-                    isinstance(node, pg.RowwiseNode)
-                    and cross_refs(node)
-                    and (
-                        node.id in repartitioned
-                        or self._cross_ref_targets_repartitioned(node, repartitioned)
-                    )
-                ):
-                    # the referencing rows and the referenced state are no longer
-                    # co-located once either side crossed an exchange point
-                    raise NotImplementedError(
-                        f"node {node.id} (rowwise) cross-references a table on the "
-                        "far side of a cluster exchange point; the referenced state "
-                        "is partitioned differently from this node's rows — inline "
-                        "the referenced columns before the exchange (select/join "
-                        "them onto the input) or run single-process"
-                    )
+                if node.kind in _NESTED_KINDS:
+                    continue
+                if node.kind == "groupby":
+                    # the two expression sites evaluate in DIFFERENT frames:
+                    # grouping expressions run PRE-exchange (rows still at the
+                    # input's placement), reducer args run POST-exchange (rows
+                    # at the group key's owner, where no foreign table's shard
+                    # can be assumed present)
+                    config_no_grouping = {
+                        k: v for k, v in node.config.items() if k != "grouping"
+                    }
+                    if refs_in(node, config_no_grouping):
+                        raise NotImplementedError(
+                            f"node {node.id} (groupby) reducer arguments reference "
+                            "another table's state, which is evaluated after the "
+                            "group-key exchange where that state is not resident — "
+                            "inline the referenced columns before the groupby "
+                            "(select/join them onto the input) or run single-process"
+                        )
+                    refs = refs_in(node, node.config.get("grouping"))
+                    own = placement(node.inputs[0]._node)
+                else:
+                    refs = refs_in(node, node.config)
+                    own = placement(node)
+                for ref_table in refs:
+                    if placement(ref_table._node) != own:
+                        raise NotImplementedError(
+                            f"node {node.id} ({node.kind}) cross-references table "
+                            f"{ref_table._node.id}, whose rows are partitioned "
+                            f"differently across spawn processes "
+                            f"({placement(ref_table._node)} vs {own}); the "
+                            "referenced state cannot be resolved remotely — "
+                            "inline the referenced columns before the exchange "
+                            "(select/join them onto the input) or run "
+                            "single-process"
+                        )
 
         self._nodes = list(self.graph.nodes)
         for node in self._nodes:
@@ -649,32 +715,6 @@ class GraphRunner:
                 if node.output is not None and node.id in self._materialized:
                     self.states[node.id].apply(delta)
         return any_output
-
-    @staticmethod
-    def _cross_ref_targets_repartitioned(node: pg.Node, repartitioned: set) -> bool:
-        """True when any cross-table ref in ``node.config`` points at a table that
-        sits downstream of a cluster exchange point."""
-        from pathway_tpu.internals.expression import ColumnExpression
-
-        found = [False]
-
-        def walk(value: Any) -> None:
-            if isinstance(value, ColumnExpression):
-                for ref in value._column_refs:
-                    if (
-                        all(ref.table is not t for t in node.inputs)
-                        and ref.table._node.id in repartitioned
-                    ):
-                        found[0] = True
-            elif isinstance(value, dict):
-                for v in value.values():
-                    walk(v)
-            elif isinstance(value, (list, tuple)):
-                for v in value:
-                    walk(v)
-
-        walk(node.config)
-        return found[0]
 
     def _route_cluster_inputs(
         self, node: pg.Node, evaluator: Any, inputs: List[Delta]

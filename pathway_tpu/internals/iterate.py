@@ -294,11 +294,18 @@ class IterateResultEvaluator:
 
 
 def _wire_snapshot_protocol() -> None:
-    from pathway_tpu.engine.evaluators import Evaluator
+    from pathway_tpu.engine.evaluators import Evaluator, wire_cluster_defaults
 
     for cls in (IterateEvaluator, IterateResultEvaluator):
         cls.state_dict = Evaluator.state_dict
         cls.load_state_dict = Evaluator.load_state_dict
+    # multi-process lane: iterate CENTRALIZES on process 0 (the nested fixpoint
+    # recomputes from full input state, which cannot be co-partitioned — the
+    # reference threads a DD Variable feedback through every worker,
+    # ``src/engine/dataflow/variable.rs``; here the root runs the whole nested
+    # graph and downstream operators re-exchange its output by their own keys)
+    wire_cluster_defaults(IterateEvaluator, "root")
+    wire_cluster_defaults(IterateResultEvaluator)
 
 
 _wire_snapshot_protocol()

@@ -31,6 +31,11 @@ class Pointer:
     def __setattr__(self, *a: Any) -> None:
         raise AttributeError("Pointer is immutable")
 
+    def __reduce__(self):
+        # slots + frozen breaks pickle's default (it loads via __setattr__);
+        # pointers cross process boundaries in cluster exchanges and journals
+        return (Pointer, (self.hi, self.lo))
+
     def as_int(self) -> int:
         return (self.hi << 64) | self.lo
 

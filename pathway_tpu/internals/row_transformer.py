@@ -399,9 +399,16 @@ class RowTransformerResultEvaluator:
 def _register() -> None:
     from pathway_tpu.engine.evaluators import EVALUATORS, Evaluator
 
+    from pathway_tpu.engine.evaluators import wire_cluster_defaults
+
     for cls in (RowTransformerEvaluator, RowTransformerResultEvaluator):
         cls.state_dict = Evaluator.state_dict
         cls.load_state_dict = Evaluator.load_state_dict
+    # multi-process lane: row transformers chase pointers across ARBITRARY rows
+    # (reference ``complex_columns.rs`` builds the same all-rows context), so
+    # their input tables centralize on process 0 and outputs flow from there
+    wire_cluster_defaults(RowTransformerEvaluator, "root")
+    wire_cluster_defaults(RowTransformerResultEvaluator)
     EVALUATORS[pg.RowTransformerNode] = RowTransformerEvaluator
     EVALUATORS[pg.RowTransformerResultNode] = RowTransformerResultEvaluator
 

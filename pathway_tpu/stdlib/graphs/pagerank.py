@@ -27,8 +27,15 @@ def pagerank(edges: Table, steps: int = 5) -> Table:
                 degrees.degree == 0, 0, (ranks.rank * 5) // (degrees.degree * 6)
             ),
         )
-        inflows = edges.groupby(id=edges.v).reduce(
-            rank=reducers.sum(outflow.ix(edges.u).flow) + 1_000
+        # flow is INLINED onto the edges via an explicit join (not an ix cross
+        # reference): joins and groupbys exchange rows by key, so this runs
+        # unchanged under spawn -n N, where a reducer-side cross-table read
+        # could not be resolved remotely
+        contrib = edges.join(outflow, edges.u == outflow.id).select(
+            v=edges.v, flow=outflow.flow
+        )
+        inflows = contrib.groupby(id=contrib.v).reduce(
+            rank=reducers.sum(contrib.flow) + 1_000
         )
         combined = base.concat(inflows)
         combined.promise_universe_is_equal_to(degrees)

@@ -145,33 +145,153 @@ def test_spawn_join_exact_global_result(tmp_path):
     assert {kv: c for kv, c in got.items() if c != 0} == dict(expected)
 
 
-def test_spawn_unsupported_operator_fails_loudly(tmp_path):
-    # iterate nests a whole sub-runner — one of the kinds still refused under
-    # spawn (sort/dedup/behaviors/ix now exchange, centralize, or replicate)
-    prog = textwrap.dedent(
-        """
-        import pathway_tpu as pw
-        t = pw.debug.table_from_rows(pw.schema_builder({"a": int}), [(1,), (16,)])
-        halve = lambda t: dict(t=t.select(a=pw.if_else(t.a > 1, t.a // 2, t.a)))
-        s = pw.iterate(halve, t=t).t
-        pw.io.subscribe(s, lambda **kw: None)
-        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
-        """
+PAGERANK_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.graphs import pagerank
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    data = json.load(open(os.path.join(tmp, f"pr_input_{pid}.json")))
+    eraw = pw.debug.table_from_rows(
+        pw.schema_builder({"u_raw": int, "v_raw": int}), [tuple(r) for r in data]
     )
-    p = tmp_path / "prog.py"
-    p.write_text(prog)
-    env = os.environ.copy()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    out = subprocess.run(
-        [
-            sys.executable, "-m", "pathway_tpu.cli", "spawn",
-            "-n", "2", "--first-port", str(21000 + os.getpid() % 500 * 4),
-            sys.executable, str(p),
-        ],
-        env=env, capture_output=True, text=True, timeout=120, cwd=str(tmp_path),
+    edges = eraw.select(
+        u=eraw.pointer_from(eraw.u_raw), v=eraw.pointer_from(eraw.v_raw)
     )
-    assert out.returncode != 0
-    assert "not co-partitioned" in out.stderr
+    ranks = pagerank(edges, steps=3)
+    # rank rows come keyed by vertex pointer; recover the vertex label by join
+    verts = eraw.select(vid=eraw.v_raw).groupby(pw.this.vid).reduce(pw.this.vid)
+    labeled = verts.with_id(verts.pointer_from(pw.this.vid)).join(
+        ranks, pw.left.id == pw.right.id
+    ).select(pw.left.vid, pw.right.rank)
+    got = {}
+    pw.io.subscribe(
+        labeled,
+        lambda key, row, time, is_addition: got.__setitem__(str(row["vid"]), row["rank"])
+        if is_addition
+        else got.pop(str(row["vid"]), None),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(got, open(os.path.join(tmp, f"pr_out_{pid}.json"), "w"))
+    """
+)
+
+
+def test_spawn_pagerank_exact(tmp_path):
+    """pagerank (unrolled join/groupby rounds with same-universe cross refs,
+    which the placement analysis must admit) under spawn -n 2: edges split
+    across processes; ranks must equal the single-process run's."""
+    edges = [(i, 0) for i in range(1, 5)] + [(0, 1), (2, 1)]
+    shard0 = edges[::2]
+    shard1 = edges[1::2]
+
+    # single-process expected output
+    (tmp_path / "pr_input_0.json").write_text(json.dumps(edges))
+    _spawn(1, PAGERANK_PROG, tmp_path)
+    expected = json.loads((tmp_path / "pr_out_0.json").read_text())
+    assert expected, "single-process pagerank produced nothing"
+
+    (tmp_path / "pr_input_0.json").write_text(json.dumps(shard0))
+    (tmp_path / "pr_input_1.json").write_text(json.dumps(shard1))
+    _spawn(2, PAGERANK_PROG, tmp_path)
+    merged: dict = {}
+    for p in range(2):
+        out = json.loads((tmp_path / f"pr_out_{p}.json").read_text())
+        for vid, rank in out.items():
+            assert vid not in merged, f"vertex {vid} owned twice"
+            merged[vid] = rank
+    assert merged == expected
+
+
+ITERATE_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    rows = json.load(open(os.path.join(tmp, f"it_input_{pid}.json")))
+    t = pw.debug.table_from_rows(pw.schema_builder({"a": int}), [tuple(r) for r in rows])
+    halve = lambda t: dict(t=t.select(a=pw.if_else(t.a > 1, t.a // 2, t.a)))
+    s = pw.iterate(halve, t=t).t
+    total = s.reduce(n=pw.reducers.count(), s=pw.reducers.sum(pw.this.a))
+    got = []
+    pw.io.subscribe(
+        total,
+        lambda key, row, time, is_addition: got.append((row["n"], row["s"]))
+        if is_addition
+        else got.remove((row["n"], row["s"])),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(got, open(os.path.join(tmp, f"it_out_{pid}.json"), "w"))
+    """
+)
+
+
+def test_spawn_iterate_fixpoint_exact(tmp_path):
+    """pw.iterate (nested fixpoint, formerly blocklisted) under spawn -n 2:
+    inputs split across processes; the fixpoint centralizes on process 0 and
+    the global aggregate must equal the single-process answer."""
+    (tmp_path / "it_input_0.json").write_text(json.dumps([(1,), (16,), (7,)]))
+    (tmp_path / "it_input_1.json").write_text(json.dumps([(64,), (3,)]))
+    _spawn(2, ITERATE_PROG, tmp_path)
+    merged = []
+    for p in range(2):
+        merged.extend(
+            tuple(x) for x in json.loads((tmp_path / f"it_out_{p}.json").read_text())
+        )
+    # every value halves to 1: 5 rows, sum 5 (exactly one process owns the total)
+    assert merged == [(5, 5)], merged
+
+
+TRANSFORMER_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    rows = json.load(open(os.path.join(tmp, f"tr_input_{pid}.json")))
+
+    class OutputSchema(pw.Schema):
+        ret: int
+
+    @pw.transformer
+    class add_one:
+        class table(pw.ClassArg, output=OutputSchema):
+            arg = pw.input_attribute()
+
+            @pw.output_attribute
+            def ret(self) -> int:
+                return self.arg + 1
+
+    t = pw.debug.table_from_rows(pw.schema_builder({"arg": int}), [tuple(r) for r in rows])
+    ret = add_one(t).table
+    got = []
+    pw.io.subscribe(
+        ret,
+        lambda key, row, time, is_addition: got.append(row["ret"])
+        if is_addition
+        else got.remove(row["ret"]),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    json.dump(sorted(got), open(os.path.join(tmp, f"tr_out_{pid}.json"), "w"))
+    """
+)
+
+
+def test_spawn_row_transformer_exact(tmp_path):
+    """@pw.transformer (pointer-chasing, formerly blocklisted) under spawn -n 2:
+    rows split across processes; outputs must equal the single-process run's."""
+    (tmp_path / "tr_input_0.json").write_text(json.dumps([(i,) for i in range(1, 7)]))
+    (tmp_path / "tr_input_1.json").write_text(json.dumps([(i,) for i in range(7, 13)]))
+    _spawn(2, TRANSFORMER_PROG, tmp_path)
+    merged: list = []
+    for p in range(2):
+        merged.extend(json.loads((tmp_path / f"tr_out_{p}.json").read_text()))
+    assert sorted(merged) == list(range(2, 14))
 
 
 STREAMING_PROG = textwrap.dedent(

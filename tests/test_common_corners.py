@@ -842,3 +842,16 @@ def test_iterate_collatz_fixpoint():
 
     res = pw.iterate(collatz, t=t).t
     assert [r["v"] for r in _run(res)] == [1, 1, 1]
+
+
+def test_pointer_pickle_roundtrip():
+    """Slots + frozen Pointer must survive pickle (cluster exchange frames and
+    persistence journals carry Pointer cells in object columns)."""
+    import pickle
+
+    from pathway_tpu.internals.keys import Pointer
+
+    p = Pointer(0x1234_5678_9ABC_DEF0, 0xFEDC_BA98_7654_3210)
+    q = pickle.loads(pickle.dumps(p, protocol=pickle.HIGHEST_PROTOCOL))
+    assert (q.hi, q.lo) == (p.hi, p.lo)
+    assert q == p

@@ -269,3 +269,20 @@ def test_run_threads_fs_reader_shards_not_duplicated(tmp_path):
             assert word not in merged
             merged[word] = cnt
     assert merged == {"cat": 18}, merged
+
+
+def test_groupby_reducer_cross_ref_refused_under_cluster():
+    """Reducer arguments evaluate AFTER the group-key exchange, where a foreign
+    table's shard is not resident — must refuse loudly, not ERROR-poison."""
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"k": str, "v": int}), [(f"k{i}", i) for i in range(10)]
+    )
+    other = t.select(w=pw.this.v * 2)
+    agg = t.groupby(pw.this.k).reduce(pw.this.k, s=pw.reducers.sum(other.w))
+    _collect(agg)
+    config_mod.set_thread_config(_threads_config(2))
+    try:
+        with pytest.raises(RuntimeError, match="reducer arguments reference"):
+            GraphRunner(G._current).run()
+    finally:
+        config_mod.set_thread_config(None)
