@@ -458,12 +458,15 @@ def bench_engine() -> dict:
     incremental processing + delivery of the update batches.
 
     Reading the ratios: wordcount/join (string keys) are the headline bars
-    (>= 1.0x). join_int is secondary and sits ~0.4x by design tradeoff: the
-    proxy is a non-incremental branchless binary search over sorted int64s —
-    near the memory-bandwidth floor — while the engine maintains a fully
-    incremental, retraction-capable arrangement. The join_churn metric is the
-    same workload once the build side actually churns: there incrementality
-    wins ~2.5x, which is the workload this engine exists for. The engine delivers
+    (>= 1.0x). join_int is secondary and sits ~0.6x (r5: single-int keys now
+    derive via an identity mix instead of xxh3, and the inner all-matched emit
+    path skips its splicing — up from ~0.47): the proxy is a non-incremental
+    branchless binary search over sorted int64s near the memory-bandwidth
+    floor, while the engine maintains a fully incremental, retraction-capable
+    arrangement and gathers object-cell outputs; closing the rest needs typed
+    (non-object) string columns. The join_churn metric is the same workload
+    once the build side actually churns: there incrementality wins ~2.5x,
+    which is the workload this engine exists for. The engine delivers
     through the vectorized ``pw.io.subscribe(on_batch=...)`` sink (columnar arrays,
     the TPU-native delivery path); the proxies consume by updating their own
     result state. Join keys are string entity ids (the representative ETL join);

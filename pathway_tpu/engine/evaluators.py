@@ -1072,10 +1072,16 @@ class JoinEvaluator(Evaluator):
         offsets, match_slots = other.jkmap.probe(jkeys)
         counts = np.diff(offsets)
 
-        # matched events: row i of the delta x each matching other-side slot
-        ev_row = np.repeat(np.arange(n, dtype=np.int64), counts)
+        # matched events: row i of the delta x each matching other-side slot.
+        # Unique-key build sides (the common case) probe to exactly one match
+        # per row — the repeats collapse to identity/copy, skip them.
+        if len(match_slots) == n and counts[-1] == 1 and (counts == 1).all():
+            ev_row = np.arange(n, dtype=np.int64)
+            ev_d = diffs
+        else:
+            ev_row = np.repeat(np.arange(n, dtype=np.int64), counts)
+            ev_d = np.repeat(diffs, counts)
         ev_other = match_slots
-        ev_d = np.repeat(diffs, counts)
 
         null_rows = np.zeros(0, dtype=np.int64)
         null_d = np.zeros(0, dtype=np.int64)
@@ -1164,15 +1170,23 @@ class JoinEvaluator(Evaluator):
         n_m, n_nu = len(ev_d), len(null_d)
 
         # per-event row index into the delta (own side) / slot into other side; -1 null
-        own_rows = np.concatenate(
-            [ev_row, null_rows, np.full(len(flip_d), -1, dtype=np.int64)]
-        )
-        other_slots = np.concatenate(
-            [ev_other, np.full(len(null_d), -1, dtype=np.int64), flip_slots]
-        )
-        out_d = np.concatenate([ev_d, null_d, flip_d])
-        own_mask = own_rows >= 0
-        other_mask = other_slots >= 0
+        if n_nu == 0 and len(flip_d) == 0:
+            # inner-match-only pass (the common case): no null segments to
+            # splice — reuse the event arrays and a shared all-true mask
+            own_rows = ev_row
+            other_slots = ev_other
+            out_d = ev_d
+            own_mask = other_mask = np.ones(n_ev, dtype=bool)
+        else:
+            own_rows = np.concatenate(
+                [ev_row, null_rows, np.full(len(flip_d), -1, dtype=np.int64)]
+            )
+            other_slots = np.concatenate(
+                [ev_other, np.full(len(null_d), -1, dtype=np.int64), flip_slots]
+            )
+            out_d = np.concatenate([ev_d, null_d, flip_d])
+            own_mask = own_rows >= 0
+            other_mask = other_slots >= 0
 
         cache: Dict[str, np.ndarray] = {}
 

@@ -126,8 +126,64 @@ def _serialize_value(value: Any, out: list[bytes]) -> None:
             out.append(b"\x0a" + len(encoded).to_bytes(8, "little") + encoded)
 
 
+# -- single-int identity-mix keys --------------------------------------------
+# A row whose key derives from EXACTLY ONE int value uses a splitmix-style
+# 128-bit mix instead of salted xxh3 over its serialization (reference key
+# derivation from Value, ``value.rs`` — the single-int join/groupby key is the
+# hottest derivation; the mix keeps full 64->128 avalanche at ~10x less cost).
+# ``csrc/pathway_native.cc::pw_intkey_mix64`` is the exact native twin — every
+# derivation site must produce identical bits for equal values. Changing this
+# function invalidates persisted journals (keys are stored in frames).
+_INTKEY_LO = 0x9E3779B97F4A7C15
+_INTKEY_HI = 0xD6E8FEB86659FD93
+_MIX_M1 = 0xBF58476D1CE4E5B9
+_MIX_M2 = 0x94D049BB133111EB
+_U64 = (1 << 64) - 1
+_INT64_MIN, _INT64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def _mix64(x: int) -> int:
+    x ^= x >> 30
+    x = (x * _MIX_M1) & _U64
+    x ^= x >> 27
+    x = (x * _MIX_M2) & _U64
+    x ^= x >> 31
+    return x
+
+
+def _is_plain_int(value: Any) -> bool:
+    return (
+        isinstance(value, (int, np.integer))
+        and not isinstance(value, (bool, np.bool_))
+        and _INT64_MIN <= int(value) <= _INT64_MAX
+    )
+
+
+def _int_key(value: int) -> tuple[int, int]:
+    u = value & _U64
+    return _mix64(u ^ _INTKEY_HI), _mix64((u + _INTKEY_LO) & _U64)
+
+
+def _int_keys_array(col: np.ndarray) -> np.ndarray:
+    """Vectorized mix for an int64 column — bit-identical to the scalar/native."""
+    u = np.ascontiguousarray(col, dtype=np.int64).view(np.uint64)
+    out = np.empty(len(col), dtype=KEY_DTYPE)
+    def mix(x: np.ndarray) -> np.ndarray:
+        x = x ^ (x >> np.uint64(30))
+        x = x * np.uint64(_MIX_M1)
+        x = x ^ (x >> np.uint64(27))
+        x = x * np.uint64(_MIX_M2)
+        x = x ^ (x >> np.uint64(31))
+        return x
+    out["hi"] = mix(u ^ np.uint64(_INTKEY_HI))
+    out["lo"] = mix(u + np.uint64(_INTKEY_LO))
+    return out
+
+
 def pointer_from(*parts: Any) -> Pointer:
     """Fingerprint values into a key (reference ``Key::for_values``, ``value.rs:73``)."""
+    if len(parts) == 1 and _is_plain_int(parts[0]):
+        return Pointer(*_int_key(int(parts[0])))
     chunks: list[bytes] = [_SALT]
     for part in parts:
         _serialize_value(part, chunks)
@@ -236,7 +292,14 @@ def _python_keys(
 ) -> np.ndarray:
     """Reference Python serializer path (the native hashers are byte-identical)."""
     out = np.empty(n, dtype=KEY_DTYPE)
+    single = len(columns) == 1
+    mask0 = masks[0] if (single and masks is not None) else None
     for i in range(n):
+        if single and (mask0 is None or mask0[i]):
+            v = columns[0][i]
+            if _is_plain_int(v):
+                out["hi"][i], out["lo"][i] = _int_key(int(v))
+                continue
         chunks: list[bytes] = [_SALT]
         for j, col in enumerate(columns):
             if masks is not None and masks[j] is not None and not masks[j][i]:
@@ -259,6 +322,13 @@ def keys_from_values(
     else falls back to the Python serializer.
     """
     n = len(columns[0]) if columns else 0
+    if (
+        len(columns) == 1
+        and columns[0].dtype == np.int64
+        and (masks is None or masks[0] is None)
+    ):
+        # single-int64 column: the vectorized mix beats even the native hasher
+        return _int_keys_array(columns[0])
     if n >= 64:
         native_out = _native_keys(columns, n, masks)
         if native_out is not None:

@@ -78,7 +78,7 @@ class IntervalJoinResult:
         )
         lt = apply_temporal_behavior(lt, self.behavior, "_pw_t")
         lt = lt.with_columns(
-            _pw_buckets=expr.apply_with_type(left_buckets, tuple, lt._pw_t)
+            _pw_buckets=expr.apply_with_type(left_buckets, dt.List_(dt.INT), lt._pw_t)
         )
         lflat = lt.flatten(lt._pw_buckets, origin_id="_pw_left_id")
         rt = self.right.with_columns(_pw_t=self.right_time)

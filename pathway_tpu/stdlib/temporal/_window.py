@@ -19,10 +19,14 @@ from pathway_tpu.internals.table import Table, _name_of
 from pathway_tpu.internals import thisclass
 
 
-def _num(value: Any) -> Any:
-    if isinstance(value, datetime.timedelta):
-        return value
-    return value
+def _time_dtype(time_expr: expr.ColumnExpression) -> dt.DType:
+    """The window-bound dtype: same as the time column's (window starts/ends
+    are arithmetic on time values). Typing these keeps ``_pw_window_start``/
+    ``_pw_window_end`` in typed arrays downstream — the engine's columnar fast
+    paths only fire when dtypes survive windowing."""
+    from pathway_tpu.internals.type_interpreter import eval_type
+
+    return eval_type(time_expr).strip_optional()
 
 
 class Window(ABC):
@@ -47,7 +51,7 @@ class TumblingWindow(Window):
             k = (t - base) // duration
             return base + k * duration
 
-        start_e = expr.apply_with_type(window_start, dt.ANY, time_expr)
+        start_e = expr.apply_with_type(window_start, _time_dtype(time_expr), time_expr)
         with_cols = table.with_columns(
             _pw_window_start=start_e,
         )
@@ -79,7 +83,9 @@ class SlidingWindow(Window):
                 s -= hop
             return tuple(sorted(out))
 
-        starts = expr.apply_with_type(windows_for, tuple, time_expr)
+        starts = expr.apply_with_type(
+            windows_for, dt.List_(_time_dtype(time_expr)), time_expr
+        )
         with_starts = table.with_columns(_pw_window_start=starts)
         flat = with_starts.flatten(with_starts._pw_window_start)
         return flat.with_columns(_pw_window_end=flat._pw_window_start + duration)
@@ -308,7 +314,10 @@ def _assign_sessions(
                 return (s[0], s[-1])
         return (mytime, mytime)
 
-    bounds = expr.apply_with_type(session_bounds, tuple, t._pw_time, times_col)
+    td = _time_dtype(time_e)
+    bounds = expr.apply_with_type(
+        session_bounds, dt.Tuple_(td, td), t._pw_time, times_col
+    )
     with_bounds = t.with_columns(_pw_session=bounds)
     return with_bounds.with_columns(
         _pw_window_start=with_bounds._pw_session[0],
@@ -335,7 +344,10 @@ def _assign_intervals_over(
 
     matched = t.with_columns(
         _pw_window_start=expr.apply_with_type(
-            matching_ats, tuple, t._pw_time, with_ats._pw_ats_tuple
+            matching_ats,
+            dt.List_(_time_dtype(time_e)),
+            t._pw_time,
+            with_ats._pw_ats_tuple,
         )
     )
     flat = matched.flatten(matched._pw_window_start)

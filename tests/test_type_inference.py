@@ -1,0 +1,145 @@
+"""Static type inference over the expression AST and through windows.
+
+Parity: reference ``internals/type_interpreter.py`` (686 LoC of dtype
+propagation) — the inferred schema drives the engine's typed-column fast
+paths, so windows/temporal outputs must not silently demote to ANY/object.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+import pathway_tpu as pw
+import pathway_tpu.stdlib.temporal as temporal
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.type_interpreter import eval_type
+
+from .utils import T
+
+
+def _table():
+    return pw.debug.table_from_rows(
+        pw.schema_builder({"i": int, "f": float, "s": str, "b": bool}),
+        [(1, 1.5, "x", True)],
+    )
+
+
+def test_arithmetic_and_comparison_dtypes():
+    t = _table()
+    assert eval_type(t.i + t.i) == dt.INT
+    assert eval_type(t.i * t.f) == dt.FLOAT
+    assert eval_type(t.i / t.i) == dt.FLOAT  # truediv always floats
+    assert eval_type(t.i // t.i) == dt.INT
+    assert eval_type(t.i > t.f) == dt.BOOL
+    assert eval_type(t.s == t.s) == dt.BOOL
+    assert eval_type(t.s + t.s) == dt.STR
+    assert eval_type(~(t.i > 0)) == dt.BOOL
+    assert eval_type((t.b & (t.i > 1))) == dt.BOOL
+
+
+def test_ifelse_coalesce_and_optional():
+    t = _table()
+    assert eval_type(pw.if_else(t.b, t.i, t.i)) == dt.INT
+    assert eval_type(pw.if_else(t.b, t.i, t.f)) in (dt.FLOAT, dt.ANY)
+    assert eval_type(pw.coalesce(t.i, 0)) == dt.INT
+    assert eval_type(pw.cast(float, t.i)) == dt.FLOAT
+    tup = pw.make_tuple(t.i, t.s)
+    got = eval_type(tup)
+    assert isinstance(got, dt.Tuple_) and got.args == (dt.INT, dt.STR)
+    assert eval_type(tup[0]) == dt.INT
+    assert eval_type(tup[1]) == dt.STR
+
+
+def test_select_propagates_inferred_schema():
+    t = _table()
+    out = t.select(a=t.i + 1, b=t.f * 2.0, c=t.i > 3, d=t.s + "!")
+    cols = out._schema.columns()
+    assert cols["a"].dtype == dt.INT
+    assert cols["b"].dtype == dt.FLOAT
+    assert cols["c"].dtype == dt.BOOL
+    assert cols["d"].dtype == dt.STR
+
+
+def test_tumbling_window_columns_typed_int():
+    t = T(
+        """
+        t  | v
+        1  | 10
+        12 | 30
+        """
+    )
+    w = t.windowby(t.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    cols = w._schema.columns()
+    assert cols["start"].dtype == dt.INT, cols["start"].dtype
+    assert cols["end"].dtype == dt.INT
+    # the materialized output is a TYPED array, not object dtype
+    df = pw.debug.table_to_pandas(w)
+    assert df["start"].dtype.kind in "i", df["start"].dtype
+    assert sorted(df["start"]) == [0, 10]
+
+
+def test_sliding_window_columns_typed_through_flatten():
+    t = T(
+        """
+        t  | v
+        4  | 10
+        """
+    )
+    w = t.windowby(t.t, window=temporal.sliding(hop=2, duration=6)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    cols = w._schema.columns()
+    assert cols["start"].dtype == dt.INT, cols["start"].dtype
+    assert cols["end"].dtype == dt.INT
+    df = pw.debug.table_to_pandas(w)
+    assert df["start"].dtype.kind in "i"
+    assert sorted(df["start"]) == [0, 2, 4]
+
+
+def test_session_window_columns_typed():
+    t = T(
+        """
+        t   | v
+        1   | 1
+        2   | 1
+        30  | 1
+        """
+    )
+    w = t.windowby(t.t, window=temporal.session(max_gap=5)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    cols = w._schema.columns()
+    assert cols["start"].dtype == dt.INT, cols["start"].dtype
+    assert cols["end"].dtype == dt.INT
+    df = pw.debug.table_to_pandas(w)
+    assert df["start"].dtype.kind in "i"
+    assert sorted(zip(df["start"], df["end"])) == [(1, 2), (30, 30)]
+
+
+def test_datetime_window_columns_typed():
+    base = datetime.datetime(2025, 1, 1)
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"ts": dt.DATE_TIME_NAIVE, "v": int}),
+        [(base + datetime.timedelta(minutes=m), m) for m in (0, 5, 25)],
+    )
+    w = t.windowby(
+        t.ts, window=temporal.tumbling(duration=datetime.timedelta(minutes=10))
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert w._schema.columns()["start"].dtype == dt.DATE_TIME_NAIVE
+    df = pw.debug.table_to_pandas(w)
+    assert sorted(df["start"]) == [base, base + datetime.timedelta(minutes=20)]
