@@ -171,6 +171,107 @@ def bench_knn() -> dict:
     }
 
 
+def bench_ivf_scale() -> dict:
+    """Tentpole check (ISSUE 1): the IVF index must BEAT dense brute force at
+    >= 1M docs with recall@10 >= 0.95.
+
+    CPU-honest like the engine sections: both sides run the same backend at
+    FULL scale on any host — the IVF win is algorithmic (probing ~1-3% of the
+    corpus through the fused probe→gather→score path) rather than device-bound
+    — so this section does NOT scale down on device fallback; only
+    PW_BENCH_SMOKE shrinks it. Reports qps, p50 in MILLISECONDS, recall@10 vs
+    the dense store over the SAME corpus, the chosen n_probe, and the
+    recompile counters (shape-bucketed compilation keeps them bounded across
+    ragged serving batch sizes)."""
+    from pathway_tpu.ops.knn import DenseKNNStore, kernel_cache_sizes
+    from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+    n_docs = 100_000 if SMOKE else 1_000_000
+    dim, n_queries, k = 128, 1024, 10
+    n_centers = 1024
+    chunk = 100_000
+    rng = np.random.default_rng(11)
+    centers = rng.normal(scale=4.0, size=(n_centers, dim)).astype(np.float32)
+
+    def clustered(n: int) -> np.ndarray:
+        return (
+            centers[rng.integers(0, n_centers, n)]
+            + rng.normal(size=(n, dim)).astype(np.float32)
+        ).astype(np.float32)
+
+    data = clustered(n_docs)
+    queries = clustered(n_queries)
+    results: dict = {"ivf1m_docs": n_docs}
+
+    # dense comparator: the same store/kernel behind the headline knn_query_qps
+    dense = DenseKNNStore(dim, metric="l2sq", initial_capacity=n_docs)
+    for s in range(0, n_docs, chunk):
+        end = min(s + chunk, n_docs)
+        dense.add_many(list(range(s, end)), data[s:end])
+        dense._flush()
+    dense.search_batch(queries, k)  # compile off the clock
+    lat = []
+    for _ in range(3):
+        t1 = time.perf_counter()
+        _ds, dense_idx, _dv = dense.search_batch(queries, k)
+        lat.append(time.perf_counter() - t1)
+    med = float(np.median(lat))
+    results["ivf1m_dense_qps"] = round(n_queries / med, 1)
+    results["ivf1m_dense_p50_batch_ms"] = round(med * 1000.0, 2)
+    dense_keys = np.vectorize(lambda s_: dense.key_of.get(int(s_), -1))(dense_idx)
+    del dense
+
+    ivf = IvfKnnStore(
+        dim, metric="l2sq", initial_capacity=n_docs,
+        n_clusters=min(1024, max(16, n_docs // 512)), n_probe=16,
+    )
+    t0 = time.perf_counter()
+    for s in range(0, n_docs, chunk):
+        end = min(s + chunk, n_docs)
+        ivf.add_many(list(range(s, end)), data[s:end])
+        ivf._flush()
+    ivf.search_batch(queries[:8], k)  # train + compile off the clock
+    results["ivf1m_train_plus_ingest_s"] = round(time.perf_counter() - t0, 1)
+
+    def recall(idx_rows: np.ndarray) -> float:
+        keys = np.vectorize(lambda s_: ivf.key_of.get(int(s_), -1))(idx_rows)
+        return float(
+            np.mean(
+                [len(set(keys[r]) & set(dense_keys[r])) / k for r in range(len(idx_rows))]
+            )
+        )
+
+    # smallest probe count reaching the 0.95 recall@10 target (reported, so the
+    # artifact carries the operating point alongside the speed)
+    probe_cap = min(ivf.n_clusters, 256)
+    while True:
+        _s, tune_idx, _v = ivf.search_batch(queries[:128], k)
+        if recall(tune_idx) >= 0.95 or ivf.n_probe >= probe_cap:
+            break
+        ivf.n_probe = min(ivf.n_probe * 2, probe_cap)
+    results["ivf1m_n_probe"] = ivf.n_probe
+
+    lat = []
+    for _ in range(5):
+        t1 = time.perf_counter()
+        _s, ivf_idx, _v = ivf.search_batch(queries, k)
+        lat.append(time.perf_counter() - t1)
+    med = float(np.median(lat))
+    results["ivf1m_qps"] = round(n_queries / med, 1)
+    results["ivf1m_p50_batch_ms"] = round(med * 1000.0, 2)
+    results["ivf1m_recall_at_10"] = round(recall(ivf_idx), 4)
+    results["ivf1m_speedup_vs_dense"] = round(
+        results["ivf1m_qps"] / max(results["ivf1m_dense_qps"], 1e-9), 2
+    )
+    # ragged serving traffic: distinct batch sizes must land in a bounded set
+    # of pow2 shape buckets (the jit-cache regression this PR adds)
+    for nq in (1, 3, 7, 30, 100):
+        ivf.search_batch(queries[:nq], 3)
+    results["ivf1m_shape_buckets"] = len(ivf.search_shape_buckets)
+    results["ivf1m_kernel_compiles"] = kernel_cache_sizes()["ivf_query"]
+    return results
+
+
 def bench_embedder() -> dict:
     """BASELINE #2: SentenceTransformer batch-embed throughput on the TPU.
 
@@ -219,14 +320,19 @@ def bench_embedder() -> dict:
         attn_flops_per_token = cfg.num_layers * 4 * p2 * cfg.hidden_size
         total_flops += b2 * p2 * (mm_flops_per_token + attn_flops_per_token)
     tflops = total_flops / dt / 1e12
-    return {
+    out = {
         "embed_docs_per_s": round(len(texts) / dt, 1),
         "embed_tokens_per_s": round(n_tokens / dt, 1),
         "embed_host_tokenize_ms_per_batch": round(tok_s / (len(texts) / bs) * 1000, 2),
         "embed_dim": enc.dim,
         "embed_tflops_per_s": round(tflops, 2),
-        "embed_mfu_pct_v5e": round(100.0 * tflops / 197.0, 2),
     }
+    import jax
+
+    if jax.default_backend() == "tpu":
+        # MFU is quoted against v5e peak bf16 — meaningless for any other device
+        out["embed_mfu_pct_v5e"] = round(100.0 * tflops / 197.0, 2)
+    return out
 
 
 def _vs_corpus(n_docs: int) -> list:
@@ -335,9 +441,10 @@ def bench_vector_store(port: int = 18715) -> dict:
     rtt_ms = float(np.median(rtts)) * 1000.0
     p50_ms = float(np.median(lat)) * 1000.0
     # decomposition: the single-query model forward (embed_ms, measured above
-    # pre-server) vs everything else (REST + engine + search). An instant-
-    # embedder probe puts the non-embed share at ~7 ms on CPU — the 15 ms
-    # BASELINE p50 target is the embed cost plus this floor.
+    # pre-server) is reported alongside p50, NOT subtracted from it — the two
+    # are measured under different host contention so the difference is not a
+    # measurement (r5 artifact carried a negative "nonembed" residual). The
+    # MEASURED non-embed floor is bench_vs_floor's vs_query_nonembed_p50_ms.
     return {
         "vs_ingest_docs_per_s": round(n_docs / ingest_s, 1),
         "vs_query_p50_ms": round(p50_ms, 2),
@@ -345,7 +452,6 @@ def bench_vector_store(port: int = 18715) -> dict:
         "device_roundtrip_p50_ms": round(rtt_ms, 2),
         "vs_query_p50_minus_rtt_ms": round(p50_ms - rtt_ms, 2),
         "vs_query_embed1_ms": round(embed_ms, 2),
-        "vs_query_nonembed_ms": round(p50_ms - embed_ms, 2),
     }
 
 
@@ -888,6 +994,7 @@ def bench_sharded() -> dict:
 
 SUB_BENCHES: dict = {
     "knn": lambda: bench_knn(),
+    "ivfscale": lambda: bench_ivf_scale(),
     "embedder": lambda: bench_embedder(),
     "window": lambda: bench_streaming_window(),
     "engine": lambda: bench_engine(),
@@ -903,11 +1010,11 @@ DEVICE_BOUND = {"knn", "embedder", "vectorstore", "scale"}
 
 # per-sub-bench wall deadlines (seconds): generous on device, tight at toy scale
 _DEADLINES_FULL = {
-    "knn": 600, "embedder": 420, "window": 300,
+    "knn": 600, "ivfscale": 900, "embedder": 420, "window": 300,
     "engine": 600, "vectorstore": 600, "vsfloor": 300, "sharded": 660, "scale": 1500,
 }
 _DEADLINES_SMALL = {
-    "knn": 300, "embedder": 240, "window": 300,
+    "knn": 300, "ivfscale": 900, "embedder": 240, "window": 300,
     "engine": 600, "vectorstore": 300, "vsfloor": 300, "sharded": 660, "scale": 420,
 }
 

@@ -133,7 +133,13 @@ def _serialize_value(value: Any, out: list[bytes]) -> None:
 # hottest derivation; the mix keeps full 64->128 avalanche at ~10x less cost).
 # ``csrc/pathway_native.cc::pw_intkey_mix64`` is the exact native twin — every
 # derivation site must produce identical bits for equal values. Changing this
-# function invalidates persisted journals (keys are stored in frames).
+# function invalidates persisted journals (keys are stored in frames) — bump
+# KEY_DERIVATION_VERSION so the persistence layer refuses to resume them.
+
+# v1: salted xxh3 for every value kind. v2: splitmix identity mix for single-int
+# keys. Recorded in every journal/checkpoint header; persistence/engine.py
+# refuses to resume stores written under a different version.
+KEY_DERIVATION_VERSION = 2
 _INTKEY_LO = 0x9E3779B97F4A7C15
 _INTKEY_HI = 0xD6E8FEB86659FD93
 _MIX_M1 = 0xBF58476D1CE4E5B9

@@ -46,6 +46,71 @@ def _search_kernel(
     return top_scores, top_idx
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1): the shape-bucketing unit — every
+    jit'd search/scatter kernel sees pow2-padded batch shapes so its cache is
+    keyed by O(log) distinct buckets instead of one entry per raw size."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def pad_queries_pow2(q_dev: jax.Array, dim: int) -> Tuple[jax.Array, int]:
+    """Pad a device query batch with zero rows to the next pow2 count (floor
+    8) — the ONE bucketing policy shared by the dense and IVF search paths.
+    Returns (padded batch, original row count) for slicing results back."""
+    nq = q_dev.shape[0]
+    q_pad = next_pow2(max(8, nq))
+    if q_pad != nq:
+        q_dev = jnp.concatenate([q_dev, jnp.zeros((q_pad - nq, dim), q_dev.dtype)])
+    return q_dev, nq
+
+
+def kernel_cache_sizes() -> Dict[str, int]:
+    """Entries in each search kernel's jit cache — the recompile counter the
+    bench artifact reports and the jit-cache regression tests bound."""
+    from pathway_tpu.ops import knn_ivf
+
+    def sz(fn: Any) -> int:
+        try:
+            return int(fn._cache_size())
+        except Exception:
+            return -1
+
+    return {
+        "dense_search": sz(_search_kernel),
+        "ivf_query": sz(knn_ivf._ivf_query_fused),
+        "ivf_pack": sz(knn_ivf._pack_pages_kernel),
+    }
+
+
+def topk_rows(
+    scores: np.ndarray, ids: np.ndarray, k: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row host top-k over (n, m) candidate arrays: (n, k) scores sorted
+    descending + their ids, padded with -inf / -1 when m < k; ids of non-finite
+    scores are -1. The ONE merge contract shared by the CPU IVF path and the
+    sharded top-k merge."""
+    n, m = scores.shape
+    kk = min(k, m)
+    if kk > 0:
+        part = np.argpartition(scores, -kk, axis=1)[:, -kk:]
+        psc = np.take_along_axis(scores, part, axis=1)
+        order = np.argsort(-psc, axis=1)
+        top = np.take_along_axis(part, order, axis=1)
+        out_s = np.take_along_axis(scores, top, axis=1).astype(np.float32)
+        out_i = np.take_along_axis(ids, top, axis=1).astype(np.int64)
+    else:
+        out_s = np.zeros((n, 0), dtype=np.float32)
+        out_i = np.zeros((n, 0), dtype=np.int64)
+    if kk < k:
+        out_s = np.pad(out_s, ((0, 0), (0, k - kk)), constant_values=-np.inf)
+        out_i = np.pad(out_i, ((0, 0), (0, k - kk)), constant_values=-1)
+    out_i[~np.isfinite(out_s)] = -1
+    return out_s, out_i
+
+
 def pad_pow2(slots: np.ndarray, vecs: "np.ndarray | None" = None, extras: "np.ndarray | None" = None):
     """Pad a scatter batch to a power-of-two bucket so the update kernel compiles
     once per (bucket, capacity) pair; padding repeats row 0 (duplicate scatter
@@ -138,15 +203,23 @@ class DenseKNNStore(SlotIngestMixin):
         metric: str = "l2sq",
         dtype: Any = jnp.float32,
         initial_capacity: int = 1024,
+        device: Any = None,
     ):
         assert metric in ("l2sq", "cos", "ip")
         self.dim = dim
         self.metric = metric
         self.dtype = dtype
         self.capacity = initial_capacity
-        self._data = jnp.zeros((self.capacity, dim), dtype=dtype)
-        self._valid = jnp.zeros((self.capacity,), dtype=bool)
-        self._norms = jnp.zeros((self.capacity,), dtype=jnp.float32)
+        self.device = device
+        # explicit placement pins the store to one chip of a mesh (the sharded
+        # wrappers place one sub-store per device); computations on committed
+        # arrays stay on that device, so only the three roots need the put
+        def _place(x):
+            return jax.device_put(x, device) if device is not None else x
+
+        self._data = _place(jnp.zeros((self.capacity, dim), dtype=dtype))
+        self._valid = _place(jnp.zeros((self.capacity,), dtype=bool))
+        self._norms = _place(jnp.zeros((self.capacity,), dtype=jnp.float32))
         self.slot_of: Dict[Any, int] = {}
         self.key_of: Dict[int, Any] = {}
         self._free: List[int] = list(range(self.capacity - 1, -1, -1))
@@ -222,6 +295,11 @@ class DenseKNNStore(SlotIngestMixin):
             queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
         k_eff = max(1, min(k, self.capacity))
         q_dev = queries if isinstance(queries, jax.Array) else jnp.asarray(queries)
+        # pow2 shape bucketing: serving traffic arrives at ragged batch sizes
+        # and per-request k; padding both to the next power of two bounds the
+        # kernel's jit cache at O(log) entries instead of one compile per size
+        q_dev, nq = pad_queries_pow2(q_dev, self.dim)
+        k_pad = min(next_pow2(k_eff), self.capacity)
         if self._data.dtype == jnp.bfloat16:
             # bf16-resident corpus (HBM capacity: 10M x 384 fits one v5e chip):
             # the MXU consumes bf16 natively with f32 accumulation — cast the
@@ -239,11 +317,11 @@ class DenseKNNStore(SlotIngestMixin):
             self._valid,
             self._norms,
             q_dev,
-            k_eff,
+            k_pad,
             self.metric,
         )
         # one batched host fetch (a tunneled device pays per-RPC latency, not size)
-        scores, idx = jax.device_get((top_scores, top_idx))
+        scores, idx = jax.device_get((top_scores[:nq, :k_eff], top_idx[:nq, :k_eff]))
         valid = np.isfinite(scores)
         return scores, idx, valid
 
@@ -463,18 +541,32 @@ class IvfKnnIndex(BruteForceKnnIndex):
         initial_capacity: int = 1024,
         n_clusters: int = 64,
         n_probe: int = 8,
+        mesh: Any = None,
     ):
-        from pathway_tpu.ops.knn_ivf import IvfKnnStore
+        if mesh is not None:
+            from pathway_tpu.parallel.knn_sharded import ShardedIvfKnnStore
 
-        super().__init__(
-            dim,
-            metric=metric,
-            initial_capacity=initial_capacity,
-            _store=IvfKnnStore(
+            store: Any = ShardedIvfKnnStore(
+                mesh,
                 dim,
                 metric=metric,
                 initial_capacity=initial_capacity,
                 n_clusters=n_clusters,
                 n_probe=n_probe,
-            ),
+            )
+        else:
+            from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+            store = IvfKnnStore(
+                dim,
+                metric=metric,
+                initial_capacity=initial_capacity,
+                n_clusters=n_clusters,
+                n_probe=n_probe,
+            )
+        super().__init__(
+            dim,
+            metric=metric,
+            initial_capacity=initial_capacity,
+            _store=store,
         )

@@ -21,7 +21,7 @@ from pathway_tpu.parallel.sharding import (
     replicated,
 )
 from pathway_tpu.parallel.exchange import shard_of_keys, exchange_by_key
-from pathway_tpu.parallel.knn_sharded import ShardedKNNStore
+from pathway_tpu.parallel.knn_sharded import ShardedIvfKnnStore, ShardedKNNStore
 
 __all__ = [
     "make_mesh",
@@ -31,5 +31,6 @@ __all__ = [
     "replicated",
     "shard_of_keys",
     "exchange_by_key",
+    "ShardedIvfKnnStore",
     "ShardedKNNStore",
 ]

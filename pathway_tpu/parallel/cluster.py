@@ -306,6 +306,31 @@ class ThreadExchangeHub:
             self.cv.notify_all()
 
 
+class PeerShutdownError(ConnectionError):
+    """A peer worker shut down while this worker waited on it — a SECONDARY
+    failure (the peer's own exception is the root cause)."""
+
+
+class PeerTimeoutError(TimeoutError):
+    """Timed out waiting on a peer worker — secondary, like
+    :class:`PeerShutdownError` (typed so failure triage classifies by
+    ``isinstance`` instead of matching message text)."""
+
+
+def _freeze_delta(payload: Any) -> Any:
+    """Mark a delta's arrays read-only before handing the LIVE object to peer
+    threads: the zero-serialization lane shares one address space, and the
+    engine-wide convention that deltas are never mutated in place is otherwise
+    unenforced — a violation must fail fast in the mutating worker, not corrupt
+    its peers nondeterministically."""
+    if payload is None:
+        return payload
+    for arr in (payload.keys, payload.diffs, *payload.columns.values()):
+        if isinstance(arr, np.ndarray):
+            arr.setflags(write=False)
+    return payload
+
+
 class ThreadExchange(ClusterExchange):
     """``ClusterExchange``'s collectives and delta routing over an in-memory
     transport: worker THREADS in one process instead of spawned processes.
@@ -321,7 +346,9 @@ class ThreadExchange(ClusterExchange):
         self._hub = hub
         self._conns = {p: None for p in range(hub.n) if p != me}  # peer ranks
 
-    def _send(self, peer: int, tag: bytes, payload: bytes) -> None:
+    def _send(self, peer: int, tag: bytes, payload: Any) -> None:
+        if payload is not None and hasattr(payload, "columns"):
+            _freeze_delta(payload)  # object handoff: enforce the no-mutation contract
         with self._hub.cv:
             self._hub.boxes[(peer, self.me, tag)] = payload
             self._hub.cv.notify_all()
@@ -332,12 +359,12 @@ class ThreadExchange(ClusterExchange):
         with self._hub.cv:
             while key not in self._hub.boxes:
                 if self._hub.closed:
-                    raise ConnectionError(
+                    raise PeerShutdownError(
                         f"worker thread {peer} shut down while waiting for {tag!r}"
                     )
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise TimeoutError(
+                    raise PeerTimeoutError(
                         f"worker thread {self.me} timed out waiting for {tag!r} "
                         f"from worker {peer}"
                     )

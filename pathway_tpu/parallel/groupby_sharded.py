@@ -16,7 +16,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from pathway_tpu.parallel.mesh import shard_map_compat
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pathway_tpu.parallel.exchange import bucket_rows
@@ -52,12 +54,11 @@ def _sharded_segment_sum_impl(
         )
         return lax.psum(local_sum, axis)
 
-    return shard_map(
+    return shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis)),
         out_specs=P(),
-        check_vma=False,
     )(key_lo, seg_ids, values)
 
 

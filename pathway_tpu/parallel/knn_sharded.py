@@ -22,7 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from pathway_tpu.parallel.mesh import shard_map_compat
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pathway_tpu.ops.knn import SlotIngestMixin, pad_pow2, pow2_target
@@ -164,20 +164,176 @@ class ShardedKNNStore(SlotIngestMixin):
             queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
         cap_local = self.capacity // self.n_shards
         k_eff = max(1, min(k, cap_local))
-        fn = shard_map(
+        fn = shard_map_compat(
             functools.partial(
                 _local_search, k=k_eff, metric=self.metric, axis=self.axis
             ),
             mesh=self.mesh,
             in_specs=(P(self.axis, None), P(self.axis), P(self.axis), P()),
             out_specs=(P(), P()),
-            check_vma=False,
         )
         top_scores, top_idx = jax.jit(fn)(
             self._data, self._valid, self._norms, jnp.asarray(queries)
         )
         scores = np.asarray(top_scores)
         idx = np.asarray(top_idx)
+        return scores, idx, np.isfinite(scores)
+
+
+def _axis_devices(mesh: Mesh, axis: str) -> List[Any]:
+    """One representative device per position along ``axis`` (index 0 of every
+    other mesh axis)."""
+    arr = np.asarray(mesh.devices)
+    ax = list(mesh.axis_names).index(axis)
+    arr = np.moveaxis(arr, ax, 0)
+    return list(arr.reshape(arr.shape[0], -1)[:, 0])
+
+
+class ShardedIvfKnnStore:
+    """Row-partitioned IVF-Flat over a mesh axis: one :class:`IvfKnnStore` per
+    shard, each pinned to its own device (centroids, inverted lists, and the
+    fused probe→gather→score kernel all run shard-local), with the per-shard
+    top-k candidates merged into the global top-k — the same all-gather top-k
+    merge contract as :class:`ShardedKNNStore`, performed host-side because the
+    per-shard IVF state (assignments, CSR) is host-managed.
+
+    Keys route round-robin to shards (the reference's key-hash balance), and
+    global slot ids interleave as ``local_slot * n_shards + shard`` so the
+    engine's ``key_of`` contract is preserved."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        dim: int,
+        metric: str = "l2sq",
+        axis: str = "data",
+        initial_capacity: int = 1024,
+        n_clusters: int = 64,
+        n_probe: int = 8,
+        dtype: Any = None,
+    ):
+        from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+        devices = _axis_devices(mesh, axis)
+        self.mesh = mesh
+        self.axis = axis
+        self.dim = dim
+        self.metric = metric
+        self.n_shards = len(devices)
+        per_shard_cap = max(16, -(-initial_capacity // self.n_shards))
+        kwargs: dict = {} if dtype is None else {"dtype": dtype}
+        self.stores: List[IvfKnnStore] = [
+            IvfKnnStore(
+                dim,
+                metric=metric,
+                initial_capacity=per_shard_cap,
+                n_clusters=n_clusters,
+                n_probe=n_probe,
+                device=dev,
+                **kwargs,
+            )
+            for dev in devices
+        ]
+        self.slot_of: Dict[Any, int] = {}
+        self.key_of: Dict[int, Any] = {}
+        self._shard_of: Dict[Any, int] = {}
+        self._rr = 0
+
+    def __len__(self) -> int:
+        return len(self.slot_of)
+
+    def _shard_for(self, key: Any) -> int:
+        shard = self._shard_of.get(key)
+        if shard is None:
+            shard = self._rr
+            self._rr = (self._rr + 1) % self.n_shards
+            self._shard_of[key] = shard
+        return shard
+
+    def _register(self, key: Any, shard: int) -> None:
+        old = self.slot_of.pop(key, None)
+        if old is not None:
+            self.key_of.pop(old, None)
+        gid = self.stores[shard].slot_of[key] * self.n_shards + shard
+        self.slot_of[key] = gid
+        self.key_of[gid] = key
+
+    def add(self, key: Any, vector: np.ndarray) -> None:
+        shard = self._shard_for(key)
+        self.stores[shard].add(key, vector)
+        self._register(key, shard)
+
+    def add_many(self, keys: List[Any], vectors: np.ndarray) -> None:
+        vectors = np.asarray(vectors, dtype=np.float32).reshape(len(keys), self.dim)
+        by_shard: Dict[int, List[int]] = {}
+        for i, key in enumerate(keys):
+            by_shard.setdefault(self._shard_for(key), []).append(i)
+        for shard, idxs in by_shard.items():
+            self.stores[shard].add_many([keys[i] for i in idxs], vectors[idxs])
+            for i in idxs:
+                self._register(keys[i], shard)
+
+    def remove(self, key: Any) -> None:
+        shard = self._shard_of.pop(key, None)
+        if shard is None:
+            return
+        self.stores[shard].remove(key)
+        gid = self.slot_of.pop(key, None)
+        if gid is not None:
+            self.key_of.pop(gid, None)
+
+    def _flush(self) -> None:
+        for store in self.stores:
+            store._flush()
+
+    def search_batch(
+        self, queries: Any, k: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        from pathway_tpu.ops.knn import topk_rows
+
+        queries = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
+        k_eff = max(1, k)
+        nq = queries.shape[0]
+        parts_s: List[np.ndarray] = []
+        parts_i: List[np.ndarray] = []
+
+        def globalize(s: np.ndarray, i: np.ndarray, shard: int) -> None:
+            gi = np.where(i >= 0, i * self.n_shards + shard, -1)
+            if s.shape[1] < k_eff:
+                pad = k_eff - s.shape[1]
+                s = np.pad(s, ((0, 0), (0, pad)), constant_values=-np.inf)
+                gi = np.pad(gi, ((0, 0), (0, pad)), constant_values=-1)
+            parts_s.append(s[:, :k_eff])
+            parts_i.append(gi[:, :k_eff])
+
+        if jax.default_backend() == "cpu":
+            # host BLAS path per shard — host-bound, nothing to overlap
+            for shard, store in enumerate(self.stores):
+                s, i, _v = store.search_batch(queries, k_eff)
+                globalize(s, i, shard)
+        else:
+            # launch EVERY shard's fused kernel before fetching any result:
+            # dispatch is async, so the per-shard searches overlap across their
+            # devices and batch latency is max-over-shards, not the sum
+            launched = [
+                store._search_device_launch(queries, k_eff)
+                if store._prepare_search()
+                else None
+                for store in self.stores
+            ]
+            for shard, handle in enumerate(launched):
+                if handle is None:
+                    globalize(
+                        np.full((nq, k_eff), -np.inf, dtype=np.float32),
+                        np.full((nq, k_eff), -1, dtype=np.int64),
+                        shard,
+                    )
+                else:
+                    s, i = jax.device_get(handle)
+                    globalize(s, i.astype(np.int64), shard)
+        scores, idx = topk_rows(
+            np.concatenate(parts_s, axis=1), np.concatenate(parts_i, axis=1), k_eff
+        )
         return scores, idx, np.isfinite(scores)
 
 

@@ -96,3 +96,19 @@ def cpu_virtual_devices(n: int) -> None:
         _xb._backend_factories.pop("axon", None)
     except Exception:
         pass
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions: 0.4.x exposes it under
+    ``jax.experimental`` with ``check_rep``; newer jax exports it top-level
+    with the kwarg renamed ``check_vma``. The replication check is disabled
+    either way (the callers' collectives produce replicated outputs by
+    construction)."""
+    try:
+        from jax import shard_map as _sm
+    except ImportError:  # pragma: no cover - version-dependent
+        from jax.experimental.shard_map import shard_map as _sm
+    try:
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover - version-dependent
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)

@@ -39,7 +39,12 @@ from typing import Any, Callable, Dict, List
 
 from pathway_tpu.internals import config as config_mod
 from pathway_tpu.internals.parse_graph import G
-from pathway_tpu.parallel.cluster import ThreadExchangeHub, set_thread_exchange
+from pathway_tpu.parallel.cluster import (
+    PeerShutdownError,
+    PeerTimeoutError,
+    ThreadExchangeHub,
+    set_thread_exchange,
+)
 
 
 def _launch(n: int, worker_body: Callable[[int], Any], hub: ThreadExchangeHub) -> List[Any]:
@@ -74,10 +79,25 @@ def _launch(n: int, worker_body: Callable[[int], Any], hub: ThreadExchangeHub) -
         # peers fail with secondary ConnectionErrors — raising one of those
         # (e.g. lowest rank) would bury the actual failing operator
         def is_secondary(e: tuple) -> bool:
-            # the exchange's own error texts (possibly wrapped in engine trace
-            # exceptions) mark a worker that died WAITING on a dead peer
-            text = repr(e[1])
-            return "shut down while waiting" in text or "timed out waiting" in text
+            # a typed peer-wait error anywhere on the exception CHAIN (engine
+            # trace wrappers preserve __cause__/__context__) marks a worker that
+            # died WAITING on a dead peer — never match message text: a user
+            # UDF's TimeoutError phrasing must not bury the real failure
+            exc: "BaseException | None" = e[1]
+            seen: set[int] = set()
+            while exc is not None and id(exc) not in seen:
+                if isinstance(exc, (PeerShutdownError, PeerTimeoutError)):
+                    return True
+                seen.add(id(exc))
+                if exc.__cause__ is not None:
+                    exc = exc.__cause__
+                elif not exc.__suppress_context__:
+                    # honor `raise ... from None`: a worker that HANDLED a peer
+                    # error and deliberately raised its own is primary
+                    exc = exc.__context__
+                else:
+                    exc = None
+            return False
 
         primary = [e for e in errors if not is_secondary(e)] or errors
         rank, exc = min(primary, key=lambda e: e[0])

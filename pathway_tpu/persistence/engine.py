@@ -19,6 +19,7 @@ cursors, and the segment-state deltas sources pushed that commit (the analogue o
 from __future__ import annotations
 
 import io
+import json
 import os
 import pickle
 import struct
@@ -27,11 +28,29 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from pathway_tpu.engine.columnar import Delta
+from pathway_tpu.internals.keys import KEY_DERIVATION_VERSION
 
 _FRAME_HEADER = struct.Struct(">Q")
 _JOURNAL = "journal.bin"
 _CHECKPOINT = "checkpoint.pkl"
-_HEADER_MAGIC = b"PWTPUJ1\n"
+_STORE_META = "store.meta"
+# v2: header line is a json meta object carrying the graph signature PLUS the
+# key-derivation version and worker count — frames store derived row keys, so a
+# journal from a build with different key derivation (or replayed under a
+# different shard layout) must be refused, not silently resumed
+_HEADER_MAGIC = b"PWTPUJ2\n"
+# known-incompatible prior formats: loading one must fail LOUDLY (v1 journals
+# predate the splitmix int-key derivation — their stored keys no longer match
+# keys this build derives for the same values)
+_OLD_MAGICS = (b"PWTPUJ1\n",)
+
+_OLD_FORMAT_ERROR = (
+    "persisted journal was written by an incompatible earlier build (format v1, "
+    "before the splitmix key-derivation change): its stored row keys no longer "
+    "match keys this build derives for the same values, so replayed rows would "
+    "become unreachable for updates/retractions — clear the persistence "
+    "directory to start fresh"
+)
 
 
 def _delta_to_payload(delta: Delta) -> tuple:
@@ -80,6 +99,11 @@ class PersistenceManager:
         from pathway_tpu.internals.config import get_pathway_config
 
         cfg = get_pathway_config()
+        self._workers = max(1, int(getattr(cfg, "processes", 1) or 1))
+        # the UNSHARDED root: the store-wide meta object lives here so a reopen
+        # with a different worker count is detected even though each worker only
+        # reads its own process-{id}/ shard
+        self._base_root = self.root
         if cfg.processes > 1 and (self._object_store is not None or not self._memory):
             # spawned replicas each own a journal shard; a shared file would
             # interleave frames from different processes into garbage
@@ -111,8 +135,88 @@ class PersistenceManager:
     def _checkpoint_key(self) -> str:
         return f"{self._object_prefix}{_CHECKPOINT}"
 
+    # -- versioned header / store-wide meta ----------------------------------
+
+    def _header_bytes(self, graph_sig: str) -> bytes:
+        meta = {
+            "sig": graph_sig,
+            "key_derivation": KEY_DERIVATION_VERSION,
+            "workers": self._workers,
+        }
+        return _HEADER_MAGIC + json.dumps(meta, sort_keys=True).encode() + b"\n"
+
+    def _check_meta(self, meta: dict, what: str) -> None:
+        """Refuse to resume state this build cannot replay correctly."""
+        kv = meta.get("key_derivation")
+        if kv != KEY_DERIVATION_VERSION:
+            raise ValueError(
+                f"persisted {what} was written with key-derivation v{kv} but this "
+                f"build derives v{KEY_DERIVATION_VERSION} keys; replayed rows would "
+                "become unreachable for updates/retractions — clear the persistence "
+                "directory to start fresh"
+            )
+        workers = meta.get("workers")
+        if workers != self._workers:
+            raise ValueError(
+                f"persisted {what} was written by a run with {workers} worker "
+                f"process(es) but this run uses {self._workers}: the journal is "
+                "sharded per worker, so resuming under a different count would "
+                "silently start from a different shard layout — rerun with the "
+                "original worker count or clear the persistence directory"
+            )
+
+    def _check_store_meta(self) -> None:
+        """Store-WIDE guard at the unsharded root: a run with a different worker
+        count reads different ``process-{id}/`` shards (possibly none), so the
+        per-shard headers alone cannot catch the mismatch."""
+        if self._object_store is not None:
+            blob = self._object_store.get(_STORE_META)
+            if blob is None:
+                self._object_store.put(
+                    _STORE_META,
+                    json.dumps(
+                        {"workers": self._workers, "key_derivation": KEY_DERIVATION_VERSION},
+                        sort_keys=True,
+                    ).encode(),
+                )
+                return
+            self._check_meta(json.loads(blob), "store")
+            return
+        if self._memory or self._base_root is None:
+            return  # in-memory stores cannot be reopened by another run
+        path = os.path.join(str(self._base_root), _STORE_META)
+        if not os.path.exists(path):
+            os.makedirs(str(self._base_root), exist_ok=True)
+            # pid-unique temp: spawned replicas race to create the meta file
+            # concurrently; both write identical content, either rename may win
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"workers": self._workers, "key_derivation": KEY_DERIVATION_VERSION},
+                    f,
+                    sort_keys=True,
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return
+        with open(path) as f:
+            self._check_meta(json.load(f), "store")
+
+    def _validate_header_line(
+        self, line: bytes, graph_sig: str, prefix_hint: str = "directory"
+    ) -> None:
+        meta = json.loads(line)
+        if meta.get("sig") != graph_sig:
+            raise ValueError(
+                "persisted journal was written by a different dataflow graph; "
+                f"clear the persistence {prefix_hint} or keep the program unchanged"
+            )
+        self._check_meta(meta, "journal")
+
     def open_for_append(self, graph_sig: str) -> None:
-        header = _HEADER_MAGIC + graph_sig.encode() + b"\n"
+        self._check_store_meta()
+        header = self._header_bytes(graph_sig)
         if self._object_store is not None:
             if self._object_store.get(self._meta_key()) is None:
                 self._object_store.put(self._meta_key(), header)
@@ -213,7 +317,13 @@ class PersistenceManager:
         compact the journal: frames ≤ ``commit_id`` are subsumed by the checkpoint.
         Crash between the two steps is safe — load skips subsumed frames by id."""
         payload = pickle.dumps(
-            {"sig": graph_sig, "commit_id": commit_id, "state": blob},
+            {
+                "sig": graph_sig,
+                "commit_id": commit_id,
+                "state": blob,
+                "key_derivation": KEY_DERIVATION_VERSION,
+                "workers": self._workers,
+            },
             protocol=pickle.HIGHEST_PROTOCOL,
         )
         if self._object_store is not None:
@@ -230,7 +340,7 @@ class PersistenceManager:
         if self._memory:
             self._mem_checkpoint = payload
             self._mem_journal = io.BytesIO()
-            self._mem_journal.write(_HEADER_MAGIC + graph_sig.encode() + b"\n")
+            self._mem_journal.write(self._header_bytes(graph_sig))
             return
         tmp = os.path.join(self.root, _CHECKPOINT + ".tmp")
         with open(tmp, "wb") as f:
@@ -239,7 +349,7 @@ class PersistenceManager:
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.root, _CHECKPOINT))
         # compact: restart the journal after the checkpointed commit
-        header = _HEADER_MAGIC + graph_sig.encode() + b"\n"
+        header = self._header_bytes(graph_sig)
         self._journal_file.truncate(len(header))
         self._journal_file.seek(0, os.SEEK_END)
         self._journal_file.flush()
@@ -278,6 +388,7 @@ class PersistenceManager:
                 "persisted checkpoint was written by a different dataflow graph; "
                 "clear the persistence directory or keep the program unchanged"
             )
+        self._check_meta(data, "checkpoint")
         return data["commit_id"], data["state"]
 
     # -- journal read path ---------------------------------------------------
@@ -289,14 +400,14 @@ class PersistenceManager:
         if self._object_store is not None:
             meta = self._object_store.get(self._meta_key())
             if meta is not None:
+                if any(meta.startswith(old) for old in _OLD_MAGICS):
+                    raise ValueError(_OLD_FORMAT_ERROR)
                 if not meta.startswith(_HEADER_MAGIC):
                     return []
-                stored_sig = meta[len(_HEADER_MAGIC) :].rstrip(b"\n").decode()
-                if stored_sig != graph_sig:
-                    raise ValueError(
-                        "persisted journal was written by a different dataflow graph; "
-                        "clear the persistence prefix or keep the program unchanged"
-                    )
+                self._validate_header_line(
+                    meta[len(_HEADER_MAGIC) :].rstrip(b"\n"), graph_sig,
+                    prefix_hint="prefix",
+                )
             frames_o: List[Tuple[int, Dict[int, Delta], Dict[int, dict]]] = []
             # sorted() belt-and-braces: frame keys are zero-padded so lexicographic
             # order IS replay order, but a custom store may list unsorted
@@ -333,6 +444,8 @@ class PersistenceManager:
                 return []
             with open(self._journal_path(), "rb") as f:
                 data = f.read()
+        if any(data.startswith(old) for old in _OLD_MAGICS):
+            raise ValueError(_OLD_FORMAT_ERROR)
         if not data.startswith(_HEADER_MAGIC):
             self._valid_end = 0  # corrupt/foreign header: truncate and start fresh
             return []
@@ -341,12 +454,7 @@ class PersistenceManager:
         except ValueError:
             self._valid_end = 0
             return []
-        stored_sig = data[len(_HEADER_MAGIC) : nl].decode()
-        if stored_sig != graph_sig:
-            raise ValueError(
-                "persisted journal was written by a different dataflow graph; "
-                "clear the persistence directory or keep the program unchanged"
-            )
+        self._validate_header_line(data[len(_HEADER_MAGIC) : nl], graph_sig)
         pos = nl + 1
         frames: List[Tuple[int, Dict[int, Delta], Dict[int, dict]]] = []
         while pos + _FRAME_HEADER.size <= len(data):

@@ -368,6 +368,26 @@ def default_lsh_knn_document_index(
     )
 
 
+def _make_ivf_index(
+    dimensions: int, metric_s: str, reserved_space: int, n_clusters: int, n_probe: int
+) -> Any:
+    """Engine-facing IVF index instance; a configured multi-shard mesh swaps in
+    the row-sharded IVF store (per-shard fused probe→gather→score kernel +
+    top-k merge — the same merge contract as the dense sharded store)."""
+    from pathway_tpu.ops.knn import IvfKnnIndex
+    from pathway_tpu.parallel.mesh import data_shards, get_default_mesh
+
+    mesh = get_default_mesh()
+    return IvfKnnIndex(
+        dimensions,
+        metric=metric_s,
+        initial_capacity=max(16, reserved_space),
+        n_clusters=n_clusters,
+        n_probe=n_probe,
+        mesh=mesh if data_shards(mesh) > 1 else None,
+    )
+
+
 class IvfKnn(_KnnInnerIndex):
     """Approximate KNN via IVF-Flat on the TPU — the reference's ANN slot
     (``USearchKnn`` over HNSW, ``usearch_integration.rs:20``) filled with a
@@ -389,8 +409,6 @@ class IvfKnn(_KnnInnerIndex):
         metric: BruteForceKnnMetricKind = BruteForceKnnMetricKind.L2SQ,
         embedder: Any = None,
     ):
-        from pathway_tpu.ops.knn import IvfKnnIndex
-
         metric_s = _metric_str(metric)
         super().__init__(
             data_column,
@@ -398,12 +416,8 @@ class IvfKnn(_KnnInnerIndex):
             dimensions,
             metric_s,
             embedder,
-            make_index=lambda: IvfKnnIndex(
-                dimensions,
-                metric=metric_s,
-                initial_capacity=max(16, reserved_space),
-                n_clusters=n_clusters,
-                n_probe=n_probe,
+            make_index=lambda: _make_ivf_index(
+                dimensions, metric_s, reserved_space, n_clusters, n_probe
             ),
         )
 

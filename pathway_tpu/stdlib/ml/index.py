@@ -37,16 +37,43 @@ class KNNIndex:
         distance_type: str = "euclidean",
         metadata: expr.ColumnReference | None = None,
         exact: bool = True,
+        approximate: str = "lsh",
+        n_clusters: int = 64,
+        n_probe: int = 8,
     ):
         self.data = data
-        if exact:
-            metric = (
-                BruteForceKnnMetricKind.COS
-                if distance_type == "cosine"
-                else BruteForceKnnMetricKind.L2SQ
+        if approximate not in ("lsh", "ivf"):
+            raise ValueError(
+                f"approximate={approximate!r} is not a KNNIndex mode; use 'lsh' or 'ivf'"
             )
-            inner = BruteForceKnn(
+        if exact and approximate != "lsh":
+            # exact=True (the default) would silently shadow an explicit ANN
+            # request with brute force — make the contradiction loud
+            raise ValueError(
+                f"approximate={approximate!r} requires exact=False "
+                "(exact=True always builds the brute-force index)"
+            )
+        metric = (
+            BruteForceKnnMetricKind.COS
+            if distance_type == "cosine"
+            else BruteForceKnnMetricKind.L2SQ
+        )
+        if exact:
+            inner: Any = BruteForceKnn(
                 data_embedding, metadata, dimensions=n_dimensions, metric=metric
+            )
+        elif approximate == "ivf":
+            # sublinear candidate selection through the fused IVF kernel
+            # (ops/knn_ivf.py) instead of LSH bucket intersection
+            from pathway_tpu.stdlib.indexing.nearest_neighbors import IvfKnn
+
+            inner = IvfKnn(
+                data_embedding,
+                metadata,
+                dimensions=n_dimensions,
+                metric=metric,
+                n_clusters=n_clusters,
+                n_probe=n_probe,
             )
         else:
             inner = LshKnn(

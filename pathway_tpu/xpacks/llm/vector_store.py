@@ -37,6 +37,16 @@ class VectorStoreServer:
             index_factory = BruteForceKnnFactory(
                 embedder=embedder, metric=BruteForceKnnMetricKind.COS
             )
+        elif index_factory == "ivf":
+            # sublinear serving at large corpora: the IVF-Flat index's fused
+            # probe→gather→score kernel (ops/knn_ivf.py) end-to-end — embed →
+            # probe centroids → stream candidate pages → top-k, one device
+            # round-trip per query batch
+            from pathway_tpu.stdlib.indexing.nearest_neighbors import IvfKnnFactory
+
+            index_factory = IvfKnnFactory(
+                embedder=embedder, metric=BruteForceKnnMetricKind.COS
+            )
         self.docs = list(docs)
         self.store = DocumentStore(
             self.docs,

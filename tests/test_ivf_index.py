@@ -156,7 +156,10 @@ def test_ivf_manifold_recall_and_balanced_buckets():
     cap = 8
     while cap < (3 * mean_occ + 1) // 2:
         cap *= 2
-    assert int(ivf._buckets.shape[1]) <= 2 * cap, ivf._buckets.shape
+    # rebalanced CSR: the largest inverted list must stay within the spill cap,
+    # not track the most bloated k-means cluster
+    occ = int(np.max(np.diff(ivf._csr_offsets)))
+    assert occ <= 2 * cap, occ
 
 
 def test_bf16_storage_matches_f32_results():
@@ -194,3 +197,179 @@ def test_bf16_storage_matches_f32_results():
     # full probe (8/8): bf16 IVF is exact up to the same quantization
     overlap = np.mean([len(set(i1[r]) & set(i3[r])) / 10 for r in range(32)])
     assert overlap >= 0.97, overlap
+
+
+# -- fused kernel paths (PR 1: CSR + paged layout, Pallas/XLA contract) --------
+
+
+def _int_store(n=1500, dim=32, n_clusters=8, n_probe=3, seed=5):
+    """Integer-valued vectors: every dot product is exact in f32 regardless of
+    accumulation order, so the Pallas kernel and the XLA composite must agree
+    BITWISE — parity assertions need no tolerance."""
+    from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+    rng = np.random.default_rng(seed)
+    docs = rng.integers(-8, 9, size=(n, dim)).astype(np.float32)
+    queries = rng.integers(-8, 9, size=(24, dim)).astype(np.float32)
+    ivf = IvfKnnStore(
+        dim, metric="l2sq", initial_capacity=2 * n,
+        n_clusters=n_clusters, n_probe=n_probe,
+    )
+    ivf.add_many(list(range(n)), docs)
+    ivf.search_batch(queries[:1], 1)  # train + build index
+    return ivf, queries
+
+
+def test_ivf_device_xla_matches_numpy_path():
+    """The XLA composite (the device production path) and the CPU BLAS path
+    walk the same CSR and must return the same neighbors and scores
+    (continuous float corpus: distinct distances, so the comparison is strict
+    up to float accumulation order)."""
+    from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+    _c, docs = _clustered(1500, 32, 8, seed=5)
+    ivf = IvfKnnStore(32, metric="l2sq", initial_capacity=4096, n_clusters=8, n_probe=3)
+    ivf.add_many(list(range(len(docs))), docs)
+    rng = np.random.default_rng(6)
+    queries = docs[rng.integers(0, len(docs), 24)] + 0.1 * rng.normal(
+        size=(24, 32)
+    ).astype(np.float32)
+    queries = queries.astype(np.float32)
+    ivf.search_batch(queries[:1], 1)  # train + build index
+    ns, ni = ivf._search_numpy(queries, 10)
+    ds, di = ivf._search_device(queries, 10, impl="xla")
+    np.testing.assert_allclose(ds, ns, rtol=1e-4, atol=1e-3)
+    overlap = np.mean(
+        [
+            len({int(x) for x in di[r] if x >= 0} & {int(x) for x in ni[r] if x >= 0}) / 10
+            for r in range(len(queries))
+        ]
+    )
+    assert overlap >= 0.99, overlap  # only float-noise boundary ties may differ
+
+
+def test_pallas_kernel_parity_with_xla_composite():
+    """Acceptance: the pallas_call kernel must prove parity with the XLA
+    composite fallback on any backend (interpret mode here). Integer vectors
+    make parity exact — identical slots AND identical scores."""
+    for metric in ("l2sq", "cos", "ip"):
+        from pathway_tpu.ops.knn_ivf import IvfKnnStore
+
+        ivf, queries = _int_store(seed=7)
+        ivf.metric = metric
+        xs, xi = ivf._search_device(queries, 10, impl="xla")
+        ps, pi = ivf._search_device(queries, 10, impl="pallas_interpret")
+        np.testing.assert_allclose(ps, xs, rtol=1e-6, atol=1e-6)
+        assert (pi == xi).all(), metric
+
+
+def test_jit_cache_bounded_over_batch_sizes():
+    """Acceptance: ragged query batch sizes across a run must trigger a bounded
+    (<= pow2-bucket-count) number of kernel compilations."""
+    from pathway_tpu.ops.knn import next_pow2
+    from pathway_tpu.ops.knn_ivf import _ivf_query_fused
+
+    ivf, queries = _int_store()
+    rng = np.random.default_rng(0)
+    base = int(_ivf_query_fused._cache_size())
+    sizes = list(range(1, 25)) + [1, 13, 24, 5]
+    for nq in sizes:
+        q = rng.integers(-8, 9, size=(nq, 32)).astype(np.float32)
+        ivf._search_device(q, 5, impl="xla")
+    buckets = {next_pow2(max(8, nq)) for nq in sizes}
+    grown = int(_ivf_query_fused._cache_size()) - base
+    assert grown <= len(buckets), (grown, buckets)
+    assert len(ivf.search_shape_buckets) <= len(buckets) + 1  # +1: the build call
+
+
+def test_ivf_shape_buckets_tracked_on_cpu_path():
+    """search_batch records pow2 (q, k) buckets on every path — the bench's
+    recompile-observability counter."""
+    ivf, queries = _int_store()
+    ivf.search_shape_buckets.clear()
+    for nq in (1, 2, 3, 5, 7, 8):
+        ivf.search_batch(queries[:nq], 3)
+    assert ivf.search_shape_buckets == {(8, 4)}
+
+
+def test_ivf_csr_pages_consistent():
+    """Every live slot appears exactly once in the CSR, page geometry is pow2
+    padded with an all-pad sentinel page, and page contents mirror the CSR."""
+    from pathway_tpu.ops.knn_ivf import PAGE
+
+    ivf, _q = _int_store()
+    ivf._ensure_index()
+    live = sorted(ivf.slot_of.values())
+    assert sorted(ivf._csr_rows.tolist()) == live
+    offsets = ivf._csr_offsets
+    n_pages_total = len(ivf._page_rows) // PAGE
+    assert n_pages_total & (n_pages_total - 1) == 0  # pow2
+    assert (ivf._page_rows[-PAGE:] == -1).all()  # sentinel page all-pad
+    packed_live = ivf._page_rows[ivf._page_rows >= 0]
+    assert sorted(packed_live.tolist()) == live
+    for c in range(ivf.n_clusters):
+        members = set(ivf._csr_rows[offsets[c] : offsets[c + 1]].tolist())
+        start = int(ivf._first_page[c]) * PAGE
+        span = int(ivf._n_pages[c]) * PAGE
+        paged = ivf._page_rows[start : start + span]
+        assert {int(x) for x in paged if x >= 0} == members
+
+
+def test_sharded_ivf_matches_single_store():
+    """Mesh-sharded IVF: per-shard fused search + top-k merge must return the
+    same neighbors as one unsharded store at full probe."""
+    from pathway_tpu.ops.knn_ivf import IvfKnnStore
+    from pathway_tpu.parallel import ShardedIvfKnnStore, make_mesh
+
+    mesh = make_mesh(8)  # data axis = 2 shards on the virtual CPU mesh
+    rng = np.random.default_rng(9)
+    dim, n, k = 16, 600, 5
+    docs = rng.integers(-8, 9, size=(n, dim)).astype(np.float32)
+    queries = rng.integers(-8, 9, size=(12, dim)).astype(np.float32)
+    single = IvfKnnStore(dim, initial_capacity=1024, n_clusters=4, n_probe=4)
+    sharded = ShardedIvfKnnStore(
+        mesh, dim, initial_capacity=1024, n_clusters=4, n_probe=4
+    )
+    keys = [f"d{i}" for i in range(n)]
+    single.add_many(keys, docs)
+    sharded.add_many(keys, docs)
+    ss, si, sv = single.search_batch(queries, k)
+    hs, hi, hv = sharded.search_batch(queries, k)
+    assert hv.all()
+    np.testing.assert_allclose(np.sort(hs, axis=1), np.sort(ss, axis=1), atol=1e-4)
+    for r in range(len(queries)):
+        a = {single.key_of[int(x)] for x in si[r] if x >= 0}
+        b = {sharded.key_of[int(x)] for x in hi[r] if x >= 0}
+        assert a == b
+    # removals route to the owning shard
+    sharded.remove("d0")
+    assert len(sharded) == n - 1
+    _s, i2, _v = sharded.search_batch(docs[:1], 1)
+    assert sharded.key_of.get(int(i2[0, 0])) != "d0"
+
+
+def test_vector_store_server_accepts_ivf_factory():
+    """index_factory='ivf' threads the IVF retriever end-to-end into the
+    DocumentStore (constructor-level wiring; the engine query path is covered
+    by test_ivf_through_data_index)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import IvfKnnFactory
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    from .mocks import fake_embedding
+
+    @pw.udf
+    def embed(text: str) -> np.ndarray:
+        return fake_embedding(text, 8)
+
+    pg.G.clear()
+    docs = T(
+        """
+        data | _metadata
+        alpha | {}
+        """
+    )
+    server = VectorStoreServer(docs, embedder=embed, index_factory="ivf")
+    assert isinstance(server.store.retriever_factory, IvfKnnFactory)
+    pg.G.clear()

@@ -543,3 +543,69 @@ def test_nondet_udf_memo_survives_checkpoint(tmp_path):
     GraphRunner(G._current).run(persistence_config=cfg)
     retractions = [v for v, d in ev2 if d < 0]
     assert retractions == [a_value]
+
+
+# -- format versioning (PR 1 satellites) ---------------------------------------
+
+
+def test_v1_journal_magic_refused(tmp_path):
+    """A journal from the pre-splitmix build must fail LOUDLY: its stored row
+    keys no longer match keys this build derives for the same values."""
+    import os
+
+    import pytest
+
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    store = tmp_path / "ps_v1"
+    cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(store))
+    mgr = PersistenceManager(cfg)
+    os.makedirs(mgr.root, exist_ok=True)
+    with open(os.path.join(str(mgr.root), "journal.bin"), "wb") as f:
+        f.write(b"PWTPUJ1\nsome-graph-sig\n")
+    with pytest.raises(ValueError, match="incompatible earlier build"):
+        mgr.load_journal("some-graph-sig")
+
+
+def test_worker_count_mismatch_refused(tmp_path):
+    """A store written under -n 2 reopened single-process must raise instead of
+    silently resuming from an empty root shard (the shard layout differs)."""
+    from dataclasses import replace
+
+    import pytest
+
+    from pathway_tpu.internals import config as config_mod
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    store = tmp_path / "ps_workers"
+    cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(store))
+    base = config_mod.PathwayConfig.from_env()
+    config_mod.set_thread_config(replace(base, processes=2, process_id=0))
+    try:
+        writer = PersistenceManager(cfg)
+        writer.load_journal("sig")
+        writer.open_for_append("sig")
+        writer.close()
+    finally:
+        config_mod.set_thread_config(None)
+    reader = PersistenceManager(cfg)  # single-process reopen
+    with pytest.raises(ValueError, match="worker process"):
+        reader.open_for_append("sig")
+
+
+def test_same_worker_count_reopens_cleanly(tmp_path):
+    """The guard must not fire on a faithful reopen."""
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    store = tmp_path / "ps_ok"
+    cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(store))
+    writer = PersistenceManager(cfg)
+    writer.load_journal("sig")
+    writer.open_for_append("sig")
+    writer.record_commit(0, {}, {})
+    writer.close()
+    reader = PersistenceManager(cfg)
+    frames = reader.load_journal("sig")
+    reader.open_for_append("sig")
+    reader.close()
+    assert len(frames) == 1

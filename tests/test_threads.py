@@ -286,3 +286,59 @@ def test_groupby_reducer_cross_ref_refused_under_cluster():
             GraphRunner(G._current).run()
     finally:
         config_mod.set_thread_config(None)
+
+
+# -- typed peer-failure triage + exchange immutability (PR 1 satellites) -------
+
+
+def test_primary_error_with_timeout_phrasing_not_misclassified():
+    """A genuine worker failure whose MESSAGE contains 'timed out waiting' must
+    still be picked as the root cause (triage is by exception type now, not by
+    repr substring): the peer that dies waiting raises a typed
+    PeerShutdownError and is the one classified secondary."""
+    import pytest
+
+    from pathway_tpu.engine.columnar import Delta
+    from pathway_tpu.parallel.cluster import get_cluster
+    from pathway_tpu.parallel.threads import run_threads
+
+    def program():
+        from pathway_tpu.internals.config import get_pathway_config
+
+        rank = get_pathway_config().process_id
+        if rank == 0:
+            raise RuntimeError("backend timed out waiting for quota")
+        get_cluster().exchange_to_root(b"t0", Delta.empty(["x"]))
+
+    with pytest.raises(RuntimeError, match="worker thread 0 failed") as ei:
+        run_threads(program, 2)
+    assert "timed out waiting for quota" in str(ei.value)
+
+
+def test_exchanged_delta_arrays_are_read_only():
+    """The zero-serialization thread exchange hands LIVE arrays to peers; they
+    must be frozen on handoff so an in-place mutation fails fast in the
+    violating worker instead of corrupting its peers."""
+    import numpy as np
+    import pytest
+
+    from pathway_tpu.engine.columnar import Delta
+    from pathway_tpu.internals.keys import KEY_DTYPE
+    from pathway_tpu.parallel.cluster import get_cluster
+    from pathway_tpu.parallel.threads import run_threads
+
+    def program():
+        keys = np.zeros(2, dtype=KEY_DTYPE)
+        diffs = np.ones(2, dtype=np.int64)
+        cols = {"x": np.arange(2, dtype=np.float64)}
+        d = Delta(keys, diffs, cols)
+        merged = get_cluster().broadcast_merge(b"bm", d)
+        return d, merged
+
+    outs = run_threads(program, 2)
+    for own, merged in outs:
+        assert not own.keys.flags.writeable
+        assert not own.columns["x"].flags.writeable
+        with pytest.raises(ValueError):
+            own.columns["x"][0] = 99.0
+        assert len(merged) == 4
