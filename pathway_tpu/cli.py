@@ -18,9 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
-import subprocess
 import sys
-import uuid
 from typing import NoReturn
 
 import click
@@ -30,33 +28,29 @@ def _plural(n: int, singular: str, plural: str) -> str:
     return f"1 {singular}" if n == 1 else f"{n} {plural}"
 
 
-def _spawn_program(*, threads, processes, first_port, program, arguments, env_base):
+def _spawn_program(
+    *, threads, processes, first_port, program, arguments, env_base, max_restarts=0
+):
+    """Launch the cluster under the supervisor (``parallel/supervisor.py``):
+    child exit codes and per-rank heartbeat status are monitored; a worker
+    crash either restarts the cluster from the persistence journal
+    (``--max-restarts`` budget, persistence on) or tears everything down with
+    a per-rank post-mortem — never a hang."""
+    from pathway_tpu.parallel.supervisor import Supervisor
+
     processes_str = _plural(processes, "process", "processes")
     workers_str = _plural(processes * threads, "total worker", "total workers")
     click.echo(f"Preparing {processes_str} ({workers_str})", err=True)
-    run_id = uuid.uuid4()
-    handles = []
-    try:
-        for process_id in range(processes):
-            env = env_base.copy()
-            env["PATHWAY_THREADS"] = str(threads)
-            env["PATHWAY_PROCESSES"] = str(processes)
-            env["PATHWAY_FIRST_PORT"] = str(first_port)
-            env["PATHWAY_PROCESS_ID"] = str(process_id)
-            env["PATHWAY_RUN_ID"] = str(run_id)
-            handles.append(subprocess.Popen([program, *arguments], env=env))
-        for handle in handles:
-            handle.wait()
-    finally:
-        for handle in handles:
-            handle.terminate()
-    codes = [handle.returncode for handle in handles]
-    failures = [c for c in codes if c != 0]
-    if not failures:
-        sys.exit(0)
-    # signal-killed children have negative codes; surface any failure as nonzero
-    first = failures[0]
-    sys.exit(first if 0 < first < 256 else 1)
+    supervisor = Supervisor(
+        processes=processes,
+        threads=threads,
+        first_port=first_port,
+        program=program,
+        arguments=arguments,
+        env_base=env_base,
+        max_restarts=max_restarts,
+    )
+    sys.exit(supervisor.run())
 
 
 @click.group
@@ -73,9 +67,18 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
 @click.option("--first-port", type=int, metavar="PORT", default=10000, help="first port to use for communication")
 @click.option("--record", is_flag=True, help="record data in the input connectors")
 @click.option("--record-path", type=str, default="record", help="directory in which record will be saved")
+@click.option(
+    "--max-restarts",
+    type=int,
+    metavar="N",
+    default=0,
+    help="restart the whole cluster up to N times after a worker crash, resuming "
+    "from the persistence journal (requires the program to run with a persistence "
+    "backend; 0 = fail fast with a post-mortem)",
+)
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
-def spawn(threads, processes, first_port, record, record_path, program, arguments):
+def spawn(threads, processes, first_port, record, record_path, max_restarts, program, arguments):
     env = os.environ.copy()
     if record:
         env["PATHWAY_REPLAY_STORAGE"] = record_path
@@ -88,6 +91,7 @@ def spawn(threads, processes, first_port, record, record_path, program, argument
         program=program,
         arguments=arguments,
         env_base=env,
+        max_restarts=max_restarts,
     )
 
 

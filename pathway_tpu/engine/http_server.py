@@ -86,12 +86,37 @@ class ProberStats:
 
 
 class MonitoringServer:
+    """Serves ``/status``+``/metrics`` (OpenMetrics) and ``/healthz`` (JSON
+    liveness: per-peer heartbeat age, commit progress — the same payload the
+    commit loop publishes to the supervisor's status file, so the supervisor
+    and external probes share one signal)."""
+
     def __init__(self, stats: ProberStats, port: int):
         self.stats = stats
+        # callable returning the liveness dict; installed by the GraphRunner
+        # once the cluster exchange exists (None -> minimal alive response)
+        self.health_source: Optional[Any] = None
         stats_ref = stats
+        server_ref = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if self.path == "/healthz":
+                    import json as _json
+
+                    source = server_ref.health_source
+                    try:
+                        payload = source() if source is not None else {}
+                    except Exception as exc:  # a probe must never 500 a worker
+                        payload = {"error": str(exc)}
+                    payload.setdefault("alive", True)
+                    body = _json.dumps(payload, sort_keys=True).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path not in ("/status", "/metrics"):
                     self.send_response(404)
                     self.end_headers()
@@ -114,8 +139,13 @@ class MonitoringServer:
         self.thread.start()
 
     def close(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        """Idempotent: stop serving AND close the listener socket — a leaked
+        listener holds the port across back-to-back runs in one process."""
+        httpd, self.httpd = self.httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
 
 
 def maybe_start_http_server(stats: ProberStats, enabled: bool) -> Optional[MonitoringServer]:

@@ -46,6 +46,10 @@ class GraphRunner:
         self._materialize_all = False  # nested iterate runners read states directly
         self._cluster: Any = None  # multi-process exchange (parallel/cluster.py)
         self._metrics: Any = None  # OTel MetricsRecorder (engine/telemetry.py)
+        self._chaos: Any = None  # fault injection (internals/chaos.py), None when off
+        self._rank = 0
+        self._supervise_dir: Any = None  # PATHWAY_SUPERVISE_DIR (spawn supervisor)
+        self._last_status_write = 0.0
 
     def state_of(self, node: pg.Node) -> StateTable:
         if node.id not in self._materialized:
@@ -139,9 +143,18 @@ class GraphRunner:
         from pathway_tpu.engine import index as _index  # noqa: F401
         from pathway_tpu.ops import segment as _segment  # noqa: F401
         from pathway_tpu.engine.evaluators import EVALUATORS
+        from pathway_tpu.internals.chaos import get_chaos
+        from pathway_tpu.internals.config import get_pathway_config as _get_cfg
         from pathway_tpu.parallel.cluster import get_cluster
 
         self._cluster = None if self._materialize_all else get_cluster()
+        self._chaos = None if self._materialize_all else get_chaos()
+        self._rank = _get_cfg().process_id
+        import os as _os
+
+        self._supervise_dir = None if self._materialize_all else _os.environ.get(
+            "PATHWAY_SUPERVISE_DIR"
+        )
         if self._cluster is not None:
             bad = sorted(
                 {n.kind for n in self.graph.nodes if n.kind in self._CLUSTER_UNSUPPORTED}
@@ -570,6 +583,11 @@ class GraphRunner:
         deltas without losing genuine data.
         """
         commit_t0 = time_mod.monotonic()
+        if self._chaos is not None:
+            # fault injection: a scheduled kill fires at the commit BOUNDARY —
+            # the previous commit is fully journaled, this one is mid-flight
+            # everywhere else in the cluster (peers block in its barriers)
+            self._chaos.maybe_kill(self._rank, self._commit)
         self.current_time = self._commit * 2  # even data times, as in the reference
         self.draining = self._ready and self.sources_finished()
         any_output = self._substep(neu=False)
@@ -620,6 +638,22 @@ class GraphRunner:
                 )
         if self._monitor is not None:
             self._monitor.update(self._commit, self._step_counts, self.states)
+        if self._supervise_dir is not None:
+            # liveness for the spawn supervisor: written from THIS loop (not a
+            # helper thread) so staleness means the commit loop stopped turning
+            now = time_mod.monotonic()
+            if now - self._last_status_write >= 0.25:
+                from pathway_tpu.parallel.supervisor import write_status
+
+                health = self.health()
+                write_status(
+                    self._supervise_dir,
+                    self._rank,
+                    commit=self._commit,
+                    persistence=self._persistence is not None,
+                    peers=health["peers"],
+                )
+                self._last_status_write = now
         self._commit += 1
         return any_output
 
@@ -742,6 +776,26 @@ class GraphRunner:
             else:
                 raise AssertionError(f"unknown cluster policy {policy!r}")
         return routed
+
+    def health(self) -> Dict[str, Any]:
+        """One liveness payload, two consumers: the ``/healthz`` endpoint and
+        the supervisor's per-rank status file (``parallel/supervisor.py``)."""
+        peers: Dict[str, float] = {}
+        dead: Dict[str, str] = {}
+        if self._cluster is not None:
+            ages = getattr(self._cluster, "heartbeat_ages", None)
+            if ages is not None:
+                peers = {str(p): round(a, 3) for p, a in ages().items()}
+            dead_fn = getattr(self._cluster, "dead_peers", None)
+            if dead_fn is not None:
+                dead = {str(p): r for p, r in dead_fn().items()}
+        return {
+            "rank": self._rank,
+            "commit": self._commit,
+            "persistence": self._persistence is not None,
+            "peers": peers,
+            "dead_peers": dead,
+        }
 
     def output_columns_of(self, node: pg.Node) -> List[str]:
         return node.output.column_names() if node.output is not None else []
@@ -914,9 +968,19 @@ class GraphRunner:
 
         self._metrics = MetricsRecorder.get(self.prober_stats)
 
-        if not self._ready:
-            with span("graph_runner.build", nodes=len(self.graph.nodes)):
-                self.setup(monitoring_level, persistence_config=persistence_config)
+        try:
+            if not self._ready:
+                with span("graph_runner.build", nodes=len(self.graph.nodes)):
+                    self.setup(monitoring_level, persistence_config=persistence_config)
+        except BaseException:
+            # a failed build must not leak the just-bound monitoring listener:
+            # the caller may fix the config and rerun in this same process
+            if self._http_server is not None:
+                self._http_server.close()
+                self._http_server = None
+            raise
+        if self._http_server is not None:
+            self._http_server.health_source = self.health
         if env_cfg.snapshot_access == "replay" and not env_cfg.continue_after_replay:
             # replay-only run: the journal has been fed through the graph in setup();
             # stop without consuming realtime connector data
@@ -997,6 +1061,11 @@ class GraphRunner:
             runtime.update(prev_runtime)
             if max_commits is None:
                 self.finish()
+            elif self._http_server is not None:
+                # stepped runs keep engine state but must not leak the
+                # monitoring listener port across back-to-back runs
+                self._http_server.close()
+                self._http_server = None
 
 
 def _has_pending(evaluator: Any) -> bool:

@@ -1,0 +1,214 @@
+"""Deterministic fault injection — the chaos harness behind the failure tests.
+
+The supervised cluster runtime (``parallel/supervisor.py``, the hardened
+``parallel/cluster.py`` mesh) is only trustworthy if its failure paths are
+exercised the same way every time. This module injects faults from a SEEDED
+plan so a failure schedule replays exactly:
+
+- worker kills at chosen commit ids (``GraphRunner.step`` calls
+  :meth:`Chaos.maybe_kill` at every commit boundary);
+- dropped / delayed / truncated exchange frames (``ClusterExchange._send``
+  consults :meth:`Chaos.frame_action` for every DATA frame — heartbeats are
+  exempt so the injection counter stream stays deterministic per peer pair);
+- transient object-store write errors (:meth:`Chaos.wrap_object_store` wraps
+  the persistence backend; the engine's retry layer must absorb them).
+
+Environment contract::
+
+    PATHWAY_CHAOS_SEED   integer seed (default 0)
+    PATHWAY_CHAOS_PLAN   JSON plan, e.g.
+        {"kill":   [{"rank": 0, "commit": 3, "run": 0}],
+         "frames": {"drop_prob": 0.0, "delay_prob": 0.0, "delay_ms": 10,
+                    "truncate_prob": 0.0},
+         "backend": {"put_error_prob": 0.5, "max_errors": 4}}
+
+``run`` in a kill entry matches ``PATHWAY_RESTART_COUNT`` (set by the
+supervisor, 0 for a first launch), so a kill fires once and the restarted
+cluster survives the replayed schedule. Determinism comes from per-stream
+``random.Random`` instances keyed ``seed:kind:rank:peer`` — the Nth draw on a
+stream is a pure function of the seed and N, never of wall clock or other
+streams.
+
+With neither env var set, :func:`get_chaos` returns ``None`` and every hook is
+a no-op attribute check on the caller's side — zero overhead in production.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+from typing import Any, Dict, List, Optional
+
+
+class ChaosBackendError(ConnectionError):
+    """Injected transient object-store failure (retryable by design)."""
+
+
+class _FrameAction:
+    """One injection decision for an outgoing exchange frame."""
+
+    __slots__ = ("kind", "delay_s")
+
+    def __init__(self, kind: str, delay_s: float = 0.0):
+        self.kind = kind  # "pass" | "drop" | "delay" | "truncate"
+        self.delay_s = delay_s
+
+    def __repr__(self) -> str:  # test/debug readability
+        return f"_FrameAction({self.kind!r}, {self.delay_s})"
+
+
+_PASS = _FrameAction("pass")
+
+
+class Chaos:
+    """Seeded injection schedule, one instance per process."""
+
+    def __init__(self, seed: int, plan: Dict[str, Any]):
+        self.seed = seed
+        self.plan = plan
+        self.run_count = int(os.environ.get("PATHWAY_RESTART_COUNT", "0") or 0)
+        self._kills: List[Dict[str, Any]] = list(plan.get("kill") or [])
+        self._frames: Dict[str, Any] = dict(plan.get("frames") or {})
+        self._backend: Dict[str, Any] = dict(plan.get("backend") or {})
+        self._streams: Dict[str, random.Random] = {}
+        self._backend_errors_left = int(self._backend.get("max_errors", 3))
+        # observability for tests: what actually fired
+        self.stats: Dict[str, int] = {
+            "kills": 0,
+            "frames_dropped": 0,
+            "frames_delayed": 0,
+            "frames_truncated": 0,
+            "backend_errors": 0,
+        }
+
+    # -- streams -------------------------------------------------------------
+
+    def _stream(self, kind: str, *key: Any) -> random.Random:
+        name = ":".join([str(self.seed), kind, *map(str, key)])
+        rng = self._streams.get(name)
+        if rng is None:
+            rng = random.Random(name)
+            self._streams[name] = rng
+        return rng
+
+    # -- worker kills ---------------------------------------------------------
+
+    def maybe_kill(self, rank: int, commit_id: int) -> None:
+        """SIGKILL this process if the plan schedules a kill at (rank, commit)
+        for the current run (restart) count. Called at every commit boundary."""
+        for entry in self._kills:
+            if (
+                int(entry.get("rank", -1)) == rank
+                and int(entry.get("commit", -1)) == commit_id
+                and int(entry.get("run", 0)) == self.run_count
+            ):
+                self.stats["kills"] += 1
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- exchange frames -------------------------------------------------------
+
+    def frame_action(self, rank: int, peer: int) -> _FrameAction:
+        """Decide the fate of the next data frame ``rank -> peer``. Draws come
+        from the per-(rank, peer) stream, so the schedule is independent of
+        timing and of traffic to other peers."""
+        if not self._frames:
+            return _PASS
+        rng = self._stream("frames", rank, peer)
+        roll = rng.random()
+        drop = float(self._frames.get("drop_prob", 0.0))
+        trunc = float(self._frames.get("truncate_prob", 0.0))
+        delay = float(self._frames.get("delay_prob", 0.0))
+        if roll < drop:
+            self.stats["frames_dropped"] += 1
+            return _FrameAction("drop")
+        if roll < drop + trunc:
+            self.stats["frames_truncated"] += 1
+            return _FrameAction("truncate")
+        if roll < drop + trunc + delay:
+            self.stats["frames_delayed"] += 1
+            return _FrameAction("delay", float(self._frames.get("delay_ms", 10)) / 1000.0)
+        return _PASS
+
+    # -- persistence backends --------------------------------------------------
+
+    def wrap_object_store(self, store: Any) -> Any:
+        """Wrap an ``ObjectStore`` so PUTs fail transiently per the plan (a
+        bounded number of times — the retry layer above must converge)."""
+        if not self._backend:
+            return store
+        return _ChaosObjectStore(store, self)
+
+    def _put_should_fail(self, key: str) -> bool:
+        if self._backend_errors_left <= 0:
+            return False
+        prob = float(self._backend.get("put_error_prob", 0.0))
+        if prob <= 0.0:
+            return False
+        if self._stream("backend").random() < prob:
+            self._backend_errors_left -= 1
+            self.stats["backend_errors"] += 1
+            return True
+        return False
+
+
+class _ChaosObjectStore:
+    """Injects transient write errors in front of a real ``ObjectStore``.
+
+    Deliberately duck-typed (not an ``ObjectStore`` subclass): internals-layer
+    code must not import the persistence package at module load."""
+
+    def __init__(self, inner: Any, chaos: Chaos):
+        self._inner = inner
+        self._chaos = chaos
+
+    def put(self, key: str, data: bytes) -> None:
+        if self._chaos._put_should_fail(key):
+            raise ChaosBackendError(
+                f"chaos: injected transient write error for {key!r} "
+                f"(seed {self._chaos.seed})"
+            )
+        self._inner.put(key, data)
+
+    def get(self, key: str) -> "bytes | None":
+        return self._inner.get(key)
+
+    def list(self, prefix: str) -> List[str]:
+        return self._inner.list(prefix)
+
+    def delete(self, key: str) -> None:
+        self._inner.delete(key)
+
+
+_chaos: Optional[Chaos] = None
+_chaos_tried = False
+
+
+def get_chaos() -> Optional[Chaos]:
+    """The process-wide chaos harness, or None when no plan is configured.
+    Built once from the env; :func:`reset_chaos` rebuilds (tests)."""
+    global _chaos, _chaos_tried
+    if _chaos_tried:
+        return _chaos
+    plan_env = os.environ.get("PATHWAY_CHAOS_PLAN")
+    if plan_env:
+        try:
+            plan = json.loads(plan_env)
+        except ValueError as exc:
+            raise ValueError(
+                f"PATHWAY_CHAOS_PLAN is not valid JSON: {exc}"
+            ) from exc
+        seed = int(os.environ.get("PATHWAY_CHAOS_SEED", "0") or 0)
+        _chaos = Chaos(seed, plan)
+    else:
+        _chaos = None
+    _chaos_tried = True
+    return _chaos
+
+
+def reset_chaos() -> None:
+    """Drop the cached harness so the next :func:`get_chaos` re-reads the env."""
+    global _chaos, _chaos_tried
+    _chaos = None
+    _chaos_tried = False

@@ -25,6 +25,20 @@ def _int_env(name: str, default: int) -> int:
         return default
 
 
+def env_float(name: str, default: float) -> float:
+    """Float knob from the env; blank or malformed values fall back to the
+    default (an optional tuning knob must never kill the pipeline). One home
+    for the parse so the mesh (cluster.py) and the supervisor read the shared
+    PATHWAY_* knobs identically."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
 @dataclass
 class PathwayConfig:
     threads: int = 1

@@ -33,6 +33,9 @@ class NoRetryStrategy(AsyncRetryStrategy):
 
 
 class ExponentialBackoffRetryStrategy(AsyncRetryStrategy):
+    # NOTE: persistence.backends.RetryingObjectStore mirrors this schedule in a
+    # sync loop (exact-type-gated); changing the retry behavior here means
+    # changing it there too, or subclassing so the sync fast path is bypassed.
     def __init__(
         self,
         max_retries: int = 3,

@@ -20,6 +20,7 @@ Environment contract (set by ``pathway_tpu spawn``): ``PATHWAY_PROCESSES``,
 from __future__ import annotations
 
 import pickle
+import random
 import socket
 import struct
 import threading
@@ -27,6 +28,12 @@ import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from pathway_tpu.internals.config import env_float as _env_float
+
+# control frame: liveness beacon, never enters the inbox (and never counts
+# toward the chaos harness's per-peer data-frame streams)
+HEARTBEAT_TAG = b"\x00hb"
 
 
 class ClusterExchange:
@@ -36,6 +43,24 @@ class ClusterExchange:
     payload per peer under a tag and blocks until the same tag arrived from every
     peer. Deterministic tag sequences (commit id x node id x purpose) keep the
     processes in lockstep without a coordinator.
+
+    Failure model (the supervised-runtime contract): every peer link carries
+    heartbeat frames, every barrier wait has a deadline, and a dead or wedged
+    peer surfaces as a typed ``PeerShutdownError`` (its socket closed) or
+    ``PeerTimeoutError`` (barrier deadline / heartbeat staleness) instead of an
+    infinite ``Condition.wait`` — a SIGKILLed worker fails its survivors loudly
+    within the deadline, never hangs them. Knobs (env):
+
+    - ``PATHWAY_BARRIER_TIMEOUT_S`` — per-barrier recv deadline (default 300);
+    - ``PATHWAY_HEARTBEAT_INTERVAL_S`` — beacon period (default 1.0);
+    - ``PATHWAY_HEARTBEAT_TIMEOUT_S`` — staleness bound while waiting on a peer
+      (default 60; 0 disables);
+    - ``PATHWAY_CONNECT_TIMEOUT_S`` — connect budget PER PEER dialed, and
+      again for the dial-in accept join (default 60; worst-case wiring time
+      for rank r is ``(n - r) x`` this bound);
+    - ``PATHWAY_EXCHANGE_INBOX_FRAMES`` — per-peer inbox bound (default 1024);
+      a full inbox parks the reader thread (TCP backpressure), it never grows
+      without bound when one process runs ahead of its peers.
     """
 
     _HDR = struct.Struct("<II")  # tag_len, payload_len
@@ -47,16 +72,42 @@ class ClusterExchange:
         self._conns: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
         self._inbox: Dict[tuple, bytes] = {}  # (peer, tag) -> payload
+        self._inbox_count: Dict[int, int] = {}  # buffered frames per peer
         self._cv = threading.Condition()
         self._closed = False
+        self._dead: Dict[int, str] = {}  # peer -> reason its link died
+        self._last_heard: Dict[int, float] = {}
         self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self.barrier_timeout_s = _env_float("PATHWAY_BARRIER_TIMEOUT_S", 300.0)
+        self.heartbeat_interval_s = _env_float("PATHWAY_HEARTBEAT_INTERVAL_S", 1.0)
+        self.heartbeat_timeout_s = _env_float("PATHWAY_HEARTBEAT_TIMEOUT_S", 60.0)
+        self._inbox_limit = max(
+            1, int(_env_float("PATHWAY_EXCHANGE_INBOX_FRAMES", 1024))
+        )
+        from pathway_tpu.internals.chaos import get_chaos
+
+        self._chaos = get_chaos()
         self._connect_all()
+        now = time.monotonic()
+        for peer in self._conns:
+            self._last_heard[peer] = now
+            self._inbox_count[peer] = 0
         for peer, conn in self._conns.items():
             t = threading.Thread(
                 target=self._reader, args=(peer, conn), daemon=True,
                 name=f"pathway:cluster-rx-{peer}",
             )
             t.start()
+        if self.heartbeat_interval_s > 0:
+            # one beacon thread PER PEER: a send stalled on one backpressured
+            # link (full socket buffer) must not starve beacons to the others —
+            # that would read as a false cluster-wide wedge
+            for peer in self._conns:
+                threading.Thread(
+                    target=self._heartbeat_loop, args=(peer,), daemon=True,
+                    name=f"pathway:cluster-hb-{peer}",
+                ).start()
 
     # -- wiring --------------------------------------------------------------
 
@@ -81,41 +132,87 @@ class ClusterExchange:
 
         acceptor = threading.Thread(target=accept_loop, daemon=True)
         acceptor.start()
-        # we dial every higher-ranked peer (with retry: they may not be up yet)
-        for peer in range(self.me + 1, self.n):
-            deadline = time.monotonic() + 60
-            while True:
-                try:
-                    s = socket.create_connection(
-                        ("127.0.0.1", self.first_port + peer), timeout=5
-                    )
-                    break
-                except OSError:
-                    if time.monotonic() > deadline:
-                        raise TimeoutError(
-                            f"cluster process {self.me} could not reach peer {peer} "
-                            f"on port {self.first_port + peer}"
+        connect_budget = _env_float("PATHWAY_CONNECT_TIMEOUT_S", 60.0)
+        try:
+            # dial every higher-ranked peer, with exponential backoff + jitter:
+            # peers may not be up yet, and N processes hammering one listener at
+            # a fixed 50 ms period synchronize into accept-queue bursts
+            rng = random.Random((self.me << 16) ^ self.first_port)
+            for peer in range(self.me + 1, self.n):
+                deadline = time.monotonic() + connect_budget
+                delay = 0.05
+                while True:
+                    try:
+                        s = socket.create_connection(
+                            ("127.0.0.1", self.first_port + peer), timeout=5
                         )
-                    time.sleep(0.05)
-            s.sendall(self.me.to_bytes(4, "little"))
-            self._conns[peer] = s
-        acceptor.join(timeout=60)
-        if acceptor.is_alive():
-            raise TimeoutError(
-                f"cluster process {self.me} timed out waiting for dial-ins"
-            )
-        if accept_errors:
-            raise ConnectionError(
-                f"cluster process {self.me} failed accepting dial-ins"
-            ) from accept_errors[0]
-        if len(accepted) != self.me:
-            raise ConnectionError(
-                f"cluster process {self.me} expected {self.me} dial-ins, got "
-                f"{sorted(accepted)}"
-            )
+                        break
+                    except OSError:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise PeerTimeoutError(
+                                f"cluster process {self.me} could not reach peer "
+                                f"{peer} on port {self.first_port + peer} within "
+                                f"{connect_budget:.0f}s"
+                            )
+                        time.sleep(
+                            min(remaining, delay * (1.0 + 0.25 * rng.random()))
+                        )
+                        delay = min(delay * 2, 2.0)
+                # back to fully blocking: create_connection's dial timeout must
+                # not linger on the socket, or every later sendall/recv on this
+                # link spuriously times out after 5s of quiet (SO_SNDTIMEO and
+                # the recv-side deadlines own timeout behavior from here on)
+                s.settimeout(None)
+                s.sendall(self.me.to_bytes(4, "little"))
+                self._conns[peer] = s
+            acceptor.join(timeout=connect_budget)
+            if acceptor.is_alive():
+                raise PeerTimeoutError(
+                    f"cluster process {self.me} timed out waiting for dial-ins"
+                )
+            if accept_errors:
+                raise ConnectionError(
+                    f"cluster process {self.me} failed accepting dial-ins"
+                ) from accept_errors[0]
+            if len(accepted) != self.me:
+                raise ConnectionError(
+                    f"cluster process {self.me} expected {self.me} dial-ins, got "
+                    f"{sorted(accepted)}"
+                )
+        except BaseException:
+            # failed wiring must not strand fds: a stranded listener wedges the
+            # retry (and the restarted rank) on "Address already in use"
+            for s in list(self._conns.values()) + list(accepted.values()):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            try:
+                listener.close()
+            except OSError:
+                pass
+            self._listener = None
+            raise
         self._conns.update(accepted)
         for peer, conn in self._conns.items():
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.barrier_timeout_s > 0:
+                # send-side deadline (SO_SNDTIMEO is send-ONLY, so the reader
+                # thread's blocking recv is untouched): a peer that stopped
+                # reading must surface as a typed error from _send, not hang
+                # sendall forever once the TCP buffers fill — _recv's deadlines
+                # can't fire if we never get there
+                conn.setsockopt(
+                    socket.SOL_SOCKET,
+                    socket.SO_SNDTIMEO,
+                    struct.pack(
+                        "ll",
+                        int(self.barrier_timeout_s),
+                        int(self.barrier_timeout_s % 1 * 1_000_000),
+                    ),
+                )
             self._send_locks[peer] = threading.Lock()
 
     @staticmethod
@@ -136,39 +233,146 @@ class ClusterExchange:
                 tag = self._recv_exact(conn, tag_len)
                 payload = self._recv_exact(conn, payload_len) if payload_len else b""
                 with self._cv:
+                    self._last_heard[peer] = time.monotonic()
+                    if tag == HEARTBEAT_TAG:
+                        self._cv.notify_all()
+                        continue
+                    # bounded inbox: park until the consumer drains (the unread
+                    # backlog itself proves the peer is alive, so keep the
+                    # heartbeat clock fresh while parked — the peer's beacons
+                    # queue behind the data we are not reading)
+                    while (
+                        self._inbox_count[peer] >= self._inbox_limit
+                        and not self._closed
+                    ):
+                        self._last_heard[peer] = time.monotonic()
+                        self._cv.wait(timeout=0.2)
+                    if self._closed:
+                        return
                     self._inbox[(peer, tag)] = payload
+                    self._inbox_count[peer] += 1
                     self._cv.notify_all()
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as exc:
             with self._cv:
-                self._closed = True
+                self._dead.setdefault(peer, str(exc) or type(exc).__name__)
                 self._cv.notify_all()
 
     def _send(self, peer: int, tag: bytes, payload: bytes) -> None:
         conn = self._conns[peer]
-        with self._send_locks[peer]:
-            conn.sendall(self._HDR.pack(len(tag), len(payload)) + tag + payload)
+        frame = self._HDR.pack(len(tag), len(payload)) + tag + payload
+        if self._chaos is not None and tag != HEARTBEAT_TAG:
+            action = self._chaos.frame_action(self.me, peer)
+            if action.kind == "drop":
+                return  # peer's barrier deadline turns this into PeerTimeoutError
+            if action.kind == "delay":
+                time.sleep(action.delay_s)
+            elif action.kind == "truncate":
+                # torn write + dead link, as a crash mid-send would leave it
+                with self._send_locks[peer]:
+                    try:
+                        conn.sendall(frame[: max(1, len(frame) // 2)])
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                with self._cv:
+                    self._dead.setdefault(peer, "chaos: link truncated")
+                    self._cv.notify_all()
+                return
+        try:
+            with self._send_locks[peer]:
+                conn.sendall(frame)
+        except OSError as exc:
+            timed_out = isinstance(exc, (socket.timeout, BlockingIOError))
+            with self._cv:
+                # the stream may have a torn partial frame on it now — the
+                # link is unusable either way, so the peer is dead to us
+                self._dead.setdefault(peer, str(exc) or type(exc).__name__)
+                self._cv.notify_all()
+            if timed_out:
+                raise PeerTimeoutError(
+                    f"cluster process {self.me} send of {tag!r} to peer {peer} "
+                    f"stalled past the {self.barrier_timeout_s:.0f}s deadline "
+                    "— peer stopped reading"
+                ) from exc
+            raise PeerShutdownError(
+                f"cluster process {self.me} failed sending {tag!r} to peer "
+                f"{peer}: {exc}"
+            ) from exc
 
-    def _recv(self, peer: int, tag: bytes, timeout: float = 300.0) -> bytes:
+    def _recv(self, peer: int, tag: bytes, timeout: Optional[float] = None) -> bytes:
+        if timeout is None:
+            timeout = self.barrier_timeout_s
         deadline = time.monotonic() + timeout
         with self._cv:
             while (peer, tag) not in self._inbox:
-                if self._closed:
-                    raise ConnectionError(
-                        f"cluster peer {peer} disconnected while waiting for {tag!r}"
+                if peer in self._dead:
+                    raise PeerShutdownError(
+                        f"cluster peer {peer} disconnected while process "
+                        f"{self.me} waited for {tag!r}: {self._dead[peer]}"
                     )
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(
-                        f"cluster process {self.me} timed out waiting for {tag!r} "
+                if self._closed:
+                    raise PeerShutdownError(
+                        f"cluster exchange closed while waiting for {tag!r} "
                         f"from peer {peer}"
                     )
-                self._cv.wait(timeout=min(remaining, 1.0))
-            return self._inbox.pop((peer, tag))
+                now = time.monotonic()
+                heard = self._last_heard.get(peer)
+                if (
+                    self.heartbeat_timeout_s > 0
+                    # without beacons, silence between barriers is normal —
+                    # staleness is only meaningful while heartbeats flow
+                    and self.heartbeat_interval_s > 0
+                    and heard is not None
+                    and now - heard > self.heartbeat_timeout_s
+                ):
+                    raise PeerTimeoutError(
+                        f"cluster peer {peer} heartbeat is {now - heard:.1f}s "
+                        f"stale (> {self.heartbeat_timeout_s:.0f}s) while process "
+                        f"{self.me} waited for {tag!r} — peer is wedged"
+                    )
+                remaining = deadline - now
+                if remaining <= 0:
+                    raise PeerTimeoutError(
+                        f"cluster process {self.me} timed out after "
+                        f"{timeout:.0f}s waiting for {tag!r} from peer {peer}"
+                    )
+                self._cv.wait(timeout=min(remaining, 0.5))
+            payload = self._inbox.pop((peer, tag))
+            self._inbox_count[peer] -= 1
+            self._cv.notify_all()  # unpark a backpressured reader
+            return payload
+
+    # -- liveness -------------------------------------------------------------
+
+    def _heartbeat_loop(self, peer: int) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            if self._closed or peer in self._dead:
+                return
+            try:
+                self._send(peer, HEARTBEAT_TAG, b"")
+            except (PeerShutdownError, OSError):
+                return  # _send already recorded the death
+
+    def heartbeat_ages(self) -> Dict[int, float]:
+        """Seconds since each peer was last heard from (any frame). The shared
+        liveness signal: served by ``/healthz`` and written to the supervisor's
+        per-rank status file."""
+        now = time.monotonic()
+        with self._cv:
+            return {peer: now - t for peer, t in self._last_heard.items()}
+
+    def dead_peers(self) -> Dict[int, str]:
+        with self._cv:
+            return dict(self._dead)
 
     # -- collectives ----------------------------------------------------------
 
     def exchange_parts(self, tag: bytes, parts: Dict[int, bytes]) -> Dict[int, bytes]:
-        """All-to-all: send ``parts[peer]`` to each peer, receive theirs. Barrier."""
+        """All-to-all: send ``parts[peer]`` to each peer, receive theirs. Barrier.
+
+        Raises :class:`PeerShutdownError` when a peer's link died, or
+        :class:`PeerTimeoutError` when a peer missed the barrier deadline or
+        went heartbeat-stale — never blocks forever on a dead peer."""
         for peer in self._conns:
             self._send(peer, tag, parts.get(peer, b""))
         return {peer: self._recv(peer, tag) for peer in self._conns}
@@ -184,6 +388,10 @@ class ClusterExchange:
         return out
 
     def close(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()  # release parked readers and waiting recvs
         for conn in self._conns.values():
             try:
                 conn.close()
@@ -345,6 +553,9 @@ class ThreadExchange(ClusterExchange):
         self.me = me
         self._hub = hub
         self._conns = {p: None for p in range(hub.n) if p != me}  # peer ranks
+        # same barrier-deadline knob as the TCP lane (no heartbeats here: a
+        # thread peer cannot vanish silently, only wedge — which this catches)
+        self.barrier_timeout_s = _env_float("PATHWAY_BARRIER_TIMEOUT_S", 300.0)
 
     def _send(self, peer: int, tag: bytes, payload: Any) -> None:
         if payload is not None and hasattr(payload, "columns"):
@@ -353,7 +564,9 @@ class ThreadExchange(ClusterExchange):
             self._hub.boxes[(peer, self.me, tag)] = payload
             self._hub.cv.notify_all()
 
-    def _recv(self, peer: int, tag: bytes, timeout: float = 300.0) -> bytes:
+    def _recv(self, peer: int, tag: bytes, timeout: Optional[float] = None) -> bytes:
+        if timeout is None:
+            timeout = self.barrier_timeout_s
         deadline = time.monotonic() + timeout
         key = (self.me, peer, tag)
         with self._hub.cv:
@@ -373,6 +586,12 @@ class ThreadExchange(ClusterExchange):
 
     def close(self) -> None:
         self._hub.close()
+
+    def heartbeat_ages(self) -> Dict[int, float]:
+        return {}  # one address space: a peer thread cannot vanish silently
+
+    def dead_peers(self) -> Dict[int, str]:
+        return {}
 
     @property
     def shared_inputs(self) -> bool:

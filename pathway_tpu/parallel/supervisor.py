@@ -1,0 +1,360 @@
+"""Supervised cluster launcher — the ``pathway_tpu spawn`` parent process.
+
+Parity target: timely/differential's supervised-worker model (a worker failure
+is a handled EVENT, not a hang) — and the r4 torture lesson that recovery by
+"kill everything and restart from the journal" works, automated here so the
+operator no longer is the supervisor.
+
+The spawn parent launches one child per rank and then watches two signals:
+
+- **exit codes** — a nonzero or signal-killed child is a cluster failure
+  (surviving ranks fail loudly themselves via the typed
+  ``PeerShutdownError``/``PeerTimeoutError`` barrier errors in
+  ``parallel/cluster.py``);
+- **heartbeat staleness** — each worker's commit loop writes a per-rank status
+  file (``write_status``) under ``PATHWAY_SUPERVISE_DIR``; a rank whose status
+  goes stale while its process is alive is wedged and gets killed. The same
+  payload backs the worker's ``/healthz`` endpoint, so the supervisor and
+  external probes share one liveness signal.
+
+On failure, the supervisor either
+
+- **restarts the cluster** — when every reporting rank ran with persistence on
+  and the ``--max-restarts`` budget remains: survivors are torn down and all
+  ranks relaunch with ``PATHWAY_RESTART_COUNT`` bumped; the restarted workers
+  replay the union of journaled commit ids in lockstep (the engine's resume
+  path), i.e. a cluster-wide rollback-resume from the last fully journaled
+  commit; or
+- **tears down loudly** — persistence off, no reports, or budget exhausted:
+  every survivor is terminated and a per-rank post-mortem (exit cause, last
+  commit, heartbeat age) goes to stderr, and the exit code is nonzero. Never a
+  hang.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from pathway_tpu.internals.config import env_float as _env_float
+
+
+def _default_stale_after() -> float:
+    """Status files refresh once per commit, and a commit may legitimately sit
+    inside an exchange barrier for the mesh's full deadline — so the wedge
+    bound must EXCEED the barrier timeout or slow-but-healthy clusters get
+    killed (and, restarted, deterministically killed again)."""
+    return _env_float("PATHWAY_BARRIER_TIMEOUT_S", 300.0) + 60.0
+
+
+# a rank that never reports at all (wedged before its first commit — e.g. a
+# deadlock during import or a giant journal load) gets a separate, generous
+# startup deadline; 0 disables
+DEFAULT_STARTUP_GRACE_S = 600.0
+
+# after a failure is detected, give the surviving ranks a moment to fail on
+# their OWN typed barrier errors (PeerShutdownError/PeerTimeoutError propagate
+# within the socket-close latency) before SIGTERMing them — post-mortems then
+# record real exit causes, not "terminated by supervisor"
+DEFAULT_DRAIN_S = 10.0
+
+_STATUS_PREFIX = "rank-"
+_STATUS_SUFFIX = ".status.json"
+
+
+def status_path(supervise_dir: str, rank: int) -> str:
+    return os.path.join(supervise_dir, f"{_STATUS_PREFIX}{rank}{_STATUS_SUFFIX}")
+
+
+def write_status(
+    supervise_dir: str,
+    rank: int,
+    *,
+    commit: int,
+    persistence: bool,
+    peers: "Dict[str, float] | None" = None,
+) -> None:
+    """Atomically publish one worker's liveness record. Called from the commit
+    loop (throttled there), so recency == the loop is actually turning; a
+    background thread here would defeat wedge detection."""
+    payload = {
+        "pid": os.getpid(),
+        "rank": rank,
+        "commit": commit,
+        "persistence": bool(persistence),
+        "peers": peers or {},
+        "ts": time.time(),
+    }
+    path = status_path(supervise_dir, rank)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:
+        # liveness reporting must never kill the worker (dir vanished mid-teardown)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def read_statuses(supervise_dir: str, n: int) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for rank in range(n):
+        try:
+            with open(status_path(supervise_dir, rank)) as f:
+                out[rank] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def describe_exit(code: "int | None") -> str:
+    if code is None:
+        return "running"
+    if code < 0:
+        try:
+            name = signal.Signals(-code).name
+        except ValueError:
+            name = str(-code)
+        return f"killed by signal {name}"
+    return f"exit code {code}"
+
+
+class Supervisor:
+    """Launch, monitor, and (with persistence) restart a spawn cluster."""
+
+    def __init__(
+        self,
+        *,
+        processes: int,
+        threads: int,
+        first_port: int,
+        program: str,
+        arguments: "List[str] | tuple",
+        env_base: Dict[str, str],
+        max_restarts: int = 0,
+        stale_after_s: "float | None" = None,
+        poll_interval_s: float = 0.2,
+    ):
+        self.n = processes
+        self.threads = threads
+        self.first_port = first_port
+        self.program = program
+        self.arguments = list(arguments)
+        self.env_base = env_base
+        self.max_restarts = max_restarts
+        if stale_after_s is None:
+            stale_after_s = _env_float(
+                "PATHWAY_SUPERVISOR_STALE_S", _default_stale_after()
+            )
+        self.stale_after_s = stale_after_s
+        self.startup_grace_s = _env_float(
+            "PATHWAY_SUPERVISOR_STARTUP_S", DEFAULT_STARTUP_GRACE_S
+        )
+        self.poll_interval_s = poll_interval_s
+        self.restarts_used = 0
+        self.handles: List[subprocess.Popen] = []
+        self._terminated_by_us: "set[int]" = set()
+        self._supervise_dir: Optional[str] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        print(f"pathway supervisor: {msg}", file=sys.stderr, flush=True)
+
+    def _launch(self) -> None:
+        assert self._supervise_dir is not None
+        # stale status files from the previous incarnation must not trip the
+        # staleness monitor against freshly launched ranks
+        for rank in range(self.n):
+            try:
+                os.unlink(status_path(self._supervise_dir, rank))
+            except OSError:
+                pass
+        run_id = uuid.uuid4()
+        self.handles = []
+        self._terminated_by_us = set()
+        self._launched_at = time.monotonic()
+        for process_id in range(self.n):
+            env = self.env_base.copy()
+            env["PATHWAY_THREADS"] = str(self.threads)
+            env["PATHWAY_PROCESSES"] = str(self.n)
+            env["PATHWAY_FIRST_PORT"] = str(self.first_port)
+            env["PATHWAY_PROCESS_ID"] = str(process_id)
+            env["PATHWAY_RUN_ID"] = str(run_id)
+            env["PATHWAY_SUPERVISE_DIR"] = self._supervise_dir
+            env["PATHWAY_RESTART_COUNT"] = str(self.restarts_used)
+            self.handles.append(
+                subprocess.Popen([self.program, *self.arguments], env=env)
+            )
+
+    def _drain(self) -> None:
+        """Briefly wait for survivors to exit on their own typed errors."""
+        deadline = time.monotonic() + _env_float(
+            "PATHWAY_SUPERVISOR_DRAIN_S", DEFAULT_DRAIN_S
+        )
+        while time.monotonic() < deadline:
+            if all(h.poll() is not None for h in self.handles):
+                return
+            time.sleep(0.05)
+
+    def _terminate_all(self) -> None:
+        for rank, handle in enumerate(self.handles):
+            if handle.poll() is None:
+                self._terminated_by_us.add(rank)
+                try:
+                    handle.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 10
+        for handle in self.handles:
+            try:
+                handle.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    handle.kill()
+                except OSError:
+                    pass
+                handle.wait()
+
+    def _watch(self) -> "Optional[tuple]":
+        """Block until the cluster finishes or fails.
+
+        Returns None when every rank exited 0; otherwise ``(rank, reason)`` for
+        the first observed failure (nonzero/signal exit, or a wedged rank the
+        supervisor had to kill for heartbeat staleness)."""
+        assert self._supervise_dir is not None
+        while True:
+            any_alive = False
+            statuses = read_statuses(self._supervise_dir, self.n)
+            up_for = time.monotonic() - self._launched_at
+            for rank, handle in enumerate(self.handles):
+                rc = handle.poll()
+                if rc is None:
+                    any_alive = True
+                    status = statuses.get(rank)
+                    if status is not None:
+                        age = time.time() - status.get("ts", 0)
+                        if (
+                            self.stale_after_s > 0
+                            and age > self.stale_after_s
+                            and up_for > self.stale_after_s
+                        ):
+                            self._kill_wedged(rank, handle)
+                            return (
+                                rank,
+                                f"heartbeat stale ({age:.0f}s); killed as wedged",
+                            )
+                    elif self.startup_grace_s > 0 and up_for > self.startup_grace_s:
+                        # never reported at all: wedged before its first commit
+                        self._kill_wedged(rank, handle)
+                        return (
+                            rank,
+                            f"no status report within {self.startup_grace_s:.0f}s "
+                            "of launch; killed as wedged at startup",
+                        )
+                elif rc != 0:
+                    return (rank, describe_exit(rc))
+            if not any_alive:
+                return None
+            time.sleep(self.poll_interval_s)
+
+    def _kill_wedged(self, rank: int, handle: subprocess.Popen) -> None:
+        self._terminated_by_us.add(rank)
+        try:
+            handle.kill()
+        except OSError:
+            pass
+        handle.wait()
+
+    # -- reporting -------------------------------------------------------------
+
+    def _post_mortem(self, failure: tuple, statuses: Dict[int, dict], why_final: str) -> None:
+        failed_rank, reason = failure
+        self._log(f"cluster FAILED — rank {failed_rank}: {reason}")
+        now = time.time()
+        for rank, handle in enumerate(self.handles):
+            status = statuses.get(rank)
+            parts = [describe_exit(handle.poll())]
+            if rank in self._terminated_by_us:
+                parts.append("terminated by supervisor")
+            if status is not None:
+                parts.append(f"last commit {status.get('commit')}")
+                parts.append(f"heartbeat {now - status.get('ts', now):.1f}s ago")
+                parts.append(
+                    "persistence on" if status.get("persistence") else "persistence off"
+                )
+            else:
+                parts.append("no status report")
+            self._log(f"  post-mortem rank {rank}: " + ", ".join(parts))
+        self._log(f"not restarting: {why_final}")
+
+    # -- entry point -----------------------------------------------------------
+
+    def run(self) -> int:
+        """Supervise until clean completion (0) or final failure (nonzero)."""
+        self._supervise_dir = tempfile.mkdtemp(prefix="pathway-supervise-")
+        try:
+            self._launch()
+            while True:
+                failure = self._watch()
+                if failure is None:
+                    return 0
+                self._drain()
+                statuses = read_statuses(self._supervise_dir, self.n)
+                # restart only when the journal can actually restore the work:
+                # every reporting rank ran with persistence on (a rank that died
+                # before its first commit simply has no report and no journal
+                # entries to lose — the others' journals still replay)
+                persistence_on = bool(statuses) and all(
+                    s.get("persistence") for s in statuses.values()
+                )
+                self._terminate_all()
+                if not persistence_on:
+                    self._post_mortem(
+                        failure,
+                        statuses,
+                        "persistence is off (or no rank reported); the journal "
+                        "cannot restore lost state — rerun with a persistence "
+                        "backend to enable failover",
+                    )
+                    return self._exit_code(failure)
+                if self.restarts_used >= self.max_restarts:
+                    self._post_mortem(
+                        failure,
+                        statuses,
+                        f"restart budget exhausted ({self.restarts_used} used, "
+                        f"--max-restarts {self.max_restarts})",
+                    )
+                    return self._exit_code(failure)
+                self.restarts_used += 1
+                last_commit = max(
+                    (s.get("commit", 0) for s in statuses.values()), default=0
+                )
+                self._log(
+                    f"rank {failure[0]} died ({failure[1]}); restarting the cluster "
+                    f"(attempt {self.restarts_used}/{self.max_restarts}), rolling "
+                    f"back to the last fully journaled commit (≤ {last_commit})"
+                )
+                self._launch()
+        finally:
+            self._terminate_all()
+            if self._supervise_dir is not None:
+                shutil.rmtree(self._supervise_dir, ignore_errors=True)
+
+    def _exit_code(self, failure: tuple) -> int:
+        codes = [h.returncode for h in self.handles if h.returncode not in (None, 0)]
+        for code in codes:
+            if 0 < code < 256:
+                return code
+        return 1

@@ -75,6 +75,11 @@ class Config:
     ``persistence_mode="silent_replay"`` keeps output callbacks / external sinks from
     re-receiving already-delivered rows during journal replay on resume (the default
     re-delivers, matching the reference's speedrun replay where sinks dedup by key).
+
+    ``backend_retry_strategy`` governs transient object-store (s3/azure) failures:
+    by default every journal/checkpoint op retries with exponential backoff
+    (``udfs.ExponentialBackoffRetryStrategy``); pass ``udfs.NoRetryStrategy()`` to
+    fail fast, or a custom strategy to tune the schedule.
     """
 
     def __init__(
@@ -85,12 +90,14 @@ class Config:
         snapshot_access: Any = None,
         persistence_mode: Any = None,
         continue_after_replay: bool = True,
+        backend_retry_strategy: Any = None,
     ):
         self.backend = backend
         self.snapshot_interval_ms = snapshot_interval_ms
         self.snapshot_access = snapshot_access
         self.persistence_mode = persistence_mode
         self.continue_after_replay = continue_after_replay
+        self.backend_retry_strategy = backend_retry_strategy
 
     @classmethod
     def simple_config(cls, backend: Backend, **kwargs: Any) -> "Config":

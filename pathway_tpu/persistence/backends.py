@@ -49,6 +49,76 @@ def _is_not_found(exc: Exception) -> bool:
     return type(exc).__name__ in ("ResourceNotFoundError", "BlobNotFound")
 
 
+class RetryingObjectStore(ObjectStore):
+    """Transient-failure absorption for object-store persistence: every op runs
+    through an :class:`~pathway_tpu.internals.udfs.AsyncRetryStrategy` (default
+    ``ExponentialBackoffRetryStrategy``), so a throttled PUT or a flaky network
+    read retries with backoff+jitter instead of killing the pipeline mid-commit.
+
+    Not-found is NOT an error at this layer (inner stores return ``None``), so
+    retries fire only on genuine exceptions. Wrap ORDER matters in tests: the
+    chaos store (``internals/chaos.py``) injects below this wrapper, so injected
+    transient write errors are exactly what this absorbs."""
+
+    def __init__(self, inner: ObjectStore, strategy: Any = None):
+        if strategy is None:
+            from pathway_tpu.internals.udfs import ExponentialBackoffRetryStrategy
+
+            strategy = ExponentialBackoffRetryStrategy(
+                max_retries=4, initial_delay=50, backoff_factor=2, jitter_ms=20
+            )
+        self._inner = inner
+        self._strategy = strategy
+        # the STOCK backoff strategies run a plain sync sleep loop — one
+        # journal PUT per commit must not pay event-loop setup/teardown per
+        # call. Exact-type check: a subclass may override invoke() (selective
+        # retry, logging) and must go through it, not a reimplemented schedule.
+        from pathway_tpu.internals.udfs import (
+            ExponentialBackoffRetryStrategy,
+            FixedDelayRetryStrategy,
+        )
+
+        self._sync_schedule = type(strategy) in (
+            ExponentialBackoffRetryStrategy,
+            FixedDelayRetryStrategy,
+        )
+
+    def _retry(self, fun: Callable, *args: Any) -> Any:
+        if self._sync_schedule:
+            import random
+            import time
+
+            s = self._strategy
+            delay = s.initial_delay
+            for attempt in range(s.max_retries + 1):
+                try:
+                    return fun(*args)
+                except Exception:
+                    if attempt == s.max_retries:
+                        raise
+                    time.sleep(delay + random.random() * s.jitter)
+                    delay *= s.backoff_factor
+            raise RuntimeError("unreachable")
+        import asyncio
+
+        async def call(*a: Any) -> Any:
+            return fun(*a)
+
+        return asyncio.run(self._strategy.invoke(call, *args))
+
+    def put(self, key: str, data: bytes) -> None:
+        self._retry(self._inner.put, key, data)
+
+    def get(self, key: str) -> "bytes | None":
+        return self._retry(self._inner.get, key)
+
+    def list(self, prefix: str) -> List[str]:
+        return self._retry(self._inner.list, prefix)
+
+    def delete(self, key: str) -> None:
+        self._retry(self._inner.delete, key)
+
+
 class PrefixedStore(ObjectStore):
     """A namespaced view over another store (per-process shards, cached-object
     subtrees) — every key gets the prefix applied on the way in/out."""

@@ -92,7 +92,22 @@ class PersistenceManager:
             # object stores have no append, and a PUT per commit frame gives the
             # fs backend's fsync-per-frame crash guarantee (a frame either fully
             # exists or doesn't; no torn tails)
-            self._object_store = backend.make_object_store()
+            store = backend.make_object_store()
+            from pathway_tpu.internals.chaos import get_chaos
+
+            chaos = get_chaos()
+            if chaos is not None:
+                # fault injection sits BELOW the retry layer: injected transient
+                # write errors must be absorbed exactly like real ones
+                store = chaos.wrap_object_store(store)
+            retry_strategy = getattr(config, "backend_retry_strategy", None)
+            from pathway_tpu.internals.udfs import NoRetryStrategy
+
+            if not isinstance(retry_strategy, NoRetryStrategy):
+                from pathway_tpu.persistence.backends import RetryingObjectStore
+
+                store = RetryingObjectStore(store, retry_strategy)
+            self._object_store = store
             self._memory = False
         else:
             self._memory = backend.kind in ("memory", "mock") or self.root is None

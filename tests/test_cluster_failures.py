@@ -1,0 +1,166 @@
+"""Mesh-layer failure semantics: typed peer errors, barrier deadlines,
+heartbeats, bounded inbox backpressure, and wiring-failure fd hygiene —
+ClusterExchange pairs wired over localhost inside one process."""
+
+from __future__ import annotations
+
+import itertools
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.parallel.cluster import (
+    ClusterExchange,
+    PeerShutdownError,
+    PeerTimeoutError,
+)
+
+_PORT_SLOT = itertools.count()
+
+
+def _port_base() -> int:
+    # distinct base per pair so back-to-back tests never contend on TIME_WAIT
+    return 26000 + os.getpid() % 200 * 16 + next(_PORT_SLOT) * 4
+
+
+def _pair(first_port: int):
+    made: dict = {}
+    errors: list = []
+
+    def mk(me: int) -> None:
+        try:
+            made[me] = ClusterExchange(2, me, first_port)
+        except BaseException as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=mk, args=(me,)) for me in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, f"pair wiring failed: {errors}"
+    assert set(made) == {0, 1}
+    return made[0], made[1]
+
+
+def test_exchange_parts_roundtrip_and_typed_shutdown(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HEARTBEAT_INTERVAL_S", "0.1")
+    a, b = _pair(_port_base())
+    try:
+        out: dict = {}
+
+        def b_side() -> None:
+            out["b"] = b.exchange_parts(b"t1", {0: b"from-b"})
+
+        t = threading.Thread(target=b_side)
+        t.start()
+        got_a = a.exchange_parts(b"t1", {1: b"from-a"})
+        t.join(timeout=10)
+        assert got_a == {1: b"from-b"}
+        assert out["b"] == {0: b"from-a"}
+
+        # peer teardown surfaces as the TYPED error, quickly (socket close,
+        # not a barrier timeout)
+        b.close()
+        t0 = time.monotonic()
+        with pytest.raises(PeerShutdownError):
+            a._recv(1, b"never-sent", timeout=30)
+        assert time.monotonic() - t0 < 5
+        assert 1 in a.dead_peers()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_barrier_deadline_raises_peer_timeout(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HEARTBEAT_INTERVAL_S", "0.1")
+    a, b = _pair(_port_base())
+    try:
+        with pytest.raises(PeerTimeoutError):
+            a._recv(1, b"nobody-sends-this", timeout=0.4)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_heartbeats_keep_peer_fresh_and_staleness_trips(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HEARTBEAT_INTERVAL_S", "0.1")
+    monkeypatch.setenv("PATHWAY_HEARTBEAT_TIMEOUT_S", "0.6")
+    a, b = _pair(_port_base())
+    try:
+        time.sleep(0.5)
+        ages = a.heartbeat_ages()
+        assert ages[1] < 0.4, f"beacons not flowing: {ages}"
+
+        # freeze b's beacons (its process is 'alive' but its loops stopped):
+        # a's next wait must trip the staleness bound, typed
+        b._stop.set()
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        with pytest.raises(PeerTimeoutError, match="stale"):
+            a._recv(1, b"x", timeout=30)
+        assert time.monotonic() - t0 < 3
+    finally:
+        a.close()
+        b.close()
+
+
+def test_bounded_inbox_applies_backpressure_without_loss(monkeypatch):
+    monkeypatch.setenv("PATHWAY_EXCHANGE_INBOX_FRAMES", "4")
+    a, b = _pair(_port_base())
+    try:
+        n_frames = 24
+        payloads = {f"t{i}".encode(): bytes([i]) * 100 for i in range(n_frames)}
+        for tag, payload in payloads.items():
+            b._send(0, tag, payload)
+        time.sleep(0.5)
+        with a._cv:
+            buffered = a._inbox_count[1]
+        assert buffered <= 4, f"inbox grew past its bound: {buffered}"
+        # draining releases the parked reader; every frame arrives intact
+        for tag, payload in payloads.items():
+            assert a._recv(1, tag, timeout=10) == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_send_deadline_trips_on_nonreading_peer(monkeypatch):
+    """A peer that stopped reading (wedged userspace, live kernel TCP stack)
+    must surface as a typed error from the SEND side once buffers fill — the
+    recv-side deadlines never fire if sendall hangs first."""
+    monkeypatch.setenv("PATHWAY_BARRIER_TIMEOUT_S", "1")
+    monkeypatch.setenv("PATHWAY_EXCHANGE_INBOX_FRAMES", "1")
+    monkeypatch.setenv("PATHWAY_HEARTBEAT_INTERVAL_S", "0")
+    a, b = _pair(_port_base())
+    try:
+        payload = b"x" * (1 << 20)
+        t0 = time.monotonic()
+        with pytest.raises((PeerTimeoutError, PeerShutdownError)):
+            # b's parked reader (inbox bound 1, nobody recvs) stops draining;
+            # TCP buffers fill and the send deadline must fire, bounded
+            for i in range(256):
+                a._send(1, f"big{i}".encode(), payload)
+        assert time.monotonic() - t0 < 30
+    finally:
+        a.close()
+        b.close()
+
+
+def test_connect_failure_closes_listener_and_raises_typed(monkeypatch):
+    monkeypatch.setenv("PATHWAY_CONNECT_TIMEOUT_S", "0.6")
+    port = _port_base()
+    t0 = time.monotonic()
+    with pytest.raises(PeerTimeoutError):
+        ClusterExchange(2, 0, port)  # peer 1 never comes up
+    assert time.monotonic() - t0 < 10
+    # the failed wiring must not strand the listener fd: the SAME port binds
+    # immediately (a stranded one wedges a retry/restart on EADDRINUSE)
+    probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        probe.bind(("127.0.0.1", port))
+    finally:
+        probe.close()

@@ -33,7 +33,24 @@ PROG = textwrap.dedent(
 
     if kill_pid is not None and int(kill_pid) == pid:
         def _assassin():
-            time.sleep(2.0)
+            # progress-gated, not wall-clock: the kill must land mid-RUN (after
+            # commits + journal frames + supervisor status exist), not during
+            # the multi-second interpreter/jax import window. The per-rank
+            # status file is per-INCARNATION (the supervisor clears it on every
+            # launch and it carries this process's pid), unlike output files
+            # which linger from earlier phases.
+            spath = os.path.join(
+                os.environ["PATHWAY_SUPERVISE_DIR"], f"rank-{pid}.status.json"
+            )
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    if json.load(open(spath))["pid"] == os.getpid():
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            time.sleep(0.5)
             try:
                 # O_EXCL: exactly one kill per marker even across restarts
                 fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -72,7 +89,8 @@ PROG = textwrap.dedent(
 )
 
 
-def _spawn_popen(tmp_path, first_port: int, kill_pid: int | None, marker: str):
+def _spawn_popen(tmp_path, first_port: int, kill_pid: int | None, marker: str,
+                 max_restarts: int = 0):
     env = os.environ.copy()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["PATHWAY_TPU_TEST_DIR"] = str(tmp_path)
@@ -87,6 +105,7 @@ def _spawn_popen(tmp_path, first_port: int, kill_pid: int | None, marker: str):
         [
             sys.executable, "-m", "pathway_tpu.cli", "spawn",
             "-n", "2", "--first-port", str(first_port),
+            "--max-restarts", str(max_restarts),
             sys.executable, str(prog),
         ],
         env=env,
@@ -176,3 +195,63 @@ def test_spawn_kill9_each_process_restart_exact(tmp_path):
         assert merged == expected, f"got {merged}, want {expected}"
     finally:
         _terminate_group(proc)
+
+
+def test_spawn_kill9_single_worker_supervised_failover(tmp_path):
+    """Single-worker failover, ONE spawn invocation: rank 0 SIGKILLs itself
+    mid-run, the supervisor restarts the cluster from the journal, and the
+    merged output converges to the exact totals — no operator in the loop."""
+    (tmp_path / "in").mkdir()
+    first_port = 24000 + os.getpid() % 500 * 4 + 2
+
+    for i in range(4):
+        (tmp_path / "in" / f"a{i}.csv").write_text(
+            "word\n" + "\n".join(["cat"] * (i + 1) + ["dog"] * 2) + "\n"
+        )
+
+    marker = str(tmp_path / "marker-failover")
+    proc = _spawn_popen(tmp_path, first_port, 0, marker, max_restarts=2)
+    err = ""
+    try:
+        # wait for the SIGKILL to actually land, THEN add data only the
+        # restarted cluster can count — converged pre-kill output files linger
+        # on disk, so totals alone cannot prove the failover happened
+        deadline = time.time() + 60
+        while time.time() < deadline and not os.path.exists(marker):
+            if proc.poll() is not None:
+                _, err = proc.communicate()
+                raise AssertionError(
+                    f"supervised spawn exited early (rc={proc.returncode}): {err}"
+                )
+            time.sleep(0.1)
+        assert os.path.exists(marker), "kill thread never fired"
+        (tmp_path / "in" / "late.csv").write_text(
+            "word\n" + "\n".join(["owl"] * 3) + "\n"
+        )
+        expected = {"cat": sum(i + 1 for i in range(4)), "dog": 8, "owl": 3}
+        deadline = time.time() + 120
+        merged: dict = {}
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                _, err = proc.communicate()
+                raise AssertionError(
+                    f"supervised spawn exited early (rc={proc.returncode}): {err}"
+                )
+            merged = _read_merged(tmp_path)
+            if merged == expected:
+                break
+            time.sleep(0.3)
+        assert merged == expected, f"got {merged}, want {expected}"
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        try:
+            _, err = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            _, err = proc.communicate()
+    assert "restarting the cluster" in (err or ""), (
+        f"supervisor never reported the failover restart:\n{err}"
+    )

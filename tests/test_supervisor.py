@@ -1,0 +1,126 @@
+"""Supervisor unit tests: exit-code aggregation, restart policy, heartbeat
+staleness, post-mortem reporting — with tiny no-dependency child programs so
+the supervision logic is exercised without engine startup cost."""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+from pathway_tpu.parallel.supervisor import Supervisor, describe_exit, status_path
+
+CLEAN_PROG = "import sys; sys.exit(0)\n"
+
+# writes its rank status (the shape pw.run publishes), then rank 0 SIGKILLs
+# itself on the FIRST incarnation only — exactly the failover scenario
+CRASH_ONCE_PROG = textwrap.dedent(
+    """
+    import json, os, signal, time
+    d = os.environ["PATHWAY_SUPERVISE_DIR"]
+    rank = int(os.environ["PATHWAY_PROCESS_ID"])
+    persistence = os.environ.get("PW_TEST_PERSISTENCE", "1") == "1"
+    path = os.path.join(d, f"rank-{rank}.status.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump({"pid": os.getpid(), "rank": rank, "commit": 7,
+                   "persistence": persistence, "peers": {}, "ts": time.time()}, f)
+    os.replace(path + ".tmp", path)
+    time.sleep(0.5)  # let every rank publish before the crash
+    if rank == 0 and os.environ.get("PATHWAY_RESTART_COUNT") == "0":
+        os.kill(os.getpid(), signal.SIGKILL)
+    """
+)
+
+WEDGED_PROG = textwrap.dedent(
+    """
+    import json, os, time
+    d = os.environ["PATHWAY_SUPERVISE_DIR"]
+    rank = int(os.environ["PATHWAY_PROCESS_ID"])
+    path = os.path.join(d, f"rank-{rank}.status.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump({"pid": os.getpid(), "rank": rank, "commit": 1,
+                   "persistence": False, "peers": {}, "ts": time.time()}, f)
+    os.replace(path + ".tmp", path)
+    time.sleep(120)  # wedged: status never refreshes, process never exits
+    """
+)
+
+
+def _supervisor(tmp_path, prog_text, *, n=2, max_restarts=0, stale_after=0.0, env=None):
+    prog = tmp_path / "prog.py"
+    prog.write_text(prog_text)
+    env_base = os.environ.copy()
+    env_base.update(env or {})
+    return Supervisor(
+        processes=n,
+        threads=1,
+        first_port=0,  # children here never open the exchange
+        program=sys.executable,
+        arguments=[str(prog)],
+        env_base=env_base,
+        max_restarts=max_restarts,
+        stale_after_s=stale_after,
+        poll_interval_s=0.05,
+    )
+
+
+def test_clean_cluster_exits_zero(tmp_path):
+    sup = _supervisor(tmp_path, CLEAN_PROG)
+    assert sup.run() == 0
+    assert sup.restarts_used == 0
+
+
+def test_crash_with_persistence_restarts_and_succeeds(tmp_path):
+    sup = _supervisor(tmp_path, CRASH_ONCE_PROG, max_restarts=1)
+    assert sup.run() == 0, "restart should have recovered the cluster"
+    assert sup.restarts_used == 1
+
+
+def test_crash_without_persistence_refuses_restart(tmp_path, capsys):
+    sup = _supervisor(
+        tmp_path, CRASH_ONCE_PROG, max_restarts=3, env={"PW_TEST_PERSISTENCE": "0"}
+    )
+    rc = sup.run()
+    assert rc != 0
+    assert sup.restarts_used == 0, "must not restart when the journal can't restore"
+    err = capsys.readouterr().err
+    assert "post-mortem" in err
+    assert "persistence is off" in err
+    assert "killed by signal SIGKILL" in err
+
+
+def test_restart_budget_exhausted_reports_and_fails(tmp_path, capsys):
+    sup = _supervisor(tmp_path, CRASH_ONCE_PROG, max_restarts=0)
+    rc = sup.run()
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "restart budget exhausted" in err
+    assert "last commit 7" in err  # per-rank post-mortem carries progress
+
+
+def test_wedged_rank_detected_by_heartbeat_staleness(tmp_path, capsys):
+    sup = _supervisor(tmp_path, WEDGED_PROG, n=1, stale_after=1.0)
+    rc = sup.run()
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "stale" in err and "wedged" in err
+
+
+def test_startup_wedge_detected_without_any_status(tmp_path, capsys, monkeypatch):
+    """A rank that hangs BEFORE its first commit (no status file ever) is still
+    caught — by the startup grace deadline, not the staleness monitor."""
+    monkeypatch.setenv("PATHWAY_SUPERVISOR_STARTUP_S", "1")
+    sup = _supervisor(tmp_path, "import time; time.sleep(60)\n", n=1)
+    rc = sup.run()
+    assert rc != 0
+    assert "wedged at startup" in capsys.readouterr().err
+
+
+def test_describe_exit_names_signals():
+    assert describe_exit(0) == "exit code 0"
+    assert describe_exit(-9) == "killed by signal SIGKILL"
+    assert describe_exit(None) == "running"
+
+
+def test_status_path_layout(tmp_path):
+    assert status_path(str(tmp_path), 3).endswith("rank-3.status.json")
