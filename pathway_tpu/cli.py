@@ -29,13 +29,16 @@ def _plural(n: int, singular: str, plural: str) -> str:
 
 
 def _spawn_program(
-    *, threads, processes, first_port, program, arguments, env_base, max_restarts=0
+    *, threads, processes, first_port, program, arguments, env_base,
+    max_restarts=0, restart_mode="surgical",
 ):
     """Launch the cluster under the supervisor (``parallel/supervisor.py``):
-    child exit codes and per-rank heartbeat status are monitored; a worker
-    crash either restarts the cluster from the persistence journal
-    (``--max-restarts`` budget, persistence on) or tears everything down with
-    a per-rank post-mortem — never a hang."""
+    child exit codes and per-rank heartbeat status are monitored. On a worker
+    crash the supervisor walks the escalation ladder — surgically relaunch
+    just the dead rank into the live cluster (persistence on, ``--max-restarts``
+    budget, ``--restart-mode surgical``), else restart the whole cluster from
+    the persistence journal, else tear everything down with a per-rank
+    post-mortem — never a hang."""
     from pathway_tpu.parallel.supervisor import Supervisor
 
     processes_str = _plural(processes, "process", "processes")
@@ -49,6 +52,7 @@ def _spawn_program(
         arguments=arguments,
         env_base=env_base,
         max_restarts=max_restarts,
+        restart_mode=restart_mode,
     )
     sys.exit(supervisor.run())
 
@@ -72,13 +76,23 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
     type=int,
     metavar="N",
     default=0,
-    help="restart the whole cluster up to N times after a worker crash, resuming "
-    "from the persistence journal (requires the program to run with a persistence "
+    help="relaunch workers up to N times after a crash, resuming from the "
+    "persistence journal (requires the program to run with a persistence "
     "backend; 0 = fail fast with a post-mortem)",
+)
+@click.option(
+    "--restart-mode",
+    type=click.Choice(["surgical", "all"], case_sensitive=False),
+    default="surgical",
+    help="'surgical' relaunches only the dead rank and rejoins it into the "
+    "live cluster (survivors hold at an epoch fence; falls back to restarting "
+    "the whole cluster when the rejoin itself fails, and finally to a loud "
+    "teardown); 'all' always restarts the whole cluster",
 )
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
-def spawn(threads, processes, first_port, record, record_path, max_restarts, program, arguments):
+def spawn(threads, processes, first_port, record, record_path, max_restarts,
+          restart_mode, program, arguments):
     env = os.environ.copy()
     if record:
         env["PATHWAY_REPLAY_STORAGE"] = record_path
@@ -92,6 +106,7 @@ def spawn(threads, processes, first_port, record, record_path, max_restarts, pro
         arguments=arguments,
         env_base=env,
         max_restarts=max_restarts,
+        restart_mode=restart_mode.lower(),
     )
 
 

@@ -110,6 +110,11 @@ class MonitoringServer:
                     except Exception as exc:  # a probe must never 500 a worker
                         payload = {"error": str(exc)}
                     payload.setdefault("alive", True)
+                    # degraded-cluster observability: the runner reports
+                    # "fencing"/"rejoining" during a surgical restart, plus
+                    # cluster_epoch / restart counts / last-rejoin duration;
+                    # a pre-cluster probe still reads as a running worker
+                    payload.setdefault("state", "running")
                     body = _json.dumps(payload, sort_keys=True).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

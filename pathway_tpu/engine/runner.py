@@ -9,6 +9,7 @@ the reference's alt/neu scheme (``timestamp.rs:20``).
 
 from __future__ import annotations
 
+import os
 import time as time_mod
 from typing import Any, Dict, List, Optional
 
@@ -50,6 +51,13 @@ class GraphRunner:
         self._rank = 0
         self._supervise_dir: Any = None  # PATHWAY_SUPERVISE_DIR (spawn supervisor)
         self._last_status_write = 0.0
+        # surgical single-rank restart (epoch fencing; parallel/cluster.py)
+        self._surgical = False  # PATHWAY_RESTART_MODE=surgical (spawn supervisor)
+        self._rejoin_carry: Dict[int, Delta] = {}  # in-flight inputs saved over a fence
+        self._input_deltas_commit = -1  # commit the current _input_deltas belong to
+        self._rejoins = 0
+        self._last_rejoin_s: "float | None" = None
+        self._rejoin_state = "running"  # "running" | "fencing" | "rejoining"
 
     def state_of(self, node: pg.Node) -> StateTable:
         if node.id not in self._materialized:
@@ -154,6 +162,10 @@ class GraphRunner:
 
         self._supervise_dir = None if self._materialize_all else _os.environ.get(
             "PATHWAY_SUPERVISE_DIR"
+        )
+        self._surgical = (
+            not self._materialize_all
+            and _os.environ.get("PATHWAY_RESTART_MODE") == "surgical"
         )
         if self._cluster is not None:
             bad = sorted(
@@ -315,27 +327,7 @@ class GraphRunner:
             self.evaluators[node.id] = evaluator_cls(node, self)
             columns = node.output.column_names() if node.output is not None else []
             self.states[node.id] = StateTable(columns)
-        shared_threads = self._cluster is not None and getattr(
-            self._cluster, "shared_inputs", False
-        )
-        if self._cluster is not None:
-            for node in self._nodes:
-                ev = self.evaluators[node.id]
-                ev._cluster_policies = tuple(
-                    ev.cluster_input_policy(i) for i in range(len(node.inputs))
-                )
-                # exchange/centralize/broadcast points are lockstep barriers:
-                # they participate in every commit even with no local rows
-                ev._cluster_barrier = node.kind in ("groupby", "join") or any(
-                    p is not None for p in ev._cluster_policies
-                )
-                if shared_threads and isinstance(node, pg.OutputNode):
-                    # transparent-threads mode: sinks live on rank 0 only, so
-                    # every worker ships its output partition to the root —
-                    # callbacks stay single-threaded and see ALL rows, in the
-                    # same per-commit batches a 1-thread run delivers
-                    ev._cluster_policies = tuple("root" for _ in node.inputs)
-                    ev._cluster_barrier = True
+        shared_threads = self._bind_cluster_policies()
         self._sources = [
             (node, self.evaluators[node.id])
             for node in self._nodes
@@ -412,37 +404,7 @@ class GraphRunner:
         from pathway_tpu.internals.config import get_pathway_config
 
         if self._cluster is not None and self._persistence is not None:
-            # Lockstep replay: journals differ after a mid-commit kill (one process
-            # recorded commit N, its peer died first), and a commit with data on
-            # only one process writes a frame only there. Exchange tags carry the
-            # commit id, so every process must replay the UNION of recorded ids at
-            # their ORIGINAL numbering — injecting an empty frame where it has no
-            # local data — or the all-to-all deadlocks. (Reference: timely workers
-            # replay a shared total order of timestamps.)
-            local_frames = {cid: deltas for cid, deltas, _offs in replay_frames}
-            id_lists = self._cluster.allgather(b"replay:ids", sorted(local_frames))
-            all_ids = sorted(set().union(*id_lists))
-            if all_ids and get_pathway_config().persistence_mode == "batch":
-                # batch mode, cluster flavor: collapse every local frame into ONE
-                # replay commit pinned at the globally-last journaled id, so the
-                # single replayed commit carries the same exchange tags everywhere
-                merged: Dict[int, List[Delta]] = {}
-                for deltas in local_frames.values():
-                    for nid, delta in deltas.items():
-                        merged.setdefault(nid, []).append(delta)
-                combined = {
-                    nid: Delta.concat(ds, list(ds[0].columns))
-                    for nid, ds in merged.items()
-                }
-                local_frames = {all_ids[-1]: combined}
-                all_ids = [all_ids[-1]]
-            for cid in all_ids:
-                self._commit = cid
-                self._inject = local_frames.get(cid, {})
-                self.step()
-            self._inject = None
-            if all_ids:
-                self._commit = all_ids[-1] + 1
+            self._cluster_replay(replay_frames)
         else:
             if replay_frames and get_pathway_config().persistence_mode == "batch":
                 # replay the whole recording as ONE commit (reference PersistenceMode::Batch)
@@ -462,6 +424,34 @@ class GraphRunner:
                 # future frame ids must exceed every journaled id (checkpoint subsumption
                 # filters by id)
                 self._commit = max(self._commit, replay_frames[-1][0] + 1)
+
+    def _bind_cluster_policies(self) -> bool:
+        """Stamp every evaluator with its per-input cluster routing policies and
+        barrier participation (re-run after a surgical-rejoin state reset — the
+        fresh evaluators need the same stamps the originals got in setup).
+        Returns the transparent-threads flag."""
+        shared_threads = self._cluster is not None and getattr(
+            self._cluster, "shared_inputs", False
+        )
+        if self._cluster is not None:
+            for node in self._nodes:
+                ev = self.evaluators[node.id]
+                ev._cluster_policies = tuple(
+                    ev.cluster_input_policy(i) for i in range(len(node.inputs))
+                )
+                # exchange/centralize/broadcast points are lockstep barriers:
+                # they participate in every commit even with no local rows
+                ev._cluster_barrier = node.kind in ("groupby", "join") or any(
+                    p is not None for p in ev._cluster_policies
+                )
+                if shared_threads and isinstance(node, pg.OutputNode):
+                    # transparent-threads mode: sinks live on rank 0 only, so
+                    # every worker ships its output partition to the root —
+                    # callbacks stay single-threaded and see ALL rows, in the
+                    # same per-commit batches a 1-thread run delivers
+                    ev._cluster_policies = tuple("root" for _ in node.inputs)
+                    ev._cluster_barrier = True
+        return shared_threads
 
     def _load_checkpoint_state(self, blob: dict) -> None:
         """Restore operator + state-table snapshots (reference operator persistence,
@@ -572,6 +562,46 @@ class GraphRunner:
                 }
             node.config["source"].restore(offsets, state_deltas, tail)
 
+    def _cluster_replay(self, replay_frames: List[tuple]) -> None:
+        """Lockstep journal replay across the cluster: journals differ after a
+        mid-commit kill (one process recorded commit N, its peer died first),
+        and a commit with data on only one process writes a frame only there.
+        Exchange tags carry the commit id, so every process must replay the
+        UNION of recorded ids at their ORIGINAL numbering — injecting an empty
+        frame where it has no local data — or the all-to-all deadlocks.
+        (Reference: timely workers replay a shared total order of timestamps.)
+        Runs at initial setup AND after a surgical-rejoin state reset; either
+        way every rank leaves with the same ``_commit`` counter, so post-replay
+        barrier tags line up."""
+        from pathway_tpu.internals.config import get_pathway_config
+
+        local_frames = {cid: deltas for cid, deltas, _offs in replay_frames}
+        id_lists = self._cluster.allgather(b"replay:ids", sorted(local_frames))
+        all_ids = sorted(set().union(*id_lists))
+        if all_ids and get_pathway_config().persistence_mode == "batch":
+            # batch mode, cluster flavor: collapse every local frame into ONE
+            # replay commit pinned at the globally-last journaled id, so the
+            # single replayed commit carries the same exchange tags everywhere
+            merged: Dict[int, List[Delta]] = {}
+            for deltas in local_frames.values():
+                for nid, delta in deltas.items():
+                    merged.setdefault(nid, []).append(delta)
+            combined = {
+                nid: Delta.concat(ds, list(ds[0].columns))
+                for nid, ds in merged.items()
+            }
+            local_frames = {all_ids[-1]: combined}
+            all_ids = [all_ids[-1]]
+        for cid in all_ids:
+            self._commit = cid
+            self._inject = local_frames.get(cid, {})
+            self.step()
+        self._inject = None
+        # nothing journaled anywhere: every rank aligns at commit 0 (a fenced
+        # survivor may arrive here mid-commit-N; leaving its counter ahead of
+        # the replacement's would skew every post-rejoin barrier tag)
+        self._commit = all_ids[-1] + 1 if all_ids else 0
+
     def step(self) -> bool:
         """Run one commit; returns True if any node produced output.
 
@@ -583,11 +613,26 @@ class GraphRunner:
         deltas without losing genuine data.
         """
         commit_t0 = time_mod.monotonic()
-        if self._chaos is not None:
-            # fault injection: a scheduled kill fires at the commit BOUNDARY —
-            # the previous commit is fully journaled, this one is mid-flight
-            # everywhere else in the cluster (peers block in its barriers)
-            self._chaos.maybe_kill(self._rank, self._commit)
+        if self._inject is None:
+            # fresh drain: these deltas belong to THIS commit (the surgical
+            # fence must only carry over input rows of the interrupted commit,
+            # never re-ingest an earlier, already-journaled batch)
+            self._input_deltas = {}
+            self._input_deltas_commit = self._commit
+        if self._chaos is not None and self._inject is None:
+            # fault injection: a scheduled kill fires at a LIVE commit
+            # boundary — the previous commit is fully journaled, this one is
+            # mid-flight everywhere else in the cluster (peers block in its
+            # barriers). Journal replay (restart-all resume or a fenced
+            # survivor's rollback) must never re-fire a kill, or the schedule
+            # would loop forever.
+            self._chaos.maybe_kill(
+                self._rank,
+                self._commit,
+                epoch=getattr(self._cluster, "epoch", 0)
+                if self._cluster is not None
+                else 0,
+            )
         self.current_time = self._commit * 2  # even data times, as in the reference
         self.draining = self._ready and self.sources_finished()
         any_output = self._substep(neu=False)
@@ -641,21 +686,35 @@ class GraphRunner:
         if self._supervise_dir is not None:
             # liveness for the spawn supervisor: written from THIS loop (not a
             # helper thread) so staleness means the commit loop stopped turning
-            now = time_mod.monotonic()
-            if now - self._last_status_write >= 0.25:
-                from pathway_tpu.parallel.supervisor import write_status
-
-                health = self.health()
-                write_status(
-                    self._supervise_dir,
-                    self._rank,
-                    commit=self._commit,
-                    persistence=self._persistence is not None,
-                    peers=health["peers"],
-                )
-                self._last_status_write = now
+            self._publish_status()
         self._commit += 1
         return any_output
+
+    def _publish_status(self, force: bool = False) -> None:
+        """Atomically publish this rank's liveness record for the supervisor
+        (throttled; ``force`` bypasses the throttle — the fence path publishes
+        on every poll so a quiesced-but-healthy survivor is never shot for
+        staleness, and so operators can watch the rejoin progress)."""
+        if self._supervise_dir is None:
+            return
+        now = time_mod.monotonic()
+        if not force and now - self._last_status_write < 0.25:
+            return
+        from pathway_tpu.parallel.supervisor import write_status
+
+        health = self.health()
+        write_status(
+            self._supervise_dir,
+            self._rank,
+            commit=self._commit,
+            persistence=self._persistence is not None,
+            peers=health["peers"],
+            epoch=health["epoch"],
+            state=health["state"],
+            restarts=health["restarts"],
+            last_rejoin_s=health["last_rejoin_s"],
+        )
+        self._last_status_write = now
 
     def _substep(self, *, neu: bool) -> bool:
         if not neu:
@@ -689,6 +748,18 @@ class GraphRunner:
                     )
                 else:
                     delta = evaluator.process([])
+                    carry = self._rejoin_carry.pop(node.id, None)
+                    if carry is not None and len(carry):
+                        # input rows drained by the commit a fence interrupted,
+                        # never journaled: re-ingest them exactly once with the
+                        # first post-rejoin batch (they journal normally now)
+                        delta = (
+                            Delta.concat(
+                                [carry, delta], self.output_columns_of(node)
+                            )
+                            if len(delta)
+                            else carry
+                        )
                 if not neu:
                     self._input_deltas[node.id] = delta
                 if self._cluster is not None and getattr(
@@ -738,7 +809,17 @@ class GraphRunner:
                             delta = evaluator.process(inputs)
                         except Exception as exc:
                             from pathway_tpu.internals.trace import add_error_context
+                            from pathway_tpu.parallel.cluster import (
+                                PeerShutdownError,
+                                PeerTimeoutError,
+                            )
 
+                            if isinstance(exc, (PeerShutdownError, PeerTimeoutError)):
+                                # a peer death inside this node's exchange is an
+                                # infrastructure failure, not an operator bug:
+                                # keep it TYPED so the surgical-rejoin fence (and
+                                # isinstance-based failure triage) can catch it
+                                raise
                             raise add_error_context(exc, node) from exc
                 if neu and len(delta):
                     delta.neu = True
@@ -795,7 +876,142 @@ class GraphRunner:
             "persistence": self._persistence is not None,
             "peers": peers,
             "dead_peers": dead,
+            # surgical-restart observability: which mesh incarnation this rank
+            # is on, how often it (or its cluster) was relaunched, and whether
+            # it is currently quiesced at an epoch fence
+            "epoch": getattr(self._cluster, "epoch", 0)
+            if self._cluster is not None
+            else 0,
+            "restarts": int(os.environ.get("PATHWAY_RESTART_COUNT", "0") or 0),
+            "rejoins": self._rejoins,
+            "last_rejoin_s": self._last_rejoin_s,
+            "state": self._rejoin_state,
         }
+
+    # -- surgical single-rank restart (epoch fence; parallel/cluster.py) -------
+
+    def _surgical_rejoin(self, exc: BaseException) -> bool:
+        """Recover from a typed peer failure without dying: quiesce at the
+        epoch fence, wait for the supervisor's replacement rank to re-dial,
+        roll this rank's operator state back to its own journal shard, and
+        lockstep-replay the union of journaled commit ids so every rank —
+        survivors and replacement alike — converges on the last cluster-wide
+        committed state. Output stays bit-identical to a failure-free run: the
+        interrupted commit's drained-but-unjournaled input rows are carried
+        across the fence and re-ingested exactly once.
+
+        Returns False when surgical recovery is off or impossible — no
+        persistence journal (nothing to roll back to: refused loudly, the
+        caller re-raises the typed error within the barrier deadline), a
+        thread-mode exchange, replay in progress — or when the fence itself
+        fails (second death, no replacement in time): the caller re-raises and
+        the supervisor escalates to restart-all, then loud teardown."""
+        cluster = self._cluster
+        if (
+            not self._surgical
+            or cluster is None
+            or not getattr(cluster, "supports_rejoin", False)
+            or self._supervise_dir is None
+            or self._persistence is None
+            or self._inject is not None
+        ):
+            return False
+        import logging
+
+        log = logging.getLogger("pathway_tpu")
+        t0 = time_mod.monotonic()
+        self._rejoin_state = "fencing"
+        log.warning(
+            "rank %d: peer failure at commit %d (%s); quiescing at the epoch "
+            "fence for a surgical rejoin",
+            self._rank,
+            self._commit,
+            exc,
+        )
+        # preserve the interrupted commit's drained input rows IFF its journal
+        # frame never made it to disk — journaled rows replay from the journal,
+        # carrying them too would double-ingest
+        if (
+            self._input_deltas_commit == self._commit
+            and getattr(self._persistence, "last_commit_id", None) != self._commit
+        ):
+            for nid, delta in self._input_deltas.items():
+                if len(delta):
+                    prev = self._rejoin_carry.get(nid)
+                    self._rejoin_carry[nid] = (
+                        Delta.concat([prev, delta], list(delta.columns.keys()))
+                        if prev is not None and len(prev)
+                        else delta
+                    )
+            for node, _ in self._sources:
+                rewind = getattr(node.config["source"], "rewind_frame_state", None)
+                if rewind is not None:
+                    # segment markers drained by the aborted commit re-ride the
+                    # next journaled frame
+                    rewind()
+        from pathway_tpu.parallel.cluster import PeerShutdownError, PeerTimeoutError
+
+        try:
+            cluster.begin_fence()
+            cluster.await_rejoin(on_wait=lambda: self._publish_status(force=True))
+        except (PeerShutdownError, PeerTimeoutError, OSError) as fence_exc:
+            self._rejoin_state = "running"
+            log.error(
+                "rank %d: surgical rejoin failed (%s); dying typed so the "
+                "supervisor can degrade to restart-all or tear down",
+                self._rank,
+                fence_exc,
+            )
+            return False
+        self._rejoin_state = "rejoining"
+        self._publish_status(force=True)
+        # the interrupted commit left partially-applied operator state (and
+        # evaluator internals) that cannot be unwound in place: rebuild from
+        # this rank's own journal shard, exactly like a relaunched process —
+        # minus the process launch, the imports, and the source re-scan
+        self._reset_operator_state()
+        frames = self._persistence.reload(self._graph_sig)
+        was_ready, self._ready = self._ready, False  # replay parity with setup
+        try:
+            self._cluster_replay(frames)
+        finally:
+            self._ready = was_ready
+        self._rejoins += 1
+        self._last_rejoin_s = time_mod.monotonic() - t0
+        self._rejoin_state = "running"
+        self._publish_status(force=True)
+        log.warning(
+            "rank %d: rejoined the cluster at epoch %d in %.2fs (resuming at "
+            "commit %d)",
+            self._rank,
+            getattr(cluster, "epoch", 0),
+            self._last_rejoin_s,
+            self._commit,
+        )
+        return True
+
+    def _reset_operator_state(self) -> None:
+        """Discard every evaluator and state table and rebuild them pristine
+        from the graph (the rejoin rollback: in-memory state from the
+        interrupted epoch is unrecoverable once a commit half-applied).
+        Sources are NOT reset — a survivor's connectors are live and correctly
+        positioned; everything they ever emitted is either journaled (replays)
+        or carried in ``_rejoin_carry``."""
+        from pathway_tpu.engine.evaluators import EVALUATORS
+
+        self.evaluators = {}
+        self.states = {}
+        for node in self._nodes:
+            self.evaluators[node.id] = EVALUATORS[type(node)](node, self)
+            columns = node.output.column_names() if node.output is not None else []
+            self.states[node.id] = StateTable(columns)
+        self._bind_cluster_policies()
+        self._sources = [(node, self.evaluators[node.id]) for node, _ in self._sources]
+        self._materialized = self._compute_materialized()
+        self._substep_deltas = {}
+        self._input_deltas = {}
+        self._input_deltas_commit = -1
+        self._step_counts = {}
 
     def output_columns_of(self, node: pg.Node) -> List[str]:
         return node.output.column_names() if node.output is not None else []
@@ -1008,12 +1224,23 @@ class GraphRunner:
 
         wake = _threading.Event()
         StreamingDataSource.register_runner(wake)
+        from pathway_tpu.parallel.cluster import PeerShutdownError, PeerTimeoutError
+
         commits = 0
         try:
             with span("graph_runner.run"):
                 while True:
                     wake.clear()
-                    any_output = self.step()
+                    try:
+                        any_output = self.step()
+                    except (PeerShutdownError, PeerTimeoutError) as exc:
+                        # a peer died mid-commit: with surgical mode on, quiesce
+                        # at the epoch fence, take the relaunched rank back in,
+                        # roll back the interrupted commit, and keep running —
+                        # otherwise die typed (PR 2 restart-all/teardown)
+                        if self._surgical_rejoin(exc):
+                            continue
+                        raise
                     commits += 1
                     if max_commits is not None and commits >= max_commits:
                         break
@@ -1031,11 +1258,15 @@ class GraphRunner:
                     if self._cluster is not None:
                         # lockstep shutdown: stop only when EVERY process drained
                         # (a peer's data may still route rows to us)
-                        if all(
-                            self._cluster.allgather(
+                        try:
+                            done_votes = self._cluster.allgather(
                                 f"done:{self._commit}".encode(), local_done
                             )
-                        ):
+                        except (PeerShutdownError, PeerTimeoutError) as exc:
+                            if self._surgical_rejoin(exc):
+                                continue
+                            raise
+                        if all(done_votes):
                             break
                         if not any_output:
                             # keep stepping (peers may exchange into us), but pace

@@ -20,14 +20,22 @@ Environment contract::
         {"kill":   [{"rank": 0, "commit": 3, "run": 0}],
          "frames": {"drop_prob": 0.0, "delay_prob": 0.0, "delay_ms": 10,
                     "truncate_prob": 0.0},
+         "rejoin": [{"rank": 0, "run": 1}],
          "backend": {"put_error_prob": 0.5, "max_errors": 4}}
 
 ``run`` in a kill entry matches ``PATHWAY_RESTART_COUNT`` (set by the
 supervisor, 0 for a first launch), so a kill fires once and the restarted
-cluster survives the replayed schedule. Determinism comes from per-stream
-``random.Random`` instances keyed ``seed:kind:rank:peer`` — the Nth draw on a
-stream is a pure function of the seed and N, never of wall clock or other
-streams.
+cluster survives the replayed schedule; an optional ``epoch`` field further
+gates the kill on the live cluster epoch (surgical-restart protocol testing:
+kill-one-rank-at-commit-N-in-epoch-E). ``rejoin`` entries drop a relaunched
+rank's rejoin handshake (``ClusterExchange._connect_rejoin`` consults
+:meth:`Chaos.drop_rejoin`), deterministically forcing the surgical →
+restart-all escalation; ``run`` there matches the REPLACEMENT's restart count
+when present (omitted = every surgical attempt for that rank is dropped —
+each attempt is a fresh process, so ``run`` is the only cross-attempt key).
+Determinism comes from per-stream ``random.Random``
+instances keyed ``seed:kind:rank:peer`` — the Nth draw on a stream is a pure
+function of the seed and N, never of wall clock or other streams.
 
 With neither env var set, :func:`get_chaos` returns ``None`` and every hook is
 a no-op attribute check on the caller's side — zero overhead in production.
@@ -71,6 +79,9 @@ class Chaos:
         self.run_count = int(os.environ.get("PATHWAY_RESTART_COUNT", "0") or 0)
         self._kills: List[Dict[str, Any]] = list(plan.get("kill") or [])
         self._frames: Dict[str, Any] = dict(plan.get("frames") or {})
+        self._rejoins: List[Dict[str, Any]] = [
+            dict(e) for e in (plan.get("rejoin") or [])
+        ]
         self._backend: Dict[str, Any] = dict(plan.get("backend") or {})
         self._streams: Dict[str, random.Random] = {}
         self._backend_errors_left = int(self._backend.get("max_errors", 3))
@@ -80,6 +91,7 @@ class Chaos:
             "frames_dropped": 0,
             "frames_delayed": 0,
             "frames_truncated": 0,
+            "rejoins_dropped": 0,
             "backend_errors": 0,
         }
 
@@ -95,17 +107,45 @@ class Chaos:
 
     # -- worker kills ---------------------------------------------------------
 
-    def maybe_kill(self, rank: int, commit_id: int) -> None:
+    def maybe_kill(self, rank: int, commit_id: int, epoch: int = 0) -> None:
         """SIGKILL this process if the plan schedules a kill at (rank, commit)
-        for the current run (restart) count. Called at every commit boundary."""
+        for the current run (restart) count — and, when the entry carries an
+        ``epoch`` field, only in that cluster epoch (kill-one-rank-at-commit-N
+        schedules that target a specific incarnation of the mesh). Called at
+        every LIVE commit boundary; journal replay never re-fires a kill."""
         for entry in self._kills:
+            want_epoch = entry.get("epoch")
             if (
                 int(entry.get("rank", -1)) == rank
                 and int(entry.get("commit", -1)) == commit_id
                 and int(entry.get("run", 0)) == self.run_count
+                and (want_epoch is None or int(want_epoch) == int(epoch))
             ):
                 self.stats["kills"] += 1
                 os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- rejoin handshakes -----------------------------------------------------
+
+    def drop_rejoin(self, rank: int) -> bool:
+        """True when the plan schedules this relaunched rank's rejoin handshake
+        to be dropped (the replacement's hello never reaches the survivors, so
+        its wiring fails typed and the supervisor degrades to restart-all).
+
+        Every replacement is a FRESH process that rebuilds this harness from
+        the env, so cross-attempt gating must key on ``run`` (the
+        replacement's ``PATHWAY_RESTART_COUNT`` — each escalation attempt has
+        a distinct one), not on in-process counters. An entry without ``run``
+        drops EVERY surgical attempt for that rank; recovery still terminates
+        because the restart-all fallback never consults this schedule."""
+        for entry in self._rejoins:
+            if int(entry.get("rank", -1)) != rank:
+                continue
+            want_run = entry.get("run")
+            if want_run is not None and int(want_run) != self.run_count:
+                continue
+            self.stats["rejoins_dropped"] += 1
+            return True
+        return False
 
     # -- exchange frames -------------------------------------------------------
 

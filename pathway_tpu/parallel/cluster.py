@@ -19,13 +19,14 @@ Environment contract (set by ``pathway_tpu spawn``): ``PATHWAY_PROCESSES``,
 
 from __future__ import annotations
 
+import os
 import pickle
 import random
 import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -34,6 +35,13 @@ from pathway_tpu.internals.config import env_float as _env_float
 # control frame: liveness beacon, never enters the inbox (and never counts
 # toward the chaos harness's per-peer data-frame streams)
 HEARTBEAT_TAG = b"\x00hb"
+# control frame: "these ranks died — quiesce at the epoch fence" (payload is a
+# pickled sorted list of dead ranks; exempt from chaos like heartbeats so the
+# recovery protocol itself stays deterministic under frame-fault plans)
+FENCE_TAG = b"\x00fence"
+# rejoin hello, sent by a relaunched rank dialing back into a live cluster:
+# magic + rank(4, little) + epoch(4, little)
+_REJOIN_MAGIC = b"PWRJ"
 
 
 class ClusterExchange:
@@ -61,15 +69,33 @@ class ClusterExchange:
     - ``PATHWAY_EXCHANGE_INBOX_FRAMES`` — per-peer inbox bound (default 1024);
       a full inbox parks the reader thread (TCP backpressure), it never grows
       without bound when one process runs ahead of its peers.
+
+    Epoch fencing (surgical single-rank restart): every frame header carries
+    the cluster epoch (``PATHWAY_CLUSTER_EPOCH``, bumped by the supervisor on
+    every relaunch). When a rank dies, survivors broadcast a ``FENCE`` control
+    frame, abort their in-flight barriers with :class:`ClusterFenceError`, and
+    quiesce in :meth:`await_rejoin`; the supervisor relaunches ONLY the dead
+    rank with ``PATHWAY_CLUSTER_REJOIN=1`` and the next epoch, and that
+    replacement dials back into every survivor's still-open listener. On
+    install the survivors adopt the new epoch and drop every stale-epoch data
+    frame (in the inbox and still in flight on the wire) instead of letting it
+    corrupt post-rejoin barriers that reuse the same commit tags. Knobs:
+    ``PATHWAY_FENCE_TIMEOUT_S`` — how long a fenced survivor waits for the
+    replacement to re-dial before giving up typed (default 180).
     """
 
-    _HDR = struct.Struct("<II")  # tag_len, payload_len
+    _HDR = struct.Struct("<III")  # tag_len, payload_len, cluster_epoch
+
+    #: real socket mesh supports the fence/rejoin protocol (the in-process
+    #: ThreadExchange does not — a thread peer cannot be relaunched)
+    supports_rejoin = True
 
     def __init__(self, n_processes: int, process_id: int, first_port: int):
         self.n = n_processes
         self.me = process_id
         self.first_port = first_port
         self._conns: Dict[int, socket.socket] = {}
+        self._conn_gen: Dict[int, int] = {}  # bumped when a peer link is replaced
         self._send_locks: Dict[int, threading.Lock] = {}
         self._inbox: Dict[tuple, bytes] = {}  # (peer, tag) -> payload
         self._inbox_count: Dict[int, int] = {}  # buffered frames per peer
@@ -79,35 +105,64 @@ class ClusterExchange:
         self._last_heard: Dict[int, float] = {}
         self._listener: Optional[socket.socket] = None
         self._stop = threading.Event()
+        self.epoch = max(0, int(_env_float("PATHWAY_CLUSTER_EPOCH", 0)))
+        self._rejoin_mode = os.environ.get("PATHWAY_CLUSTER_REJOIN") == "1"
+        self._pending_rejoin: Dict[int, tuple] = {}  # rank -> (socket, epoch)
+        self._fence_dead: "set[int]" = set()  # ranks peers told us died
+        self._fence_pending = False
+        # frames from an epoch we have not adopted YET: a survivor that
+        # installed the rejoin first may talk to us before our own install
+        # (parked here, delivered at install — dropping them would wedge the
+        # post-rejoin replay until the barrier deadline)
+        self._future_inbox: Dict[tuple, tuple] = {}  # (peer, tag) -> (payload, epoch)
+        self.stale_frames_dropped = 0
         self.barrier_timeout_s = _env_float("PATHWAY_BARRIER_TIMEOUT_S", 300.0)
         self.heartbeat_interval_s = _env_float("PATHWAY_HEARTBEAT_INTERVAL_S", 1.0)
         self.heartbeat_timeout_s = _env_float("PATHWAY_HEARTBEAT_TIMEOUT_S", 60.0)
+        self.fence_timeout_s = _env_float("PATHWAY_FENCE_TIMEOUT_S", 180.0)
         self._inbox_limit = max(
             1, int(_env_float("PATHWAY_EXCHANGE_INBOX_FRAMES", 1024))
         )
         from pathway_tpu.internals.chaos import get_chaos
 
         self._chaos = get_chaos()
-        self._connect_all()
+        if self._rejoin_mode and self.n > 1:
+            self._connect_rejoin()
+        else:
+            self._connect_all()
         now = time.monotonic()
         for peer in self._conns:
             self._last_heard[peer] = now
             self._inbox_count[peer] = 0
+            self._conn_gen[peer] = 0
         for peer, conn in self._conns.items():
-            t = threading.Thread(
-                target=self._reader, args=(peer, conn), daemon=True,
-                name=f"pathway:cluster-rx-{peer}",
-            )
-            t.start()
+            self._start_reader(peer, conn)
         if self.heartbeat_interval_s > 0:
             # one beacon thread PER PEER: a send stalled on one backpressured
             # link (full socket buffer) must not starve beacons to the others —
             # that would read as a false cluster-wide wedge
             for peer in self._conns:
-                threading.Thread(
-                    target=self._heartbeat_loop, args=(peer,), daemon=True,
-                    name=f"pathway:cluster-hb-{peer}",
-                ).start()
+                self._start_heartbeat(peer)
+        # the listener stays open for the cluster's lifetime: a surgically
+        # relaunched rank rejoins by dialing it (parked until the engine
+        # reaches the fence and installs the link)
+        if self._listener is not None:
+            threading.Thread(
+                target=self._rejoin_acceptor, daemon=True,
+                name="pathway:cluster-rejoin-accept",
+            ).start()
+
+    def _start_reader(self, peer: int, conn: socket.socket) -> None:
+        threading.Thread(
+            target=self._reader, args=(peer, conn), daemon=True,
+            name=f"pathway:cluster-rx-{peer}",
+        ).start()
+
+    def _start_heartbeat(self, peer: int) -> None:
+        threading.Thread(
+            target=self._heartbeat_loop, args=(peer, self._conn_gen.get(peer, 0)),
+            daemon=True, name=f"pathway:cluster-hb-{peer}",
+        ).start()
 
     # -- wiring --------------------------------------------------------------
 
@@ -134,36 +189,9 @@ class ClusterExchange:
         acceptor.start()
         connect_budget = _env_float("PATHWAY_CONNECT_TIMEOUT_S", 60.0)
         try:
-            # dial every higher-ranked peer, with exponential backoff + jitter:
-            # peers may not be up yet, and N processes hammering one listener at
-            # a fixed 50 ms period synchronize into accept-queue bursts
             rng = random.Random((self.me << 16) ^ self.first_port)
             for peer in range(self.me + 1, self.n):
-                deadline = time.monotonic() + connect_budget
-                delay = 0.05
-                while True:
-                    try:
-                        s = socket.create_connection(
-                            ("127.0.0.1", self.first_port + peer), timeout=5
-                        )
-                        break
-                    except OSError:
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            raise PeerTimeoutError(
-                                f"cluster process {self.me} could not reach peer "
-                                f"{peer} on port {self.first_port + peer} within "
-                                f"{connect_budget:.0f}s"
-                            )
-                        time.sleep(
-                            min(remaining, delay * (1.0 + 0.25 * rng.random()))
-                        )
-                        delay = min(delay * 2, 2.0)
-                # back to fully blocking: create_connection's dial timeout must
-                # not linger on the socket, or every later sendall/recv on this
-                # link spuriously times out after 5s of quiet (SO_SNDTIMEO and
-                # the recv-side deadlines own timeout behavior from here on)
-                s.settimeout(None)
+                s = self._dial_peer(peer, connect_budget, rng)
                 s.sendall(self.me.to_bytes(4, "little"))
                 self._conns[peer] = s
             acceptor.join(timeout=connect_budget)
@@ -197,23 +225,157 @@ class ClusterExchange:
             raise
         self._conns.update(accepted)
         for peer, conn in self._conns.items():
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            if self.barrier_timeout_s > 0:
-                # send-side deadline (SO_SNDTIMEO is send-ONLY, so the reader
-                # thread's blocking recv is untouched): a peer that stopped
-                # reading must surface as a typed error from _send, not hang
-                # sendall forever once the TCP buffers fill — _recv's deadlines
-                # can't fire if we never get there
-                conn.setsockopt(
-                    socket.SOL_SOCKET,
-                    socket.SO_SNDTIMEO,
-                    struct.pack(
-                        "ll",
-                        int(self.barrier_timeout_s),
-                        int(self.barrier_timeout_s % 1 * 1_000_000),
-                    ),
-                )
+            self._tune_socket(conn)
             self._send_locks[peer] = threading.Lock()
+
+    def _dial_peer(
+        self, peer: int, connect_budget: float, rng: random.Random
+    ) -> socket.socket:
+        """Dial one peer with exponential backoff + jitter: the peer may not be
+        up yet, and N processes hammering one listener at a fixed 50 ms period
+        synchronize into accept-queue bursts. Raises :class:`PeerTimeoutError`
+        past the budget."""
+        deadline = time.monotonic() + connect_budget
+        delay = 0.05
+        while True:
+            try:
+                s = socket.create_connection(
+                    ("127.0.0.1", self.first_port + peer), timeout=5
+                )
+                break
+            except OSError:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise PeerTimeoutError(
+                        f"cluster process {self.me} could not reach peer "
+                        f"{peer} on port {self.first_port + peer} within "
+                        f"{connect_budget:.0f}s"
+                    )
+                time.sleep(min(remaining, delay * (1.0 + 0.25 * rng.random())))
+                delay = min(delay * 2, 2.0)
+        # back to fully blocking: create_connection's dial timeout must not
+        # linger on the socket, or every later sendall/recv on this link
+        # spuriously times out after 5s of quiet (SO_SNDTIMEO and the
+        # recv-side deadlines own timeout behavior from here on)
+        s.settimeout(None)
+        return s
+
+    def _tune_socket(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self.barrier_timeout_s > 0:
+            # send-side deadline (SO_SNDTIMEO is send-ONLY, so the reader
+            # thread's blocking recv is untouched): a peer that stopped
+            # reading must surface as a typed error from _send, not hang
+            # sendall forever once the TCP buffers fill — _recv's deadlines
+            # can't fire if we never get there
+            conn.setsockopt(
+                socket.SOL_SOCKET,
+                socket.SO_SNDTIMEO,
+                struct.pack(
+                    "ll",
+                    int(self.barrier_timeout_s),
+                    int(self.barrier_timeout_s % 1 * 1_000_000),
+                ),
+            )
+
+    def _connect_rejoin(self) -> None:
+        """Relaunched-rank wiring: dial EVERY survivor's still-open listener and
+        introduce ourselves with the rejoin hello (rank + new epoch). The
+        survivors' acceptor threads park the links until their engines reach
+        the epoch fence and install them — no accept phase on our side."""
+        if self._chaos is not None and self._chaos.drop_rejoin(self.me):
+            # deterministic fault injection: the rejoin handshake is "lost".
+            # Failing the wiring loudly (instead of silently half-joining)
+            # exercises the surgical -> restart-all escalation in the supervisor.
+            raise PeerTimeoutError(
+                f"chaos: rejoin handshake of rank {self.me} (epoch {self.epoch}) "
+                "dropped by plan"
+            )
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", self.first_port + self.me))
+        listener.listen(self.n)
+        self._listener = listener
+        connect_budget = _env_float("PATHWAY_CONNECT_TIMEOUT_S", 60.0)
+        hello = (
+            _REJOIN_MAGIC
+            + self.me.to_bytes(4, "little")
+            + (self.epoch & 0xFFFFFFFF).to_bytes(4, "little")
+        )
+        rng = random.Random((self.me << 16) ^ self.first_port ^ self.epoch)
+        try:
+            for peer in range(self.n):
+                if peer == self.me:
+                    continue
+                # a second dead rank (double failure) makes a survivor
+                # unreachable: _dial_peer's typed timeout fails the rejoin
+                # loudly so the supervisor degrades to restart-all
+                s = self._dial_peer(peer, connect_budget, rng)
+                s.sendall(hello)
+                self._conns[peer] = s
+        except BaseException:
+            for s in list(self._conns.values()):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            try:
+                listener.close()
+            except OSError:
+                pass
+            self._listener = None
+            raise
+        for peer, conn in self._conns.items():
+            self._tune_socket(conn)
+            self._send_locks[peer] = threading.Lock()
+
+    def _rejoin_acceptor(self) -> None:
+        """Post-wiring accept loop: park dial-ins from relaunched ranks until
+        the engine's fence path installs them (``await_rejoin``). Runs for the
+        exchange's lifetime; exits when the listener closes."""
+        listener = self._listener
+        while not self._closed:
+            try:
+                conn, _addr = listener.accept()
+            except OSError:
+                return  # listener closed (teardown)
+            try:
+                conn.settimeout(10.0)
+                hello = self._recv_exact(conn, len(_REJOIN_MAGIC) + 8)
+                conn.settimeout(None)
+            except (ConnectionError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            rank = int.from_bytes(hello[4:8], "little")
+            epoch = int.from_bytes(hello[8:12], "little")
+            stale_conn: Optional[socket.socket] = None
+            with self._cv:
+                ok = (
+                    not self._closed
+                    and hello.startswith(_REJOIN_MAGIC)
+                    and 0 <= rank < self.n
+                    and rank != self.me
+                    # stale-epoch rejoins (a zombie replacement from an
+                    # abandoned attempt) are refused, not installed
+                    and epoch > self.epoch
+                )
+                if ok:
+                    old = self._pending_rejoin.pop(rank, None)
+                    if old is not None:
+                        stale_conn = old[0]
+                    self._pending_rejoin[rank] = (conn, epoch)
+                    self._cv.notify_all()
+            if not ok:
+                stale_conn = conn
+            if stale_conn is not None:
+                try:
+                    stale_conn.close()
+                except OSError:
+                    pass
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -229,13 +391,25 @@ class ClusterExchange:
         try:
             while True:
                 hdr = self._recv_exact(conn, self._HDR.size)
-                tag_len, payload_len = self._HDR.unpack(hdr)
+                tag_len, payload_len, frame_epoch = self._HDR.unpack(hdr)
                 tag = self._recv_exact(conn, tag_len)
                 payload = self._recv_exact(conn, payload_len) if payload_len else b""
                 with self._cv:
                     self._last_heard[peer] = time.monotonic()
                     if tag == HEARTBEAT_TAG:
+                        # beacons prove liveness whatever the epoch — a peer
+                        # mid-fence is alive, not stale
                         self._cv.notify_all()
+                        continue
+                    if tag == FENCE_TAG:
+                        if frame_epoch >= self.epoch:
+                            try:
+                                ranks = pickle.loads(payload)
+                            except Exception:
+                                ranks = []
+                            self._fence_dead.update(int(r) for r in ranks)
+                            self._fence_pending = True
+                            self._cv.notify_all()
                         continue
                     # bounded inbox: park until the consumer drains (the unread
                     # backlog itself proves the peer is alive, so keep the
@@ -244,23 +418,49 @@ class ClusterExchange:
                     while (
                         self._inbox_count[peer] >= self._inbox_limit
                         and not self._closed
+                        and frame_epoch >= self.epoch
                     ):
                         self._last_heard[peer] = time.monotonic()
                         self._cv.wait(timeout=0.2)
                     if self._closed:
                         return
+                    if frame_epoch < self.epoch:
+                        # stale-epoch data frame (sent before the sender
+                        # fenced): DROPPED, never delivered — post-rejoin
+                        # barriers replay the same commit tags, and a stale
+                        # payload under a reused tag would silently corrupt
+                        # them
+                        self.stale_frames_dropped += 1
+                        continue
+                    if frame_epoch > self.epoch:
+                        # a peer that installed the rejoin BEFORE us is already
+                        # talking at the new epoch: park the frame (it still
+                        # counts toward the inbox bound) and deliver it when
+                        # our own install adopts that epoch — dropping it would
+                        # lose a barrier part nobody retransmits
+                        self._future_inbox[(peer, tag)] = (payload, frame_epoch)
+                        self._inbox_count[peer] += 1
+                        self._cv.notify_all()
+                        continue
                     self._inbox[(peer, tag)] = payload
                     self._inbox_count[peer] += 1
                     self._cv.notify_all()
         except (ConnectionError, OSError) as exc:
             with self._cv:
-                self._dead.setdefault(peer, str(exc) or type(exc).__name__)
+                # a replaced link (rejoin installed a fresh socket for this
+                # peer) dying late must not re-mark the NEW link dead
+                if self._conns.get(peer) is conn:
+                    self._dead.setdefault(peer, str(exc) or type(exc).__name__)
                 self._cv.notify_all()
 
     def _send(self, peer: int, tag: bytes, payload: bytes) -> None:
         conn = self._conns[peer]
-        frame = self._HDR.pack(len(tag), len(payload)) + tag + payload
-        if self._chaos is not None and tag != HEARTBEAT_TAG:
+        frame = (
+            self._HDR.pack(len(tag), len(payload), self.epoch & 0xFFFFFFFF)
+            + tag
+            + payload
+        )
+        if self._chaos is not None and tag not in (HEARTBEAT_TAG, FENCE_TAG):
             action = self._chaos.frame_action(self.me, peer)
             if action.kind == "drop":
                 return  # peer's barrier deadline turns this into PeerTimeoutError
@@ -286,7 +486,11 @@ class ClusterExchange:
             with self._cv:
                 # the stream may have a torn partial frame on it now — the
                 # link is unusable either way, so the peer is dead to us
-                self._dead.setdefault(peer, str(exc) or type(exc).__name__)
+                # (unless the link was already replaced by a rejoin: a stale
+                # heartbeat thread failing on the OLD socket must not poison
+                # the freshly installed one)
+                if self._conns.get(peer) is conn:
+                    self._dead.setdefault(peer, str(exc) or type(exc).__name__)
                 self._cv.notify_all()
             if timed_out:
                 raise PeerTimeoutError(
@@ -305,6 +509,12 @@ class ClusterExchange:
         deadline = time.monotonic() + timeout
         with self._cv:
             while (peer, tag) not in self._inbox:
+                if self._fence_pending:
+                    raise ClusterFenceError(
+                        f"cluster peer requested an epoch fence (ranks "
+                        f"{sorted(self._fence_dead)} died) while process "
+                        f"{self.me} waited for {tag!r} at epoch {self.epoch}"
+                    )
                 if peer in self._dead:
                     raise PeerShutdownError(
                         f"cluster peer {peer} disconnected while process "
@@ -344,9 +554,15 @@ class ClusterExchange:
 
     # -- liveness -------------------------------------------------------------
 
-    def _heartbeat_loop(self, peer: int) -> None:
+    def _heartbeat_loop(self, peer: int, gen: int = 0) -> None:
         while not self._stop.wait(self.heartbeat_interval_s):
-            if self._closed or peer in self._dead:
+            if (
+                self._closed
+                or peer in self._dead
+                # the link was replaced by a rejoin; its NEW heartbeat thread
+                # owns the beacons now
+                or self._conn_gen.get(peer, 0) != gen
+            ):
                 return
             try:
                 self._send(peer, HEARTBEAT_TAG, b"")
@@ -364,6 +580,113 @@ class ClusterExchange:
     def dead_peers(self) -> Dict[int, str]:
         with self._cv:
             return dict(self._dead)
+
+    # -- epoch fence / surgical rejoin ----------------------------------------
+
+    def begin_fence(self) -> None:
+        """Tell every live peer this rank observed a death and is quiescing at
+        the epoch fence. Peers abort their in-flight barriers with
+        :class:`ClusterFenceError` within socket latency instead of sitting out
+        the full barrier deadline. Best-effort: a peer whose link also died
+        learns about the fence from its own typed error."""
+        with self._cv:
+            dead = sorted(set(self._dead) | self._fence_dead)
+        payload = pickle.dumps(dead, protocol=pickle.HIGHEST_PROTOCOL)
+        for peer in list(self._conns):
+            if peer in dead:
+                continue
+            try:
+                self._send(peer, FENCE_TAG, payload)
+            except (PeerShutdownError, PeerTimeoutError, OSError):
+                pass
+
+    def await_rejoin(
+        self,
+        timeout: Optional[float] = None,
+        on_wait: "Optional[Callable[[], None]]" = None,
+    ) -> int:
+        """Quiesce at the epoch fence until the supervisor's replacement
+        rank(s) re-dial, then install the new link(s) and adopt their epoch.
+
+        Returns the new cluster epoch. ``on_wait`` (if given) is called every
+        poll interval WITHOUT the exchange lock held — the engine uses it to
+        keep publishing liveness status so the supervisor's staleness monitor
+        doesn't shoot a healthy, fenced survivor. Raises
+        :class:`PeerTimeoutError` when no replacement arrives in time (second
+        failure, exhausted restart budget — the caller escalates)."""
+        if timeout is None:
+            timeout = self.fence_timeout_s
+        deadline = time.monotonic() + timeout
+        while True:
+            installed: Dict[int, tuple] = {}
+            old_conns: List[socket.socket] = []
+            with self._cv:
+                waiting = (set(self._dead) | self._fence_dead) - set(
+                    self._pending_rejoin
+                )
+                if not waiting and self._pending_rejoin:
+                    installed = self._pending_rejoin
+                    self._pending_rejoin = {}
+                    new_epoch = max(e for (_c, e) in installed.values())
+                    for rank, (conn, _e) in installed.items():
+                        old = self._conns.get(rank)
+                        if old is not None and old is not conn:
+                            old_conns.append(old)
+                        self._conns[rank] = conn
+                        self._conn_gen[rank] = self._conn_gen.get(rank, 0) + 1
+                        self._dead.pop(rank, None)
+                        self._last_heard[rank] = time.monotonic()
+                    # the aborted epoch's frames must never meet the replayed
+                    # barriers that reuse their tags: purge the whole inbox
+                    # (parked readers wake, re-check the epoch, and drop)
+                    self.stale_frames_dropped += len(self._inbox)
+                    self._inbox.clear()
+                    for p in self._inbox_count:
+                        self._inbox_count[p] = 0
+                    # deliver frames peers already sent at the epoch we are
+                    # adopting (they installed first and raced ahead of us)
+                    future, self._future_inbox = self._future_inbox, {}
+                    for (peer, tag), (payload, ep) in future.items():
+                        if ep == new_epoch and peer in self._conns:
+                            self._inbox[(peer, tag)] = payload
+                            self._inbox_count[peer] = (
+                                self._inbox_count.get(peer, 0) + 1
+                            )
+                        else:
+                            self.stale_frames_dropped += 1
+                    self._fence_dead.clear()
+                    self._fence_pending = False
+                    self.epoch = new_epoch
+                    self._cv.notify_all()
+                elif self._closed:
+                    raise PeerShutdownError(
+                        f"cluster exchange closed while process {self.me} "
+                        "fenced for a rejoin"
+                    )
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise PeerTimeoutError(
+                            f"process {self.me} fenced at epoch {self.epoch} "
+                            f"but no replacement rank re-dialed within "
+                            f"{timeout:.0f}s (waiting on {sorted(waiting)})"
+                        )
+                    self._cv.wait(timeout=min(remaining, 0.25))
+            if installed:
+                for old in old_conns:
+                    try:
+                        old.close()
+                    except OSError:
+                        pass
+                for rank, (conn, _e) in installed.items():
+                    self._tune_socket(conn)
+                    self._send_locks.setdefault(rank, threading.Lock())
+                    self._start_reader(rank, conn)
+                    if self.heartbeat_interval_s > 0:
+                        self._start_heartbeat(rank)
+                return self.epoch
+            if on_wait is not None:
+                on_wait()
 
     # -- collectives ----------------------------------------------------------
 
@@ -388,18 +711,41 @@ class ClusterExchange:
         return out
 
     def close(self) -> None:
+        """Idempotent teardown — safe to call again from the fence path when a
+        rejoin aborts mid-handshake (never double-closes peer sockets, parked
+        rejoin dial-ins, or the listener)."""
         self._stop.set()
         with self._cv:
+            if self._closed:
+                return
             self._closed = True
+            pending = list(self._pending_rejoin.values())
+            self._pending_rejoin = {}
+            conns = list(self._conns.values())
+            listener, self._listener = self._listener, None
             self._cv.notify_all()  # release parked readers and waiting recvs
-        for conn in self._conns.values():
+        for conn, _epoch in pending:
             try:
                 conn.close()
             except OSError:
                 pass
-        if self._listener is not None:
+        for conn in conns:
             try:
-                self._listener.close()
+                conn.close()
+            except OSError:
+                pass
+        if listener is not None:
+            try:
+                # shutdown BEFORE close: the rejoin acceptor blocks in
+                # accept() on this fd, and a plain close would leave that
+                # in-flight syscall holding the open file description — the
+                # port would stay bound and wedge a relaunched rank on
+                # EADDRINUSE. shutdown wakes the acceptor with an error first.
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                listener.close()
             except OSError:
                 pass
 
@@ -525,6 +871,13 @@ class PeerTimeoutError(TimeoutError):
     ``isinstance`` instead of matching message text)."""
 
 
+class ClusterFenceError(PeerShutdownError):
+    """A peer observed a rank death and broadcast the epoch fence: this rank
+    must abort its in-flight barriers and quiesce for a surgical rejoin (or,
+    with surgical mode off, fail fast exactly like any other peer loss — it IS
+    a :class:`PeerShutdownError`)."""
+
+
 def _freeze_delta(payload: Any) -> Any:
     """Mark a delta's arrays read-only before handing the LIVE object to peer
     threads: the zero-serialization lane shares one address space, and the
@@ -547,11 +900,15 @@ class ThreadExchange(ClusterExchange):
     serializing between address spaces beyond the pickle the routing layer
     already does)."""
 
+    #: thread peers cannot be relaunched into a live hub — no fence protocol
+    supports_rejoin = False
+
     def __init__(self, hub: ThreadExchangeHub, me: int):
         # deliberately NOT calling super().__init__ — no sockets to wire
         self.n = hub.n
         self.me = me
         self._hub = hub
+        self.epoch = 0
         self._conns = {p: None for p in range(hub.n) if p != me}  # peer ranks
         # same barrier-deadline knob as the TCP lane (no heartbeats here: a
         # thread peer cannot vanish silently, only wedge — which this catches)

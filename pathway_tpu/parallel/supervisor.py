@@ -10,25 +10,40 @@ The spawn parent launches one child per rank and then watches two signals:
 - **exit codes** — a nonzero or signal-killed child is a cluster failure
   (surviving ranks fail loudly themselves via the typed
   ``PeerShutdownError``/``PeerTimeoutError`` barrier errors in
-  ``parallel/cluster.py``);
+  ``parallel/cluster.py``); a rank that exits 0 while its peers keep running
+  past the drain grace is a failure too — lockstep shutdown lands clean exits
+  together, so a lone straggler means the program quit one rank early and the
+  cluster is incomplete;
 - **heartbeat staleness** — each worker's commit loop writes a per-rank status
   file (``write_status``) under ``PATHWAY_SUPERVISE_DIR``; a rank whose status
   goes stale while its process is alive is wedged and gets killed. The same
   payload backs the worker's ``/healthz`` endpoint, so the supervisor and
   external probes share one liveness signal.
 
-On failure, the supervisor either
+On failure, the supervisor escalates down a three-rung ladder:
 
-- **restarts the cluster** — when every reporting rank ran with persistence on
-  and the ``--max-restarts`` budget remains: survivors are torn down and all
-  ranks relaunch with ``PATHWAY_RESTART_COUNT`` bumped; the restarted workers
-  replay the union of journaled commit ids in lockstep (the engine's resume
-  path), i.e. a cluster-wide rollback-resume from the last fully journaled
-  commit; or
+- **surgical single-rank restart** (``--restart-mode surgical``, the default
+  with ``--max-restarts`` > 0 and more than one rank): only the dead rank is
+  relaunched, with ``PATHWAY_RESTART_COUNT`` bumped, ``PATHWAY_CLUSTER_EPOCH``
+  advanced, and ``PATHWAY_CLUSTER_REJOIN=1``; survivors quiesce at the mesh's
+  epoch fence instead of dying (``parallel/cluster.py``), take the
+  replacement's re-dial, and every rank rollback-resumes by lockstep-replaying
+  the union of journaled commit ids — seven healthy workers of a ``spawn -n
+  8`` keep their processes, sockets, and warmed state;
+- **restarts the cluster** — when surgical rejoin is off or itself fails
+  (second concurrent death, dropped rejoin handshake, fence timeout) and the
+  budget remains: survivors are torn down and all ranks relaunch with
+  ``PATHWAY_RESTART_COUNT`` bumped; the restarted workers replay the union of
+  journaled commit ids in lockstep (the engine's resume path), i.e. a
+  cluster-wide rollback-resume from the last fully journaled commit; or
 - **tears down loudly** — persistence off, no reports, or budget exhausted:
   every survivor is terminated and a per-rank post-mortem (exit cause, last
-  commit, heartbeat age) goes to stderr, and the exit code is nonzero. Never a
-  hang.
+  commit, epoch at death, heartbeat age, who killed it) goes to stderr, and
+  the exit code is nonzero. Never a hang.
+
+Both restart rungs require persistence on (the journal is the rollback
+substrate); each relaunch — surgical or full — consumes one unit of the
+``--max-restarts`` budget.
 """
 
 from __future__ import annotations
@@ -81,16 +96,26 @@ def write_status(
     commit: int,
     persistence: bool,
     peers: "Dict[str, float] | None" = None,
+    epoch: int = 0,
+    state: str = "running",
+    restarts: int = 0,
+    last_rejoin_s: "float | None" = None,
 ) -> None:
     """Atomically publish one worker's liveness record. Called from the commit
     loop (throttled there), so recency == the loop is actually turning; a
-    background thread here would defeat wedge detection."""
+    background thread here would defeat wedge detection. The fence path also
+    publishes (``state`` = "fencing"/"rejoining") so a quiesced survivor stays
+    visibly healthy and the supervisor can time the rejoin."""
     payload = {
         "pid": os.getpid(),
         "rank": rank,
         "commit": commit,
         "persistence": bool(persistence),
         "peers": peers or {},
+        "epoch": int(epoch),
+        "state": state,
+        "restarts": int(restarts),
+        "last_rejoin_s": last_rejoin_s,
         "ts": time.time(),
     }
     path = status_path(supervise_dir, rank)
@@ -143,9 +168,14 @@ class Supervisor:
         arguments: "List[str] | tuple",
         env_base: Dict[str, str],
         max_restarts: int = 0,
+        restart_mode: str = "surgical",
         stale_after_s: "float | None" = None,
         poll_interval_s: float = 0.2,
     ):
+        if restart_mode not in ("surgical", "all"):
+            raise ValueError(
+                f"restart_mode must be 'surgical' or 'all', got {restart_mode!r}"
+            )
         self.n = processes
         self.threads = threads
         self.first_port = first_port
@@ -153,6 +183,15 @@ class Supervisor:
         self.arguments = list(arguments)
         self.env_base = env_base
         self.max_restarts = max_restarts
+        self.restart_mode = restart_mode
+        # monotonically increasing mesh incarnation: bumped on EVERY relaunch
+        # (surgical or full) and handed to children via PATHWAY_CLUSTER_EPOCH;
+        # survivors of a surgical restart adopt it from the rejoin handshake
+        self.cluster_epoch = 0
+        # (rank, started_at, target_epoch) while a surgical rejoin is in
+        # flight; a second failure in this window degrades to restart-all
+        self._rejoining: "Optional[tuple]" = None
+        self.last_rejoin_s: "float | None" = None
         if stale_after_s is None:
             stale_after_s = _env_float(
                 "PATHWAY_SUPERVISOR_STALE_S", _default_stale_after()
@@ -165,7 +204,29 @@ class Supervisor:
         self.restarts_used = 0
         self.handles: List[subprocess.Popen] = []
         self._terminated_by_us: "set[int]" = set()
+        self._killed_for_staleness: "set[int]" = set()
+        self._clean_exit_at: Dict[int, float] = {}  # rank -> first seen rc==0
         self._supervise_dir: Optional[str] = None
+
+    def _surgical_enabled(self) -> bool:
+        # n == 1 has no survivors to keep alive — surgical degenerates to
+        # restart-all there, so don't bother with the rejoin machinery
+        return self.restart_mode == "surgical" and self.max_restarts > 0 and self.n > 1
+
+    def _child_env(self, process_id: int) -> Dict[str, str]:
+        env = self.env_base.copy()
+        env["PATHWAY_THREADS"] = str(self.threads)
+        env["PATHWAY_PROCESSES"] = str(self.n)
+        env["PATHWAY_FIRST_PORT"] = str(self.first_port)
+        env["PATHWAY_PROCESS_ID"] = str(process_id)
+        env["PATHWAY_RUN_ID"] = self._run_id
+        env["PATHWAY_SUPERVISE_DIR"] = self._supervise_dir
+        env["PATHWAY_RESTART_COUNT"] = str(self.restarts_used)
+        env["PATHWAY_CLUSTER_EPOCH"] = str(self.cluster_epoch)
+        if self._surgical_enabled():
+            # workers fence-and-wait on a peer death instead of dying typed
+            env["PATHWAY_RESTART_MODE"] = "surgical"
+        return env
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -181,22 +242,40 @@ class Supervisor:
                 os.unlink(status_path(self._supervise_dir, rank))
             except OSError:
                 pass
-        run_id = uuid.uuid4()
+        self._run_id = str(uuid.uuid4())
         self.handles = []
         self._terminated_by_us = set()
+        self._killed_for_staleness = set()
+        self._clean_exit_at = {}
         self._launched_at = time.monotonic()
         for process_id in range(self.n):
-            env = self.env_base.copy()
-            env["PATHWAY_THREADS"] = str(self.threads)
-            env["PATHWAY_PROCESSES"] = str(self.n)
-            env["PATHWAY_FIRST_PORT"] = str(self.first_port)
-            env["PATHWAY_PROCESS_ID"] = str(process_id)
-            env["PATHWAY_RUN_ID"] = str(run_id)
-            env["PATHWAY_SUPERVISE_DIR"] = self._supervise_dir
-            env["PATHWAY_RESTART_COUNT"] = str(self.restarts_used)
             self.handles.append(
-                subprocess.Popen([self.program, *self.arguments], env=env)
+                subprocess.Popen(
+                    [self.program, *self.arguments], env=self._child_env(process_id)
+                )
             )
+
+    def _relaunch_rank(self, rank: int) -> None:
+        """Surgical restart: relaunch ONLY the dead rank, with the bumped
+        restart count, the next cluster epoch, and the rejoin flag — the
+        replacement dials back into the survivors' open listeners instead of
+        rewiring the whole mesh."""
+        assert self._supervise_dir is not None
+        try:
+            os.unlink(status_path(self._supervise_dir, rank))
+        except OSError:
+            pass
+        self._terminated_by_us.discard(rank)
+        self._killed_for_staleness.discard(rank)
+        self._clean_exit_at.pop(rank, None)
+        # a fresh startup-grace window for the replacement (and a conservative
+        # staleness holiday for fencing survivors, who publish status anyway)
+        self._launched_at = time.monotonic()
+        env = self._child_env(rank)
+        env["PATHWAY_CLUSTER_REJOIN"] = "1"
+        self.handles[rank] = subprocess.Popen(
+            [self.program, *self.arguments], env=env
+        )
 
     def _drain(self) -> None:
         """Briefly wait for survivors to exit on their own typed errors."""
@@ -238,6 +317,18 @@ class Supervisor:
             any_alive = False
             statuses = read_statuses(self._supervise_dir, self.n)
             up_for = time.monotonic() - self._launched_at
+            if self._rejoining is not None and len(statuses) == self.n:
+                rejoin_rank, started_at, target_epoch = self._rejoining
+                if all(
+                    int(s.get("epoch", 0) or 0) >= target_epoch
+                    for s in statuses.values()
+                ):
+                    self.last_rejoin_s = time.monotonic() - started_at
+                    self._log(
+                        f"rank {rejoin_rank} rejoined the cluster at epoch "
+                        f"{target_epoch} in {self.last_rejoin_s:.1f}s"
+                    )
+                    self._rejoining = None
             for rank, handle in enumerate(self.handles):
                 rc = handle.poll()
                 if rc is None:
@@ -265,12 +356,29 @@ class Supervisor:
                         )
                 elif rc != 0:
                     return (rank, describe_exit(rc))
+                else:
+                    self._clean_exit_at.setdefault(rank, time.monotonic())
             if not any_alive:
                 return None
+            # a rank that exited 0 while its peers keep running is a cluster
+            # event too: lockstep shutdown means clean exits land together, so
+            # a lone rc==0 straggler (rank-conditional sys.exit in the program)
+            # would otherwise strand fenced survivors for the full fence
+            # timeout waiting on a replacement that never launches. The drain
+            # window absorbs the normal millisecond exit stagger.
+            grace = _env_float("PATHWAY_SUPERVISOR_DRAIN_S", DEFAULT_DRAIN_S)
+            for rank, first_seen in self._clean_exit_at.items():
+                if time.monotonic() - first_seen > grace:
+                    return (
+                        rank,
+                        "exited 0 while peers kept running — the cluster is "
+                        "incomplete",
+                    )
             time.sleep(self.poll_interval_s)
 
     def _kill_wedged(self, rank: int, handle: subprocess.Popen) -> None:
         self._terminated_by_us.add(rank)
+        self._killed_for_staleness.add(rank)
         try:
             handle.kill()
         except OSError:
@@ -285,15 +393,26 @@ class Supervisor:
         now = time.time()
         for rank, handle in enumerate(self.handles):
             status = statuses.get(rank)
-            parts = [describe_exit(handle.poll())]
-            if rank in self._terminated_by_us:
+            rc = handle.poll()
+            parts = [describe_exit(rc)]
+            # attribute the kill: operators triaging a post-mortem need to know
+            # whether the supervisor shot this rank or something external
+            # (chaos plan, OOM killer, an operator's kill -9) got it first
+            if rank in self._killed_for_staleness:
+                parts.append("killed by supervisor for staleness")
+            elif rank in self._terminated_by_us:
                 parts.append("terminated by supervisor")
+            elif rc is not None and rc < 0:
+                parts.append("signal was external (chaos plan or operator)")
             if status is not None:
                 parts.append(f"last commit {status.get('commit')}")
+                parts.append(f"epoch {status.get('epoch', 0)} at death")
                 parts.append(f"heartbeat {now - status.get('ts', now):.1f}s ago")
                 parts.append(
                     "persistence on" if status.get("persistence") else "persistence off"
                 )
+                if status.get("state") not in (None, "running"):
+                    parts.append(f"state {status.get('state')}")
             else:
                 parts.append("no status report")
             self._log(f"  post-mortem rank {rank}: " + ", ".join(parts))
@@ -310,12 +429,44 @@ class Supervisor:
                 failure = self._watch()
                 if failure is None:
                     return 0
-                self._drain()
+                failed_rank = failure[0]
                 statuses = read_statuses(self._supervise_dir, self.n)
                 # restart only when the journal can actually restore the work:
                 # every reporting rank ran with persistence on (a rank that died
                 # before its first commit simply has no report and no journal
                 # entries to lose — the others' journals still replay)
+                persistence_on = bool(statuses) and all(
+                    s.get("persistence") for s in statuses.values()
+                )
+                if (
+                    self._surgical_enabled()
+                    and persistence_on
+                    and self.restarts_used < self.max_restarts
+                    # a failure while a rejoin is still in flight (second
+                    # concurrent death, dead replacement, dropped handshake)
+                    # means surgical recovery is not converging: fall through
+                    # to restart-all
+                    and self._rejoining is None
+                    and self.handles[failed_rank].poll() is not None
+                ):
+                    self.restarts_used += 1
+                    self.cluster_epoch += 1
+                    self._rejoining = (
+                        failed_rank,
+                        time.monotonic(),
+                        self.cluster_epoch,
+                    )
+                    self._log(
+                        f"rank {failed_rank} died ({failure[1]}); surgically "
+                        f"relaunching rank {failed_rank} only (attempt "
+                        f"{self.restarts_used}/{self.max_restarts}, epoch "
+                        f"{self.cluster_epoch}) — survivors hold at the epoch "
+                        "fence"
+                    )
+                    self._relaunch_rank(failed_rank)
+                    continue
+                self._drain()
+                statuses = read_statuses(self._supervise_dir, self.n)
                 persistence_on = bool(statuses) and all(
                     s.get("persistence") for s in statuses.values()
                 )
@@ -337,7 +488,15 @@ class Supervisor:
                         f"--max-restarts {self.max_restarts})",
                     )
                     return self._exit_code(failure)
+                if self._rejoining is not None:
+                    self._log(
+                        f"surgical rejoin of rank {self._rejoining[0]} failed "
+                        f"({failure[1]} on rank {failed_rank}); falling back to "
+                        "restart-all"
+                    )
+                    self._rejoining = None
                 self.restarts_used += 1
+                self.cluster_epoch += 1
                 last_commit = max(
                     (s.get("commit", 0) for s in statuses.values()), default=0
                 )

@@ -128,6 +128,11 @@ class PersistenceManager:
                 self.root = os.path.join(str(self.root), f"process-{cfg.process_id}")
         self._mem_journal: io.BytesIO = io.BytesIO()
         self._journal_file: Any = None
+        # id of the last frame THIS incarnation appended (None before the first):
+        # the surgical-rejoin fence uses it to tell a journaled in-flight commit
+        # (already durable, must not be re-ingested) from a lost one (its drained
+        # input rows must be carried over the rollback)
+        self.last_commit_id: Optional[int] = None
         # byte offset of the last complete frame, set by load_journal; open_for_append
         # truncates torn tail bytes past it so new frames never land after garbage
         self._valid_end: Optional[int] = None
@@ -284,6 +289,7 @@ class PersistenceManager:
             ),
             protocol=pickle.HIGHEST_PROTOCOL,
         )
+        self.last_commit_id = commit_id
         if self._object_store is not None:
             self._object_store.put(self._frame_key(self._next_seq), frame)
             self._next_seq += 1
@@ -300,6 +306,20 @@ class PersistenceManager:
         if self._journal_file is not None:
             self._journal_file.close()
             self._journal_file = None
+
+    def reload(self, graph_sig: str) -> List[Tuple[int, Dict[int, Delta], Dict[int, dict]]]:
+        """Surgical-rejoin rollback: drop the append handle, re-read every
+        durable frame of THIS rank's journal shard, and reopen for append.
+
+        The caller (the fenced survivor, or the relaunched rank via the normal
+        setup path) rebuilds its operator state by replaying the returned
+        frames; the cluster's lockstep union replay then aligns commit ids
+        across ranks, so everyone converges on the last cluster-wide committed
+        id no matter whose journal ran ahead when the failure hit."""
+        self.close()
+        frames = self.load_journal(graph_sig)
+        self.open_for_append(graph_sig)
+        return frames
 
     def cached_objects(self) -> Any:
         """The pipeline's durable URI -> (blob, metadata) store (reference

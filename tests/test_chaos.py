@@ -189,7 +189,8 @@ CHAOS_PROG = textwrap.dedent(
 )
 
 
-def _chaos_spawn(tmp_path, first_port, *, plan, persist, max_restarts, extra_env=None):
+def _chaos_spawn(tmp_path, first_port, *, plan, persist, max_restarts,
+                 extra_env=None, restart_mode=None):
     env = os.environ.copy()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["PATHWAY_TPU_TEST_DIR"] = str(tmp_path)
@@ -204,11 +205,12 @@ def _chaos_spawn(tmp_path, first_port, *, plan, persist, max_restarts, extra_env
     env.update(extra_env or {})
     prog = tmp_path / "prog.py"
     prog.write_text(CHAOS_PROG)
+    mode_args = ["--restart-mode", restart_mode] if restart_mode else []
     return subprocess.Popen(
         [
             sys.executable, "-m", "pathway_tpu.cli", "spawn",
             "-n", "2", "--first-port", str(first_port),
-            "--max-restarts", str(max_restarts),
+            "--max-restarts", str(max_restarts), *mode_args,
             sys.executable, str(prog),
         ],
         env=env,
@@ -276,9 +278,11 @@ def _failure_free_counts(tmp_path) -> dict:
 
 @pytest.mark.chaos
 def test_chaos_kill_one_worker_supervisor_failover_exact(tmp_path):
-    """Seeded kill of rank 0 at commit 3 (persistence on): the supervisor
-    restarts the cluster, the journal union replays, streaming continues, and
-    the merged output is bit-identical to the failure-free run."""
+    """Seeded kill of rank 0 at commit 3 (persistence on, ``--restart-mode
+    all`` pinning the PR 2 rung): the supervisor restarts the cluster, the
+    journal union replays, streaming continues, and the merged output is
+    bit-identical to the failure-free run. (Surgical mode — the default — is
+    covered by ``test_rejoin.py``.)"""
     (tmp_path / "in").mkdir()
     first_port = 28000 + os.getpid() % 500 * 4
     for i in range(4):
@@ -287,7 +291,8 @@ def test_chaos_kill_one_worker_supervisor_failover_exact(tmp_path):
         )
 
     plan = {"kill": [{"rank": 0, "commit": 3, "run": 0}]}
-    proc = _chaos_spawn(tmp_path, first_port, plan=plan, persist=True, max_restarts=1)
+    proc = _chaos_spawn(tmp_path, first_port, plan=plan, persist=True,
+                        max_restarts=1, restart_mode="all")
     err = ""
     try:
         time.sleep(5)  # kill + restart window
@@ -322,8 +327,11 @@ def test_chaos_kill_one_worker_supervisor_failover_exact(tmp_path):
 @pytest.mark.chaos
 def test_chaos_repeated_kills_long_torture(tmp_path):
     """Long variant (excluded from tier-1 via ``slow``): BOTH ranks die across
-    consecutive incarnations — rank 0 on the first run, rank 1 after the first
-    restart — and two supervised failovers still converge to exact totals."""
+    consecutive incarnations — rank 0 first, then the surviving rank 1 after
+    the first recovery — and two supervised failovers still converge to exact
+    totals. With ``--max-restarts`` > 0 the supervisor runs in surgical mode,
+    so each death should relaunch only the dead rank (a restart-all fallback
+    still counts as a recovery, but at least one rung must fire per death)."""
     (tmp_path / "in").mkdir()
     first_port = 28000 + os.getpid() % 500 * 4 + 4
     for i in range(6):
@@ -334,13 +342,21 @@ def test_chaos_repeated_kills_long_torture(tmp_path):
     plan = {
         "kill": [
             {"rank": 0, "commit": 3, "run": 0},
+            # the survivor keeps run 0 across rank 0's surgical restart, so its
+            # own scheduled kill fires later at a live post-rejoin commit; the
+            # run-1 companion covers the tolerated restart-all fallback, where
+            # rank 1 is relaunched with a bumped restart count and the run-0
+            # entry would never match again
+            {"rank": 1, "commit": 9, "run": 0},
             {"rank": 1, "commit": 9, "run": 1},
         ]
     }
-    proc = _chaos_spawn(tmp_path, first_port, plan=plan, persist=True, max_restarts=2)
+    # budget 3 absorbs one surgical->restart-all fallback and still leaves a
+    # recovery for the second death
+    proc = _chaos_spawn(tmp_path, first_port, plan=plan, persist=True, max_restarts=3)
     err = ""
     try:
-        time.sleep(10)  # both kill + restart windows
+        time.sleep(10)  # both kill + recovery windows
         (tmp_path / "in" / "late.csv").write_text(
             "word\n" + "\n".join(["owl"] * 5) + "\n"
         )
@@ -360,8 +376,12 @@ def test_chaos_repeated_kills_long_torture(tmp_path):
         assert merged == expected, f"got {merged}, want {expected}"
     finally:
         err = _terminate_group(proc)
-    assert err.count("restarting the cluster") >= 2, (
-        f"expected two supervised restarts:\n{err}"
+    recoveries = err.count("surgically relaunching") + err.count(
+        "restarting the cluster"
+    )
+    assert recoveries >= 2, f"expected two supervised recoveries:\n{err}"
+    assert "surgically relaunching" in err, (
+        f"--max-restarts > 0 should exercise surgical mode:\n{err}"
     )
 
 

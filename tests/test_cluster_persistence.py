@@ -90,7 +90,7 @@ PROG = textwrap.dedent(
 
 
 def _spawn_popen(tmp_path, first_port: int, kill_pid: int | None, marker: str,
-                 max_restarts: int = 0):
+                 max_restarts: int = 0, restart_mode: "str | None" = None):
     env = os.environ.copy()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["PATHWAY_TPU_TEST_DIR"] = str(tmp_path)
@@ -101,11 +101,12 @@ def _spawn_popen(tmp_path, first_port: int, kill_pid: int | None, marker: str,
         env["PW_TEST_KILL_MARKER"] = marker
     prog = tmp_path / "prog.py"
     prog.write_text(PROG)
+    mode_args = ["--restart-mode", restart_mode] if restart_mode else []
     return subprocess.Popen(
         [
             sys.executable, "-m", "pathway_tpu.cli", "spawn",
             "-n", "2", "--first-port", str(first_port),
-            "--max-restarts", str(max_restarts),
+            "--max-restarts", str(max_restarts), *mode_args,
             sys.executable, str(prog),
         ],
         env=env,
@@ -199,8 +200,10 @@ def test_spawn_kill9_each_process_restart_exact(tmp_path):
 
 def test_spawn_kill9_single_worker_supervised_failover(tmp_path):
     """Single-worker failover, ONE spawn invocation: rank 0 SIGKILLs itself
-    mid-run, the supervisor restarts the cluster from the journal, and the
-    merged output converges to the exact totals — no operator in the loop."""
+    mid-run, the supervisor restarts the cluster from the journal (pinned to
+    ``--restart-mode all`` — the PR 2 rung; surgical mode is covered by
+    ``test_rejoin.py``), and the merged output converges to the exact totals —
+    no operator in the loop."""
     (tmp_path / "in").mkdir()
     first_port = 24000 + os.getpid() % 500 * 4 + 2
 
@@ -210,7 +213,8 @@ def test_spawn_kill9_single_worker_supervised_failover(tmp_path):
         )
 
     marker = str(tmp_path / "marker-failover")
-    proc = _spawn_popen(tmp_path, first_port, 0, marker, max_restarts=2)
+    proc = _spawn_popen(tmp_path, first_port, 0, marker, max_restarts=2,
+                        restart_mode="all")
     err = ""
     try:
         # wait for the SIGKILL to actually land, THEN add data only the

@@ -46,7 +46,16 @@ WEDGED_PROG = textwrap.dedent(
 )
 
 
-def _supervisor(tmp_path, prog_text, *, n=2, max_restarts=0, stale_after=0.0, env=None):
+# like CRASH_ONCE_PROG, but rank 0 SIGKILLs itself on run 0 AND run 1 — the
+# surgical replacement dies too, forcing the restart-all fallback rung
+CRASH_TWICE_PROG = CRASH_ONCE_PROG.replace(
+    'os.environ.get("PATHWAY_RESTART_COUNT") == "0"',
+    'os.environ.get("PATHWAY_RESTART_COUNT") in ("0", "1")',
+)
+
+
+def _supervisor(tmp_path, prog_text, *, n=2, max_restarts=0, stale_after=0.0,
+                env=None, restart_mode="surgical"):
     prog = tmp_path / "prog.py"
     prog.write_text(prog_text)
     env_base = os.environ.copy()
@@ -59,6 +68,7 @@ def _supervisor(tmp_path, prog_text, *, n=2, max_restarts=0, stale_after=0.0, en
         arguments=[str(prog)],
         env_base=env_base,
         max_restarts=max_restarts,
+        restart_mode=restart_mode,
         stale_after_s=stale_after,
         poll_interval_s=0.05,
     )
@@ -76,6 +86,39 @@ def test_crash_with_persistence_restarts_and_succeeds(tmp_path):
     assert sup.restarts_used == 1
 
 
+def test_surgical_mode_relaunches_only_the_dead_rank(tmp_path, capsys):
+    """Default mode: rank 0's crash relaunches rank 0 ONLY — the survivor is
+    neither terminated nor relaunched, and the epoch advances."""
+    sup = _supervisor(tmp_path, CRASH_ONCE_PROG, max_restarts=1)
+    assert sup.run() == 0
+    assert sup.restarts_used == 1
+    assert sup.cluster_epoch == 1
+    err = capsys.readouterr().err
+    assert "surgically relaunching rank 0 only" in err
+    assert "restarting the cluster" not in err
+    assert "terminated by supervisor" not in err
+
+
+def test_restart_mode_all_skips_surgical(tmp_path, capsys):
+    sup = _supervisor(tmp_path, CRASH_ONCE_PROG, max_restarts=1, restart_mode="all")
+    assert sup.run() == 0
+    err = capsys.readouterr().err
+    assert "restarting the cluster" in err
+    assert "surgically relaunching" not in err
+
+
+def test_surgical_replacement_crash_falls_back_to_restart_all(tmp_path, capsys):
+    """The relaunched rank dies again while the rejoin is in flight: the
+    supervisor must degrade to restart-all (budget permitting) and recover."""
+    sup = _supervisor(tmp_path, CRASH_TWICE_PROG, max_restarts=2)
+    assert sup.run() == 0
+    assert sup.restarts_used == 2
+    err = capsys.readouterr().err
+    assert "surgically relaunching rank 0 only" in err
+    assert "falling back to restart-all" in err
+    assert "restarting the cluster" in err
+
+
 def test_crash_without_persistence_refuses_restart(tmp_path, capsys):
     sup = _supervisor(
         tmp_path, CRASH_ONCE_PROG, max_restarts=3, env={"PW_TEST_PERSISTENCE": "0"}
@@ -87,6 +130,9 @@ def test_crash_without_persistence_refuses_restart(tmp_path, capsys):
     assert "post-mortem" in err
     assert "persistence is off" in err
     assert "killed by signal SIGKILL" in err
+    # the SIGKILL came from the program itself, not from the supervisor
+    assert "signal was external (chaos plan or operator)" in err
+    assert "epoch 0 at death" in err
 
 
 def test_restart_budget_exhausted_reports_and_fails(tmp_path, capsys):
@@ -104,6 +150,31 @@ def test_wedged_rank_detected_by_heartbeat_staleness(tmp_path, capsys):
     assert rc != 0
     err = capsys.readouterr().err
     assert "stale" in err and "wedged" in err
+    # post-mortem attributes the kill to the supervisor, not to chaos/operator
+    assert "killed by supervisor for staleness" in err
+    assert "signal was external" not in err
+
+
+def test_clean_exit_straggler_is_a_cluster_event(tmp_path, capsys, monkeypatch):
+    """A rank that exits 0 while its peers keep running (rank-conditional
+    sys.exit in the program) must surface as a failure after the drain grace —
+    lockstep shutdown means legitimate clean exits land together, and fenced
+    survivors must not wait a full fence timeout for a replacement the
+    supervisor would never launch."""
+    monkeypatch.setenv("PATHWAY_SUPERVISOR_DRAIN_S", "0.5")
+    prog = textwrap.dedent(
+        """
+        import os, sys, time
+        if int(os.environ["PATHWAY_PROCESS_ID"]) == 0:
+            sys.exit(0)
+        time.sleep(60)
+        """
+    )
+    sup = _supervisor(tmp_path, prog)
+    rc = sup.run()
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "exited 0 while peers kept running" in err
 
 
 def test_startup_wedge_detected_without_any_status(tmp_path, capsys, monkeypatch):
