@@ -449,6 +449,12 @@ class ExpressionEvaluator:
         poisoned &= ~replayed
         clean_idx = np.nonzero(~poisoned & ~replayed)[0]
         out[poisoned] = ERROR
+        # batch-level stage accounting (engine/telemetry.py stage counters): one
+        # timing add per COMMIT batch, so the serving/ingest hot paths stay
+        # observable (embed time vs engine time) at negligible cost
+        import time as _time
+
+        _t0 = _time.perf_counter()
         for start in range(0, len(clean_idx), max_bs):
             idx = clean_idx[start : start + max_bs]
             batch_args = [list(a[idx]) for a in args]
@@ -465,6 +471,11 @@ class ExpressionEvaluator:
                 )
             for i, r in zip(idx, results):
                 out[i] = r
+        if len(clean_idx):
+            from pathway_tpu.engine import telemetry as _telemetry
+
+            _telemetry.stage_add("eval.batch_udf_s", _time.perf_counter() - _t0)
+            _telemetry.stage_add("eval.batch_udf_rows", float(len(clean_idx)))
         self._memo_record(store, out)
         return out
 

@@ -16,7 +16,9 @@ from __future__ import annotations
 import contextlib
 import os
 import sys
-from typing import Any, Iterator
+import threading
+import time
+from typing import Any, Dict, Iterator
 
 
 def _telemetry_requested(module: str) -> bool:
@@ -53,6 +55,56 @@ def span(name: str, **attributes: Any) -> Iterator[None]:
             except Exception:
                 pass
         yield
+
+
+# -- stage counters (always-on, in-process) ----------------------------------
+#
+# Lightweight cumulative counters/timings for hot-path stages (embed pipeline
+# tokenize/dispatch/cache, batch-UDF evaluation). Unlike the OTel instruments
+# below these are ALWAYS on: one dict add under a lock per *batch-level* event,
+# cheap enough for the serving path, and readable in-process (the bench's
+# embedpipe section and DocumentStore.statistics_query report them) without any
+# exporter wiring. Keys are dotted stage names; ``*_s`` keys are cumulative
+# seconds, everything else is a count.
+
+_stage_lock = threading.Lock()
+_stage_counters: Dict[str, float] = {}
+
+
+def stage_add(name: str, value: float = 1.0) -> None:
+    """Add ``value`` to the cumulative counter ``name``."""
+    with _stage_lock:
+        _stage_counters[name] = _stage_counters.get(name, 0.0) + value
+
+
+@contextlib.contextmanager
+def stage_timer(name: str) -> Iterator[None]:
+    """Accumulate wall seconds under ``<name>_s`` and bump ``<name>_calls``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - t0
+        with _stage_lock:
+            _stage_counters[name + "_s"] = _stage_counters.get(name + "_s", 0.0) + elapsed
+            _stage_counters[name + "_calls"] = _stage_counters.get(name + "_calls", 0.0) + 1
+
+
+def stage_snapshot(prefix: str | None = None) -> Dict[str, float]:
+    """Copy of the counters (optionally only those under ``prefix``)."""
+    with _stage_lock:
+        if prefix is None:
+            return dict(_stage_counters)
+        return {k: v for k, v in _stage_counters.items() if k.startswith(prefix)}
+
+
+def stage_reset(prefix: str | None = None) -> None:
+    with _stage_lock:
+        if prefix is None:
+            _stage_counters.clear()
+        else:
+            for k in [k for k in _stage_counters if k.startswith(prefix)]:
+                del _stage_counters[k]
 
 
 # -- metrics (reference telemetry.rs:37-45: OTLP process mem/cpu + latency) -------

@@ -1,0 +1,23 @@
+"""Shape-bucketing helpers shared by every jit'd kernel path.
+
+One home for the power-of-two bucketing rule (previously duplicated across
+``models/encoder.py``, ``ops/knn.py`` and ``ops/segment.py``): padding batch
+shapes to pow2 buckets keys each kernel's jit cache by O(log) distinct shapes
+instead of one compile per raw size. Pure python, import-free — safe to use
+from modules that must not pull in jax.
+"""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor).
+
+    ``floor`` is the minimum bucket (device paths use 8: tiny batches still
+    produce MXU/lane-aligned shapes, and the sub-8 sizes would each cost a
+    compile for no throughput gain). ``floor`` must itself be a power of two.
+    """
+    p = floor
+    while p < n:
+        p *= 2
+    return p

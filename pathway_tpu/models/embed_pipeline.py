@@ -1,0 +1,401 @@
+"""Pipelined embedding runtime shared by the ingest and query paths.
+
+Three stages in front of ``JaxSentenceEncoder``, each measured through
+``engine/telemetry.py`` stage counters:
+
+1. **Content-hash embed cache** (:class:`EmbedCache`): an LRU keyed on
+   (model, xxhash-of-text) consulted BEFORE the encoder on both paths, so
+   re-ingested/duplicate chunks and repeated queries skip the forward pass
+   entirely. The cache is orthogonal to the engine's memoize-on-retraction
+   contract for non-deterministic UDFs: retraction rows are replayed from the
+   evaluator's per-key memo and never reach this layer — the cache only
+   deduplicates *forward* work across distinct rows/commits with equal text.
+2. **Overlapped length-sorted ingest** (``JaxSentenceEncoder.encode_pipelined``):
+   commit batches split into length-sorted sub-batches, host tokenization of
+   sub-batch k+1 overlapping the device's forward of k via JAX async dispatch.
+3. **Query coalescing** (:class:`QueryCoalescer`): a deadline-based
+   micro-batcher in front of ``encode_device`` that merges concurrent
+   in-flight retrieve queries into ONE encoder dispatch (``max_wait_ms`` /
+   ``max_batch``), so N concurrent clients pay ~1 dispatch instead of N.
+
+Counters (``telemetry.stage_snapshot("embed.")``): cache hits/misses/evictions,
+coalesce requests/batches/rows (avg batch = rows/batches), dedup_rows,
+tokenize/encode timings, padded vs real token counts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine import telemetry
+
+
+class EmbedCache:
+    """Thread-safe LRU of text → embedding keyed by (model, content hash).
+
+    Keys are 128-bit xxh3 digests of the text salted with the model name —
+    content-addressed, so identical chunks across files/commits share one
+    entry. Values are read-only float32 host rows. ``max_entries=0`` disables
+    the cache (get always misses, put is a no-op) without branching at call
+    sites."""
+
+    def __init__(self, max_entries: int = 50_000, model: str = ""):
+        self.max_entries = int(max_entries)
+        self._salt = model.encode()
+        self._data: "OrderedDict[bytes, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _key(self, text: str) -> bytes:
+        import xxhash
+
+        return xxhash.xxh3_128_digest(self._salt + b"\x00" + str(text).encode())
+
+    def get(self, text: str) -> Optional[np.ndarray]:
+        # per-row counters stay on the cache's own lock; the telemetry stage
+        # counters (process-global lock) are fed one batch-level add per commit
+        # by EmbedPipeline — a 1024-row ingest must not take the global lock
+        # 1024 times
+        if self.max_entries <= 0:
+            return None
+        key = self._key(text)
+        with self._lock:
+            vec = self._data.get(key)
+            if vec is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return vec
+
+    def put(self, text: str, vec: np.ndarray) -> None:
+        if self.max_entries <= 0:
+            return
+        row = np.ascontiguousarray(vec, dtype=np.float32)
+        row.setflags(write=False)  # shared across rows/commits: must never mutate
+        key = self._key(text)
+        with self._lock:
+            self._data[key] = row
+            self._data.move_to_end(key)
+            while len(self._data) > self.max_entries:
+                self._data.popitem(last=False)
+                self.evictions += 1
+                telemetry.stage_add("embed.cache_evictions")  # rare: batch-level in practice
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "cache_hits": self.hits,
+                "cache_misses": self.misses,
+                "cache_evictions": self.evictions,
+                "cache_size": len(self._data),
+            }
+
+
+class _Request:
+    __slots__ = ("texts", "arrived", "event", "rows", "error")
+
+    def __init__(self, texts: List[str]):
+        self.texts = texts
+        self.arrived = time.monotonic()
+        self.event = threading.Event()
+        self.rows: Optional[List[Any]] = None
+        self.error: Optional[BaseException] = None
+
+
+class QueryCoalescer:
+    """Deadline-based micro-batcher merging concurrent embed requests into one
+    encoder dispatch.
+
+    The first request to arrive at an empty queue anchors a batch window of
+    ``max_wait_ms``; requests arriving inside the window (or while the encoder
+    is busy with the previous batch) join the same dispatch, capped at
+    ``max_batch`` rows. A request is therefore dispatched no later than
+    ``max_wait_ms`` after submission (deadline contract) and immediately once
+    ``max_batch`` rows are waiting. Duplicate texts within a batch encode once
+    (content dedup) — every request still receives its own rows, in order.
+
+    ``encode_rows(texts) -> sequence of per-row values`` runs on the worker
+    thread; row values may be host arrays or device-resident jax slices — the
+    coalescer never inspects them. An optional ``after_batch(texts, rows)``
+    hook runs AFTER responders are released (cache fill without adding to
+    request latency)."""
+
+    def __init__(
+        self,
+        encode_rows: Callable[[List[str]], Sequence[Any]],
+        *,
+        max_wait_ms: float = 2.0,
+        max_batch: int = 256,
+        after_batch: Callable[[List[str], Sequence[Any]], None] | None = None,
+    ):
+        self._encode_rows = encode_rows
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_batch = max(1, int(max_batch))
+        self._after_batch = after_batch
+        self._queue: "deque[_Request]" = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._worker: threading.Thread | None = None
+        self._closed = False
+        # counters (also mirrored into telemetry stage counters)
+        self.requests = 0
+        self.batches = 0
+        self.coalesced_rows = 0
+        self.dedup_rows = 0
+        self.max_batch_rows = 0
+
+    # -- submission ----------------------------------------------------------
+
+    def embed(self, texts: List[str]) -> List[Any]:
+        """Blocking: returns one row value per input text, in order."""
+        if not texts:
+            return []
+        req = _Request(list(texts))
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("QueryCoalescer is closed")
+            self._queue.append(req)
+            self.requests += 1
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._run, name="pathway:embed-coalescer", daemon=True
+                )
+                self._worker.start()
+            self._cond.notify_all()
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        assert req.rows is not None
+        return req.rows
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- worker --------------------------------------------------------------
+
+    def _gather(self) -> List[_Request]:
+        """Wait for work, honor the batch window, take up to max_batch rows."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return []
+                self._cond.wait(timeout=0.5)
+            # the window anchors at the OLDEST queued request's arrival — time
+            # it already spent waiting behind a busy encoder counts against the
+            # deadline, so a request is dispatched no later than max_wait_ms
+            # after submission (plus the in-flight batch, which is unavoidable)
+            deadline = self._queue[0].arrived + self.max_wait_ms / 1000.0
+            while sum(len(r.texts) for r in self._queue) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(timeout=remaining)
+            take: List[_Request] = []
+            rows = 0
+            while self._queue and (
+                not take or rows + len(self._queue[0].texts) <= self.max_batch
+            ):
+                req = self._queue.popleft()
+                take.append(req)
+                rows += len(req.texts)
+            return take
+
+    def _run(self) -> None:
+        while True:
+            batch = self._gather()
+            if not batch:
+                if self._closed:
+                    return
+                continue
+            texts = [t for r in batch for t in r.texts]
+            # content dedup inside the coalesced batch: N clients asking the
+            # same question pay one forward row
+            first_of: Dict[str, int] = {}
+            unique: List[str] = []
+            slot_of = []
+            for t in texts:
+                j = first_of.setdefault(t, len(unique))
+                if j == len(unique):
+                    unique.append(t)
+                slot_of.append(j)
+            try:
+                with telemetry.stage_timer("embed.coalesce_encode"):
+                    out = self._encode_rows(unique)
+                rows = [out[j] for j in slot_of]
+            except BaseException as exc:  # propagate to every waiter in the batch
+                for r in batch:
+                    r.error = exc
+                    r.event.set()
+                continue
+            self.batches += 1
+            self.coalesced_rows += len(texts)
+            self.dedup_rows += len(texts) - len(unique)
+            self.max_batch_rows = max(self.max_batch_rows, len(texts))
+            telemetry.stage_add("embed.coalesce_batches")
+            telemetry.stage_add("embed.coalesce_rows", len(texts))
+            if len(texts) > len(unique):
+                telemetry.stage_add("embed.coalesce_dedup_rows", len(texts) - len(unique))
+            pos = 0
+            for r in batch:
+                r.rows = rows[pos : pos + len(r.texts)]
+                pos += len(r.texts)
+                r.event.set()
+            if self._after_batch is not None:
+                try:
+                    self._after_batch(unique, out)
+                except Exception:
+                    pass  # cache fill is best-effort; responders already released
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "coalesce_requests": self.requests,
+            "coalesce_batches": self.batches,
+            "coalesce_rows": self.coalesced_rows,
+            "coalesce_dedup_rows": self.dedup_rows,
+            "coalesce_max_batch_rows": self.max_batch_rows,
+        }
+
+
+class EmbedPipeline:
+    """The embed runtime shared by ingest (``encode_batch``) and query
+    (``embed_query_rows``) paths: cache → overlapped/coalesced encode → fill.
+
+    Knobs: ``max_wait_ms``/``max_batch`` (coalescer window), ``sub_batch``
+    (length-sorted ingest sub-batch rows), ``cache_size`` (LRU entries; 0
+    disables)."""
+
+    def __init__(
+        self,
+        encoder: Any,
+        *,
+        model: str = "",
+        max_wait_ms: float = 2.0,
+        max_batch: int = 256,
+        sub_batch: int = 128,
+        cache_size: int = 50_000,
+    ):
+        self.encoder = encoder
+        self.sub_batch = int(sub_batch)
+        self.cache = EmbedCache(cache_size, model=model)
+        self._pad_padded = 0.0
+        self._pad_real = 0.0
+        self.coalescer = QueryCoalescer(
+            self._encode_device_rows,
+            max_wait_ms=max_wait_ms,
+            max_batch=max_batch,
+            after_batch=self._fill_cache_from_device,
+        )
+
+    # -- ingest path ---------------------------------------------------------
+
+    def encode_batch(self, texts: List[str]) -> np.ndarray:
+        """Host float32 (n, dim) embeddings for a commit batch: cache hits skip
+        the forward; misses ride the overlapped length-sorted sub-batch path."""
+        n = len(texts)
+        dim = self.encoder.dim
+        out = np.empty((n, dim), dtype=np.float32)
+        miss_idx: List[int] = []
+        with telemetry.stage_timer("embed.cache_lookup"):
+            for i, t in enumerate(texts):
+                hit = self.cache.get(t)
+                if hit is None:
+                    miss_idx.append(i)
+                else:
+                    out[i] = hit
+        self._stage_cache_counts(n - len(miss_idx), len(miss_idx))
+        if miss_idx:
+            with telemetry.stage_timer("embed.ingest_encode"):
+                vecs, stats = self.encoder.encode_pipelined(
+                    [str(texts[i]) for i in miss_idx], sub_batch=self.sub_batch
+                )
+            telemetry.stage_add("embed.tokenize_s", stats["tokenize_s"])
+            telemetry.stage_add("embed.padded_tokens", stats["padded_tokens"])
+            telemetry.stage_add("embed.real_tokens", stats["real_tokens"])
+            self._pad_padded += stats["padded_tokens"]
+            self._pad_real += stats["real_tokens"]
+            for j, i in enumerate(miss_idx):
+                out[i] = vecs[j]
+                self.cache.put(texts[i], vecs[j])
+        return out
+
+    # -- query path ----------------------------------------------------------
+
+    def embed_query_rows(self, texts: List[str]) -> List[Any]:
+        """Per-row embedding values for the serving path. Cache hits return
+        host rows; misses coalesce with every other in-flight query into one
+        ``encode_device`` dispatch and return DEVICE-resident jax slices (the
+        downstream KNN kernel consumes either without an extra round trip)."""
+        rows: List[Any] = [None] * len(texts)
+        miss_idx: List[int] = []
+        for i, t in enumerate(texts):
+            hit = self.cache.get(t)
+            if hit is None:
+                miss_idx.append(i)
+            else:
+                rows[i] = hit
+        self._stage_cache_counts(len(texts) - len(miss_idx), len(miss_idx))
+        if miss_idx:
+            got = self.coalescer.embed([str(texts[i]) for i in miss_idx])
+            for i, v in zip(miss_idx, got):
+                rows[i] = v
+        return rows
+
+    def _encode_device_rows(self, texts: List[str]) -> List[Any]:
+        dev = self.encoder.encode_device(texts)
+        return [dev[i] for i in range(len(texts))]
+
+    def _fill_cache_from_device(self, texts: List[str], rows: Sequence[Any]) -> None:
+        """Runs on the coalescer worker AFTER responders are released: ONE
+        device→host fetch of the whole batch (restacked from the rows the
+        responders got — no hidden state shared with the encode call) fills
+        the cache without adding a sync to any query's latency."""
+        if self.cache.max_entries <= 0 or not texts:
+            return
+        import jax.numpy as jnp
+
+        host = np.asarray(jnp.stack(list(rows[: len(texts)])), dtype=np.float32)
+        for t, v in zip(texts, host):
+            self.cache.put(t, v)
+
+    def _stage_cache_counts(self, hits: int, misses: int) -> None:
+        """ONE batch-level telemetry add per counter per commit (the telemetry
+        module's stated granularity) instead of a global-lock hit per row."""
+        if self.cache.max_entries <= 0:
+            return  # cache disabled: keep telemetry consistent with stats()
+        if hits:
+            telemetry.stage_add("embed.cache_hits", hits)
+        if misses:
+            telemetry.stage_add("embed.cache_misses", misses)
+
+    # -- reporting -----------------------------------------------------------
+
+    def pad_waste_ratio(self) -> float:
+        """Fraction of encoded tokens that were padding (ingest path)."""
+        if self._pad_padded <= 0:
+            return 0.0
+        return 1.0 - self._pad_real / self._pad_padded
+
+    def stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        out.update(self.cache.stats())
+        out.update(self.coalescer.stats())
+        out["pad_waste_ratio"] = round(self.pad_waste_ratio(), 4)
+        return out

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pathway_tpu.internals.shapes import next_pow2 as _next_pow2_shared
+
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
 def _search_kernel(
@@ -49,11 +51,10 @@ def _search_kernel(
 def next_pow2(n: int) -> int:
     """Smallest power of two >= n (>= 1): the shape-bucketing unit — every
     jit'd search/scatter kernel sees pow2-padded batch shapes so its cache is
-    keyed by O(log) distinct buckets instead of one entry per raw size."""
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+    keyed by O(log) distinct buckets instead of one entry per raw size.
+    Delegates to the ONE shared rule in ``internals/shapes.py`` (also used by
+    the encoder and segment reductions)."""
+    return _next_pow2_shared(n, floor=1)
 
 
 def pad_queries_pow2(q_dev: jax.Array, dim: int) -> Tuple[jax.Array, int]:
@@ -118,9 +119,7 @@ def pad_pow2(slots: np.ndarray, vecs: "np.ndarray | None" = None, extras: "np.nd
     n = len(slots)
     if n == 0:
         return slots, vecs, extras
-    bucket = 8
-    while bucket < n:
-        bucket *= 2
+    bucket = _next_pow2_shared(n, floor=8)
     if bucket != n:
         pad = bucket - n
         slots = np.concatenate([slots, np.full(pad, slots[0], slots.dtype)])

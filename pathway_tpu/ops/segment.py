@@ -22,6 +22,8 @@ from typing import Any
 
 import numpy as np
 
+from pathway_tpu.internals.shapes import next_pow2 as _next_pow2
+
 # Below this, host↔device transfer dominates the reduction itself.
 _DEVICE_THRESHOLD = 1 << 15
 
@@ -47,10 +49,6 @@ def _jit_segment_sum(num_segments: int):
         return jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
 
     return kernel
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
 
 
 # Above this row count, a configured multi-shard mesh routes the reduction through

@@ -147,11 +147,25 @@ class DocumentStore:
                 expr.apply_with_type(_seen_ts, dt.Optional_(dt.INT), self.input_docs._metadata)
             ),
         )
+
+        def _payload(c: Any, m: Any, i: Any) -> Json:
+            payload = {"file_count": c or 0, "last_modified": m, "last_indexed": i}
+            # live embed-pipeline counters (cache hit/miss, coalescing, pad
+            # waste) when the embedder exposes them — read at answer time so
+            # /v1/statistics doubles as the serving-path observability endpoint
+            stats_fn = getattr(
+                getattr(self.retriever_factory, "embedder", None), "pipeline_stats", None
+            )
+            if stats_fn is not None:
+                try:
+                    payload["embedder"] = stats_fn()
+                except Exception:
+                    pass
+            return Json(payload)
+
         joined = info_queries.join_left(counted, id=info_queries.id).select(
             result=expr.apply_with_type(
-                lambda c, m, i: Json(
-                    {"file_count": c or 0, "last_modified": m, "last_indexed": i}
-                ),
+                _payload,
                 dt.JSON,
                 counted.count,
                 counted.last_modified,

@@ -22,13 +22,41 @@ from pathway_tpu.internals.udfs import (
 
 
 class BaseEmbedder(UDF):
+    # Embedders that know their output width up front set this (constructor
+    # table/kwarg) so graph build never pays a real encode of "." — for the
+    # API-backed embedders that probe was a NETWORK call (and an asyncio.run)
+    # per index construction.
+    _dimension: int | None = None
+
     def get_embedding_dimension(self, **kwargs: Any) -> int:
+        if self._dimension is not None and not kwargs:
+            return int(self._dimension)
         result = self.func(".", **kwargs)  # type: ignore[misc]
         import asyncio
 
         if asyncio.iscoroutine(result):
             result = asyncio.run(result)
         return len(result)
+
+
+# Output widths of the fixed-dimension API models (the reference docs' values):
+# consulted at graph-build time so known models skip the probe encode entirely.
+_KNOWN_EMBED_DIMS = {
+    "text-embedding-3-small": 1536,
+    "text-embedding-3-large": 3072,
+    "text-embedding-ada-002": 1536,
+    "models/embedding-001": 768,
+    "models/text-embedding-004": 768,
+}
+
+
+def _known_dim(model: str | None) -> int | None:
+    if model is None:
+        return None
+    # litellm routes as "provider/model": match on the tail as well
+    return _KNOWN_EMBED_DIMS.get(model) or _KNOWN_EMBED_DIMS.get(
+        model.rsplit("/", 1)[-1]
+    )
 
 
 class SentenceTransformerEmbedder(BaseEmbedder):
@@ -41,9 +69,19 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         call_kwargs: dict = {},
         device: str = "tpu",
         batch_size: int = 1024,
+        max_wait_ms: float = 2.0,
+        max_coalesce_batch: int = 256,
+        sub_batch: int = 128,
+        embed_cache_size: int = 50_000,
+        encoder_config: Any = None,
         **kwargs: Any,
     ):
+        """``max_wait_ms``/``max_coalesce_batch``: query-coalescer batch window;
+        ``sub_batch``: length-sorted ingest sub-batch rows; ``embed_cache_size``:
+        content-hash LRU entries (0 disables); ``encoder_config``: override
+        ``EncoderConfig`` (tests use a tiny architecture)."""
         super().__init__(**kwargs)
+        from pathway_tpu.models.embed_pipeline import EmbedPipeline
         from pathway_tpu.models.encoder import JaxSentenceEncoder
 
         if device not in ("tpu", None):
@@ -62,19 +100,27 @@ class SentenceTransformerEmbedder(BaseEmbedder):
                 "with no JAX equivalent; ignored",
                 stacklevel=2,
             )
-        self.encoder = JaxSentenceEncoder(model)
+        self.encoder = JaxSentenceEncoder(model, config=encoder_config)
         self.batch_size = batch_size
+        self.pipeline = EmbedPipeline(
+            self.encoder,
+            model=model,
+            max_wait_ms=max_wait_ms,
+            max_batch=max_coalesce_batch,
+            sub_batch=sub_batch,
+            cache_size=embed_cache_size,
+        )
 
         def embed_one(text: str) -> np.ndarray:
-            return self.encoder.encode([str(text)])[0]
+            return self.pipeline.encode_batch([str(text)])[0]
 
         self.func = embed_one
 
     def __call__(self, *args: Any, **kwargs: Any) -> expr.ColumnExpression:
-        encoder = self.encoder
+        pipeline = self.pipeline
 
         def embed_batch(texts: List[str]) -> List[np.ndarray]:
-            vectors = encoder.encode([str(t) for t in texts])
+            vectors = pipeline.encode_batch(texts)
             return [vectors[i] for i in range(len(texts))]
 
         return expr.BatchApplyExpression(
@@ -90,16 +136,20 @@ class SentenceTransformerEmbedder(BaseEmbedder):
     def device_expression(self, *args: Any, **kwargs: Any) -> expr.ColumnExpression:
         """Query-path variant: embedding cells are DEVICE-resident jax slices so
         downstream device kernels (KNN search) chain without a host round-trip.
+        Runs through the pipeline's content-hash cache and query coalescer, so
+        concurrent retrieve queries share one encoder dispatch and repeated
+        texts skip the forward entirely.
 
         Declared ``deterministic=False`` so the engine memoizes each query row's
         embedding and REPLAYS it on retraction (the rest connector's
         delete-completed-queries cleanup) instead of re-running the encoder — one
-        encode per query, with the memo entry popped on retraction."""
-        encoder = self.encoder
+        encode per query, with the memo entry popped on retraction. The content
+        cache sits BELOW that memo: it never answers retraction rows, it only
+        dedups forward work across distinct rows with equal text."""
+        pipeline = self.pipeline
 
         def embed_batch(texts: List[str]) -> List[Any]:
-            vectors = encoder.encode_device([str(t) for t in texts])
-            return [vectors[i] for i in range(len(texts))]
+            return pipeline.embed_query_rows([str(t) for t in texts])
 
         return expr.BatchApplyExpression(
             embed_batch,
@@ -110,6 +160,11 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             kwargs,
             max_batch_size=self.batch_size,
         )
+
+    def pipeline_stats(self) -> dict:
+        """Cache/coalescer/pad-waste counters (surfaced by
+        ``DocumentStore.statistics_query`` and the bench's embedpipe section)."""
+        return self.pipeline.stats()
 
     def get_embedding_dimension(self, **kwargs: Any) -> int:
         return self.encoder.dim
@@ -134,6 +189,12 @@ class OpenAIEmbedder(BaseEmbedder):
         )
         self.model = model
         self.kwargs = dict(openai_kwargs)
+        # graph build learns the dim WITHOUT a network call: an explicit
+        # ``dimensions=`` request (v3 models) wins, else the model table
+        if "dimensions" in self.kwargs:
+            self._dimension = int(self.kwargs["dimensions"])
+        else:
+            self._dimension = _known_dim(model)
         self.api_key = api_key
         self._client: Any = None
         self._client_loop: Any = None
@@ -179,6 +240,10 @@ class LiteLLMEmbedder(BaseEmbedder):
         )
         self.model = model
         self.kwargs = dict(litellm_kwargs)
+        if "dimensions" in self.kwargs:
+            self._dimension = int(self.kwargs["dimensions"])
+        else:
+            self._dimension = _known_dim(model)
 
         async def embed(input: str, **kwargs: Any) -> list:
             try:
@@ -211,6 +276,10 @@ class GeminiEmbedder(BaseEmbedder):
         )
         self.model = model
         self.kwargs = dict(genai_kwargs)
+        if "output_dimensionality" in self.kwargs:
+            self._dimension = int(self.kwargs["output_dimensionality"])
+        else:
+            self._dimension = _known_dim(model)
 
         async def embed(input: str, **kwargs: Any) -> list:
             try:
