@@ -692,6 +692,150 @@ def bench_streaming_window() -> dict:
     return {"window_rows_per_s": round(n / dt, 1), "window_updates": cnt[0]}
 
 
+def bench_telemetry() -> dict:
+    """Metrics-plane overhead: streaming wordcount with per-operator
+    profiling toggled PER COMMIT (even commits profiled, odd not) inside one
+    run, so machine noise — which on a cpu-shared host dwarfs the true
+    overhead at whole-run granularity (±20-50% between identical runs) —
+    decorrelates from the measurement: adjacent commits see the same machine.
+    Per-arm MEDIANS (durations are heavy-tailed), median-of-3 passes, GC off
+    during the measured run (allocation-triggered pauses otherwise land on
+    one parity), and a NULL calibration (same toggle bookkeeping, profiling
+    off for both parities) subtracted to cancel the estimator's own parity
+    bias. Contract: <2% commit-throughput delta on the headline regime.
+
+    Two regimes: headline ``telemetry_overhead_pct`` on engine-bench-sized
+    commits (~8k rows, multi-ms — what production batches look like;
+    lands <1% + measurement floor), and
+    ``telemetry_overhead_small_commits_pct`` on sub-millisecond few-hundred-
+    row commits — the ADVERSARIAL bound where fixed per-commit bookkeeping
+    (~18 µs measured standalone: per-op perf_counter pairs + one-pass
+    retraction counts + the ring/fold appends) is largest relative to real
+    work; expect a few percent there, by design of the regime. CPU-vs-CPU on
+    any host, no device keys. Also reports the profiled commits' duration
+    percentiles from the live log-bucketed histogram (what /metrics serves,
+    measured not mocked)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.profile import get_profiler, reset_profile
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals import parse_graph as pg
+
+    rng = np.random.default_rng(11)
+    words_pool = np.array([f"word{i}" for i in range(4_000)])
+
+    class ToggleRunner(GraphRunner):
+        """Profiling on for even commits, off for odd — the per-commit A/B.
+        With ``null=True`` profiling is off for BOTH parities while commits
+        are still classified even/odd: that run measures the estimator's own
+        parity bias (allocator drift, cache effects, throttle phase), which
+        is subtracted from the toggle estimate."""
+
+        def __init__(self, graph, *, null: bool = False):
+            super().__init__(graph)
+            self.null = null
+            self.durations_on: list = []
+            self.durations_off: list = []
+
+        def step(self) -> bool:
+            even = self._commit % 2 == 0
+            profiled = even and not self.null
+            saved = self._profiler
+            if not profiled:
+                self._profiler = None
+            t0 = time.perf_counter()
+            try:
+                out = super().step()
+            finally:
+                dt = time.perf_counter() - t0
+                self._profiler = saved
+            (self.durations_on if even else self.durations_off).append(dt)
+            return out
+
+    def typical(values: list) -> float:
+        """Median: commit durations are heavy-tailed (GC, scheduler, state
+        growth spikes run 5-10x the median) and the overhead under test is
+        percent-level — a mean would be set by the tail, not the signal."""
+        values = sorted(values)
+        mid = len(values) // 2
+        return values[mid] if len(values) % 2 else (values[mid - 1] + values[mid]) / 2
+
+    def measure(n: int, n_commits: int, *, null: bool = False) -> tuple:
+        import gc
+
+        per = n // n_commits
+        words = words_pool[rng.integers(0, len(words_pool), n)]
+        rows = [(w, 2 * (i // per), 1) for i, w in enumerate(words.tolist())]
+        pg.G.clear()
+        tbl = pw.debug.table_from_rows(
+            pw.schema_builder({"word": str}), rows, is_stream=True
+        )
+        out = tbl.groupby(pw.this.word).reduce(pw.this.word, cnt=pw.reducers.count())
+        pw.io.subscribe(out, on_batch=lambda *a: None)
+        runner = ToggleRunner(pg.G._current, null=null)
+        # GC pauses (~100 µs) are allocation-count-triggered: the profiled
+        # arm's slightly higher allocation rate SHIFTS which parity pays
+        # them, turning GC timing into a systematic A/B bias either way.
+        # Collect up front, keep GC off for the measured run.
+        gc.collect()
+        gc.disable()
+        try:
+            runner.run(monitoring_level=pw.MonitoringLevel.NONE)
+        finally:
+            gc.enable()
+        # drop per-arm warmup (first profiled + first unprofiled commit pay
+        # first-touch costs) before the medians
+        on_mean = typical(runner.durations_on[1:])
+        off_mean = typical(runner.durations_off[1:])
+        return (on_mean - off_mean) / off_mean * 100.0, on_mean, off_mean
+
+    def calibrated(n: int, n_commits: int) -> tuple:
+        """Bias-corrected overhead: median-of-3 toggle passes MINUS
+        median-of-3 null passes (same runner, profiling off for both
+        parities). The null measures everything the estimator picks up that
+        is NOT profiling — even/odd parity bias from allocator drift, cache
+        phase, and the host's cpu-share throttle — which in this container
+        runs ±1-3%, the same order as the effect under test."""
+        toggles = sorted(measure(n, n_commits) for _ in range(3))
+        nulls = sorted(measure(n, n_commits, null=True)[0] for _ in range(3))
+        pct, on_t, off_t = toggles[1]
+        return pct - nulls[1], on_t, off_t
+
+    prev = os.environ.get("PATHWAY_PROFILE")
+    os.environ["PATHWAY_PROFILE"] = "1"
+    try:
+        scale = 4 if SMOKE else 1
+        reset_profile()
+        # representative: engine-bench-sized commit batches (~8k rows/commit,
+        # multi-ms commits) — the regime the <2% contract is about; per-commit
+        # bookkeeping (~18 µs measured standalone) amortizes to well under 1%
+        rep_n = 400_000 if SMOKE else 800_000
+        rep_pct, rep_on, rep_off = calibrated(rep_n, rep_n // 8_000)
+        totals = get_profiler().operator_totals()  # folds pending profiles
+        pct = get_profiler().commit_hist.percentiles()
+        # by NAME, like the flight-recorder summary and /v1/statistics — kind
+        # alone cannot distinguish two groupby nodes
+        slowest = max(totals, key=lambda e: e["seconds"])["name"] if totals else ""
+        reset_profile()
+        # adversarial: the regime is DEFINED by its ~500-row sub-ms commits —
+        # scaling rows down further would measure a regime nothing runs in
+        small_pct, _on, _off = calibrated(200_000 // scale, 400 // scale)
+    finally:
+        if prev is None:
+            os.environ.pop("PATHWAY_PROFILE", None)
+        else:
+            os.environ["PATHWAY_PROFILE"] = prev
+    reset_profile()
+    return {
+        "telemetry_overhead_pct": round(rep_pct, 2),
+        "telemetry_overhead_small_commits_pct": round(small_pct, 2),
+        "telemetry_profiled_commit_ms": round(rep_on * 1000, 3),
+        "telemetry_unprofiled_commit_ms": round(rep_off * 1000, 3),
+        "telemetry_commit_p50_ms": round(pct["p50"] * 1000, 3),
+        "telemetry_commit_p99_ms": round(pct["p99"] * 1000, 3),
+        "telemetry_slowest_operator": slowest,
+    }
+
+
 def bench_engine() -> dict:
     """Streaming wordcount + incremental join vs vectorized-numpy CPU proxies
     maintaining identical per-commit results (VERDICT round-2 item 1).
@@ -1136,6 +1280,7 @@ SUB_BENCHES: dict = {
     "embedpipe": lambda: bench_embedpipe(),
     "window": lambda: bench_streaming_window(),
     "engine": lambda: bench_engine(),
+    "telemetry": lambda: bench_telemetry(),
     "vectorstore": lambda: bench_vector_store(),
     "vsfloor": lambda: bench_vs_floor(),
     "sharded": lambda: bench_sharded(),
@@ -1152,11 +1297,13 @@ DEVICE_BOUND = {"knn", "embedder", "embedpipe", "vectorstore", "scale"}
 # per-sub-bench wall deadlines (seconds): generous on device, tight at toy scale
 _DEADLINES_FULL = {
     "knn": 600, "ivfscale": 900, "embedder": 420, "embedpipe": 600, "window": 300,
-    "engine": 600, "vectorstore": 600, "vsfloor": 300, "sharded": 660, "scale": 1500,
+    "engine": 600, "telemetry": 420, "vectorstore": 600, "vsfloor": 300,
+    "sharded": 660, "scale": 1500,
 }
 _DEADLINES_SMALL = {
     "knn": 300, "ivfscale": 900, "embedder": 240, "embedpipe": 420, "window": 300,
-    "engine": 600, "vectorstore": 300, "vsfloor": 300, "sharded": 660, "scale": 420,
+    "engine": 600, "telemetry": 420, "vectorstore": 300, "vsfloor": 300,
+    "sharded": 660, "scale": 420,
 }
 
 

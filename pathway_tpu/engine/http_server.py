@@ -15,6 +15,20 @@ from typing import Any, Dict, Optional
 DEFAULT_MONITORING_HTTP_PORT = 20000
 
 
+def _escape_label(value: str) -> str:
+    """OpenMetrics label-value escaping (backslash, quote, newline)."""
+    return (
+        str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _format_value(value: float) -> str:
+    """Ints render bare; floats keep full precision via repr."""
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
 class ProberStats:
     """Shared run statistics, updated by the commit loop (reference ``graph.rs:554``)."""
 
@@ -61,6 +75,12 @@ class ProberStats:
             return self._latencies_locked(now)
 
     def to_openmetrics(self) -> str:
+        """Full metrics plane as one OpenMetrics exposition: the run-level
+        gauges/counters, every stage counter (exchange bytes/frames, barrier
+        waits, embed pipeline, …) as a ``stage``-labeled counter family,
+        per-operator wall-time/row/retraction totals labeled by operator
+        name/kind, and every registered log-bucketed histogram (commit
+        duration, REST latency) as a real histogram family."""
         now = time.time()
         with self.lock:
             input_latency, output_latency = self._latencies_locked(now)
@@ -71,17 +91,60 @@ class ProberStats:
                 "# HELP output_latency_ms A latency of output in milliseconds (-1 when finished)",
                 "# TYPE output_latency_ms gauge",
                 f"output_latency_ms {output_latency}",
-                "# HELP input_rows_total Rows ingested by input connectors",
-                "# TYPE input_rows_total counter",
+                "# HELP input_rows A counter of rows ingested by input connectors",
+                "# TYPE input_rows counter",
                 f"input_rows_total {self.input_rows}",
-                "# HELP output_rows_total Rows delivered to sinks",
-                "# TYPE output_rows_total counter",
+                "# HELP output_rows A counter of rows delivered to sinks",
+                "# TYPE output_rows counter",
                 f"output_rows_total {self.output_rows}",
-                "# HELP commits_total Engine commits executed",
-                "# TYPE commits_total counter",
+                "# HELP commits A counter of engine commits executed",
+                "# TYPE commits counter",
                 f"commits_total {self.commits}",
-                "# EOF",
             ]
+        from pathway_tpu.engine import profile as _profile
+        from pathway_tpu.engine import telemetry as _telemetry
+
+        stages = _telemetry.stage_snapshot()
+        if stages:
+            lines.append(
+                "# HELP pathway_stage Cumulative in-process stage counters "
+                "(keys ending _s are seconds)"
+            )
+            lines.append("# TYPE pathway_stage counter")
+            for name in sorted(stages):
+                lines.append(
+                    f'pathway_stage_total{{stage="{_escape_label(name)}"}} '
+                    f"{_format_value(stages[name])}"
+                )
+        totals = _profile.get_profiler().operator_totals()
+        if totals:
+            for family, key, help_text in (
+                ("pathway_operator_seconds", "seconds", "Wall seconds per operator"),
+                ("pathway_operator_rows", "rows", "Delta rows emitted per operator"),
+                (
+                    "pathway_operator_retractions",
+                    "retractions",
+                    "Retraction rows emitted per operator",
+                ),
+            ):
+                lines.append(f"# HELP {family} {help_text}")
+                lines.append(f"# TYPE {family} counter")
+                for entry in totals:
+                    lines.append(
+                        f'{family}_total{{operator="{_escape_label(entry["name"])}"'
+                        f',kind="{_escape_label(entry["kind"])}"'
+                        f',node="{entry["node"]}"}} '
+                        f"{_format_value(entry[key])}"
+                    )
+        hists = _profile.histograms()
+        for hist_name in sorted(hists):
+            hist = hists[hist_name]
+            if hist.count == 0:
+                continue
+            lines.extend(
+                hist.openmetrics_lines(hist_name, f"Log-bucketed {hist_name}")
+            )
+        lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 
@@ -108,7 +171,11 @@ class MonitoringServer:
                     try:
                         payload = source() if source is not None else {}
                     except Exception as exc:  # a probe must never 500 a worker
-                        payload = {"error": str(exc)}
+                        # ...but a failing probe callback is NOT healthy
+                        # either: keep HTTP 200 + alive (the process serves),
+                        # and surface the degradation instead of masking it
+                        # behind a synthetic "running"
+                        payload = {"error": str(exc), "state": "degraded"}
                     payload.setdefault("alive", True)
                     # degraded-cluster observability: the runner reports
                     # "fencing"/"rejoining" during a surgical restart, plus

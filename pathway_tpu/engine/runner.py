@@ -13,7 +13,10 @@ import os
 import time as time_mod
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from pathway_tpu.engine.columnar import Delta, StateTable
+from pathway_tpu.engine.profile import CommitProfile
 from pathway_tpu.internals import parse_graph as pg
 
 
@@ -58,6 +61,11 @@ class GraphRunner:
         self._rejoins = 0
         self._last_rejoin_s: "float | None" = None
         self._rejoin_state = "running"  # "running" | "fencing" | "rejoining"
+        # metrics plane (engine/profile.py): per-operator commit profiles +
+        # the crash/stall flight recorder; None in nested iterate runners
+        self._profiler: Any = None
+        self._recorder: Any = None
+        self._profile_ops: "List[tuple] | None" = None
 
     def state_of(self, node: pg.Node) -> StateTable:
         if node.id not in self._materialized:
@@ -167,6 +175,17 @@ class GraphRunner:
             not self._materialize_all
             and _os.environ.get("PATHWAY_RESTART_MODE") == "surgical"
         )
+        if not self._materialize_all:
+            # nested iterate runners share the outer commit's clock; profiling
+            # them would double-count their wall time under the outer operator
+            from pathway_tpu.engine import profile as _profile
+
+            if _profile.profiling_enabled():
+                self._profiler = _profile.get_profiler()
+            self._recorder = _profile.get_flight_recorder()
+            self._recorder.configure(
+                rank=self._rank, default_dir=self._supervise_dir
+            )
         if self._cluster is not None:
             bad = sorted(
                 {n.kind for n in self.graph.nodes if n.kind in self._CLUSTER_UNSUPPORTED}
@@ -667,8 +686,8 @@ class GraphRunner:
                 ):
                     if self._take_checkpoint():
                         self._last_checkpoint = time_mod.monotonic()
+        input_rows = sum(len(d) for d in self._input_deltas.values())
         if self.prober_stats is not None:
-            input_rows = sum(len(d) for d in self._input_deltas.values())
             self.prober_stats.record_commit(
                 input_rows,
                 self._output_rows_this_commit,
@@ -681,6 +700,20 @@ class GraphRunner:
                     self._output_rows_this_commit,
                     time_mod.monotonic() - commit_t0,
                 )
+        if self._profiler is not None:
+            commit_profile = CommitProfile(
+                commit=self._commit,
+                rank=self._rank,
+                duration_s=time_mod.monotonic() - commit_t0,
+                input_rows=input_rows,
+                output_rows=self._output_rows_this_commit,
+                neu=neu,
+                ops=self._profile_ops or [],
+            )
+            self._profiler.record_commit(commit_profile)
+            if self._recorder is not None:
+                self._recorder.record_commit(commit_profile)
+            self._profile_ops = None
         if self._monitor is not None:
             self._monitor.update(self._commit, self._step_counts, self.states)
         if self._supervise_dir is not None:
@@ -720,14 +753,27 @@ class GraphRunner:
         if not neu:
             self._step_counts = {}
             self._output_rows_this_commit = 0
+            self._profile_ops = [] if self._profiler is not None else None
         deltas: Dict[int, Delta] = {}
         self._substep_deltas = deltas
         any_output = False
         from pathway_tpu.engine import expression_evaluator as ee_mod
 
+        profile_ops = self._profile_ops
+        runtime = ee_mod.get_runtime()
         for node in self._nodes:
             evaluator = self.evaluators[node.id]
-            ee_mod.get_runtime()["node"] = node
+            runtime["node"] = node
+            # commit identity for UDFs that read live process-global state
+            # (the /v1/statistics engine snapshot): re-derivations WITHIN one
+            # commit must see the same value (a value that moved between two
+            # evaluations churns nondeterministic update pairs), while the
+            # next commit reads fresh — retraction rows of later commits are
+            # covered by the evaluator's memoize-on-retraction, not by this.
+            # Set per node because nested iterate runners share this
+            # thread-local and overwrite it mid-substep.
+            runtime["commit_token"] = (id(self), self._commit)
+            _t_op = time_mod.perf_counter() if profile_ops is not None else 0.0
             if (
                 isinstance(node, pg.OutputNode)
                 and not neu
@@ -829,6 +875,23 @@ class GraphRunner:
                 self._step_counts[node.id] = self._step_counts.get(node.id, 0) + len(delta)
                 if node.output is not None and node.id in self._materialized:
                     self.states[node.id].apply(delta)
+            if profile_ops is not None:
+                rows = len(delta)
+                # count_nonzero: ONE pass over diffs (a min() pre-check reads
+                # the array twice on the update-heavy deltas that dominate
+                # steady state, doubling the per-op profiling cost)
+                retractions = (
+                    int(np.count_nonzero(delta.diffs < 0)) if rows else 0
+                )
+                profile_ops.append((
+                    node.id,
+                    node.name,
+                    node.kind,
+                    time_mod.perf_counter() - _t_op,
+                    rows,
+                    retractions,
+                    neu,
+                ))
         return any_output
 
     def _route_cluster_inputs(
@@ -928,6 +991,16 @@ class GraphRunner:
             self._commit,
             exc,
         )
+        if self._recorder is not None:
+            # the interrupted commit is the post-mortem's subject: dump before
+            # the rollback resets state (a failed rejoin dies typed after this)
+            self._recorder.record_event(
+                "fence",
+                commit=self._commit,
+                epoch=getattr(cluster, "epoch", 0),
+                error=str(exc),
+            )
+            self._recorder.dump("fence")
         # preserve the interrupted commit's drained input rows IFF its journal
         # frame never made it to disk — journaled rows replay from the journal,
         # carrying them too would double-ingest
@@ -979,6 +1052,12 @@ class GraphRunner:
         self._rejoins += 1
         self._last_rejoin_s = time_mod.monotonic() - t0
         self._rejoin_state = "running"
+        if self._recorder is not None:
+            self._recorder.record_event(
+                "rejoin",
+                epoch=getattr(cluster, "epoch", 0),
+                duration_s=self._last_rejoin_s,
+            )
         self._publish_status(force=True)
         log.warning(
             "rank %d: rejoined the cluster at epoch %d in %.2fs (resuming at "
@@ -1226,6 +1305,32 @@ class GraphRunner:
         StreamingDataSource.register_runner(wake)
         from pathway_tpu.parallel.cluster import PeerShutdownError, PeerTimeoutError
 
+        # flight-recorder SIGTERM hook: a supervisor stall-kill (SIGTERM grace
+        # before SIGKILL) or operator shutdown leaves a dump behind. Main
+        # thread only — signal.signal raises ValueError elsewhere.
+        import signal as _signal
+
+        _prev_term: Any = None
+        _installed_term = False
+        if self._recorder is not None and self._recorder.enabled:
+            def _on_term(signum: int, frame: Any) -> None:
+                self._recorder.dump("sigterm")
+                # chain: restore whatever was there — including SIG_IGN (a
+                # process that deliberately ignored SIGTERM must keep
+                # ignoring it) — and re-raise so the previous disposition
+                # (default termination, operator handler, or ignore) applies
+                _signal.signal(
+                    _signal.SIGTERM,
+                    _prev_term if _prev_term is not None else _signal.SIG_DFL,
+                )
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+            try:
+                _prev_term = _signal.signal(_signal.SIGTERM, _on_term)
+                _installed_term = True
+            except ValueError:
+                pass  # not the main thread
+
         commits = 0
         try:
             with span("graph_runner.run"):
@@ -1283,11 +1388,18 @@ class GraphRunner:
             # in the finally block fires their on_end either way
             from pathway_tpu.engine.evaluators import OutputEvaluator
 
+            if self._recorder is not None:
+                self._recorder.dump(f"crash: {type(exc).__name__}")
             for evaluator in self.evaluators.values():
                 if isinstance(evaluator, OutputEvaluator):
                     evaluator.notify_failure(exc)
             raise
         finally:
+            if _installed_term:
+                try:
+                    _signal.signal(_signal.SIGTERM, _prev_term)
+                except (ValueError, TypeError):
+                    pass
             StreamingDataSource.unregister_runner(wake)
             runtime.update(prev_runtime)
             if max_commits is None:

@@ -77,6 +77,15 @@ def stage_add(name: str, value: float = 1.0) -> None:
         _stage_counters[name] = _stage_counters.get(name, 0.0) + value
 
 
+def stage_add_many(updates: Dict[str, float]) -> None:
+    """Fold several counter increments under ONE lock acquisition — the
+    exchange layer bumps bytes+frames+waits per barrier and must not pay a
+    lock round-trip per key."""
+    with _stage_lock:
+        for name, value in updates.items():
+            _stage_counters[name] = _stage_counters.get(name, 0.0) + value
+
+
 @contextlib.contextmanager
 def stage_timer(name: str) -> Iterator[None]:
     """Accumulate wall seconds under ``<name>_s`` and bump ``<name>_calls``."""
@@ -160,6 +169,10 @@ class MetricsRecorder:
             import psutil
 
             process = psutil.Process()
+            # prime the cpu clock: cpu_percent(interval=None) measures SINCE
+            # the previous call, so an unprimed first sample reports 0.0 for
+            # the whole first export interval
+            process.cpu_percent(interval=None)
 
             def _mem_cb(_options: Any) -> list:
                 from opentelemetry.metrics import Observation

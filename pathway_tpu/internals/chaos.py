@@ -122,6 +122,20 @@ class Chaos:
                 and (want_epoch is None or int(want_epoch) == int(epoch))
             ):
                 self.stats["kills"] += 1
+                # SIGKILL is uncatchable, so the flight recorder dumps HERE —
+                # the injected death is the one failure mode that can leave a
+                # complete black box behind (deferred import: internals-layer
+                # modules must stay light at module load)
+                try:
+                    from pathway_tpu.engine.profile import get_flight_recorder
+
+                    recorder = get_flight_recorder()
+                    recorder.record_event(
+                        "chaos_kill", rank=rank, commit=commit_id, epoch=epoch
+                    )
+                    recorder.dump("chaos_kill")
+                except Exception:
+                    pass  # the kill must fire regardless
                 os.kill(os.getpid(), signal.SIGKILL)
 
     # -- rejoin handshakes -----------------------------------------------------
@@ -144,6 +158,7 @@ class Chaos:
             if want_run is not None and int(want_run) != self.run_count:
                 continue
             self.stats["rejoins_dropped"] += 1
+            self._record_injection("chaos_rejoin_drop", rank=rank, run=self.run_count)
             return True
         return False
 
@@ -162,14 +177,27 @@ class Chaos:
         delay = float(self._frames.get("delay_prob", 0.0))
         if roll < drop:
             self.stats["frames_dropped"] += 1
+            self._record_injection("chaos_frame_drop", rank=rank, peer=peer)
             return _FrameAction("drop")
         if roll < drop + trunc:
             self.stats["frames_truncated"] += 1
+            self._record_injection("chaos_frame_truncate", rank=rank, peer=peer)
             return _FrameAction("truncate")
         if roll < drop + trunc + delay:
             self.stats["frames_delayed"] += 1
             return _FrameAction("delay", float(self._frames.get("delay_ms", 10)) / 1000.0)
         return _PASS
+
+    @staticmethod
+    def _record_injection(kind: str, **details: Any) -> None:
+        """Destructive injections land in the flight recorder's event ring so
+        a dump distinguishes injected faults from organic ones."""
+        try:
+            from pathway_tpu.engine.profile import get_flight_recorder
+
+            get_flight_recorder().record_event(kind, **details)
+        except Exception:
+            pass
 
     # -- persistence backends --------------------------------------------------
 

@@ -2,7 +2,14 @@
 
 Parity: reference ``internals/monitoring.py`` — a rich-powered live terminal dashboard
 (operator latencies, connector counts, ``:56-190``) with ``MonitoringLevel`` (``:228``)
-controlling detail. Falls back to plain stderr lines off-tty or without rich.
+controlling detail. Falls back to plain stderr lines off-tty or without rich —
+the plain path runs whenever the rich live display is unavailable (no tty, no
+rich, a broken console), so redirected/CI runs still see throttled progress.
+
+The dashboard reads the engine's per-operator profile totals
+(``engine/profile.py``): each operator row shows cumulative wall seconds and
+rows/s next to the row counters, so "which operator is slow" is answerable
+from the live view, not only from ``/metrics``.
 """
 
 from __future__ import annotations
@@ -56,22 +63,48 @@ class StatsMonitor:
                 out.append(node)
         return out
 
+    def _profile_totals(self) -> Dict[tuple, dict]:
+        """Per-operator cumulative seconds from the engine profiler, keyed by
+        the full (node_id, name, kind) triple — node ids restart at 0 for
+        every graph in the process, so an id-only key would show another
+        graph's operator seconds. Empty when profiling is off (the dashboard
+        then shows zeros, not a crash)."""
+        try:
+            from pathway_tpu.engine.profile import get_profiler
+
+            return {
+                (e["node"], e["name"], e["kind"]): e
+                for e in get_profiler().operator_totals()
+            }
+        except Exception:
+            return {}
+
     def _render(self, commit: int) -> Any:
         from rich.table import Table
 
+        elapsed = max(time.monotonic() - self.start, 1e-9)
+        totals = self._profile_totals()
         table = Table(title=f"pathway_tpu run — commit {commit}")
         table.add_column("operator")
         table.add_column("kind")
         table.add_column("rows in latest commit", justify="right")
         table.add_column("rows total", justify="right")
+        table.add_column("time (s)", justify="right")
+        table.add_column("rows/s", justify="right")
         for node in self._interesting_nodes():
+            rows_total = self.counts.get(node.id, 0)
+            seconds = totals.get(
+                (node.id, node.name, node.kind), {}
+            ).get("seconds", 0.0)
             table.add_row(
                 node.name,
                 node.kind,
                 str(self.latest_commit_rows.get(node.id, 0)),
-                str(self.counts.get(node.id, 0)),
+                str(rows_total),
+                f"{seconds:.3f}",
+                f"{rows_total / elapsed:.1f}",
             )
-        table.caption = f"elapsed {time.monotonic() - self.start:.1f}s"
+        table.caption = f"elapsed {elapsed:.1f}s"
         return table
 
     def update(
@@ -91,12 +124,25 @@ class StatsMonitor:
                     self._live.update(self._render(commit))
                 except Exception:
                     pass
-        elif now - self._last_print > 1.0 and sys.stderr.isatty():
+        elif now - self._last_print > 1.0:
+            # plain-line fallback whenever the rich live display is not
+            # running — including redirected/non-tty stderr (CI logs), which
+            # previously got NOTHING despite the module contract
             self._last_print = now
             total = sum(self.counts.values())
+            elapsed = max(now - self.start, 1e-9)
+            slowest = ""
+            totals = self._profile_totals()
+            if totals:
+                worst = max(totals.values(), key=lambda e: e["seconds"])
+                if worst["seconds"] > 0:
+                    slowest = (
+                        f" slowest={worst['name']}:{worst['seconds']:.2f}s"
+                    )
             print(
                 f"[pathway-tpu] commit={commit} rows_processed={total} "
-                f"elapsed={now - self.start:.1f}s",
+                f"rows_per_s={total / elapsed:.1f} "
+                f"elapsed={elapsed:.1f}s{slowest}",
                 file=sys.stderr,
             )
 

@@ -10,9 +10,11 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from typing import Any, Dict, Optional, Sequence
 
 from pathway_tpu.engine.datasource import StreamingDataSource
+from pathway_tpu.engine.profile import histogram as _histogram
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import parse_graph as pg
 from pathway_tpu.internals import schema as sch
@@ -288,8 +290,14 @@ class RestServerSubject:
                 if col.dtype.strip_optional() == dt.JSON and v is not None and not isinstance(v, Json):
                     v = Json(v)
                 row[name] = v
+            t0 = time.perf_counter()
             source.push(row, key=key, diff=1)
             result = await future
+            # the serving-path latency histogram (/metrics exports it next to
+            # commit duration): push -> engine commit -> future resolution
+            _histogram("pathway_rest_latency_seconds").observe(
+                time.perf_counter() - t0
+            )
             self.futures.pop(kb, None)
             if self.delete_completed_queries:
                 source.push(row, key=key, diff=-1)

@@ -30,6 +30,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from pathway_tpu.engine.profile import get_flight_recorder as _flight_recorder
+from pathway_tpu.engine.telemetry import (
+    stage_add as _stage_add,
+    stage_add_many as _stage_add_many,
+)
 from pathway_tpu.internals.config import env_float as _env_float
 
 # control frame: liveness beacon, never enters the inbox (and never counts
@@ -394,6 +399,13 @@ class ClusterExchange:
                 tag_len, payload_len, frame_epoch = self._HDR.unpack(hdr)
                 tag = self._recv_exact(conn, tag_len)
                 payload = self._recv_exact(conn, payload_len) if payload_len else b""
+                if tag != HEARTBEAT_TAG:
+                    _stage_add_many({
+                        f"exchange.peer{peer}.bytes_received": float(
+                            self._HDR.size + tag_len + payload_len
+                        ),
+                        f"exchange.peer{peer}.frames_received": 1.0,
+                    })
                 with self._cv:
                     self._last_heard[peer] = time.monotonic()
                     if tag == HEARTBEAT_TAG:
@@ -409,6 +421,13 @@ class ClusterExchange:
                                 ranks = []
                             self._fence_dead.update(int(r) for r in ranks)
                             self._fence_pending = True
+                            _stage_add("cluster.fences_received")
+                            _flight_recorder().record_event(
+                                "fence_received",
+                                from_peer=peer,
+                                dead_ranks=sorted(self._fence_dead),
+                                epoch=self.epoch,
+                            )
                             self._cv.notify_all()
                         continue
                     # bounded inbox: park until the consumer drains (the unread
@@ -481,6 +500,13 @@ class ClusterExchange:
         try:
             with self._send_locks[peer]:
                 conn.sendall(frame)
+            if tag != HEARTBEAT_TAG:
+                # per-peer traffic accounting (heartbeats excluded — 1 Hz
+                # beacons would drown the data-frame signal)
+                _stage_add_many({
+                    f"exchange.peer{peer}.bytes_sent": float(len(frame)),
+                    f"exchange.peer{peer}.frames_sent": 1.0,
+                })
         except OSError as exc:
             timed_out = isinstance(exc, (socket.timeout, BlockingIOError))
             with self._cv:
@@ -535,6 +561,13 @@ class ClusterExchange:
                     and heard is not None
                     and now - heard > self.heartbeat_timeout_s
                 ):
+                    _stage_add("cluster.peer_stale_trips")
+                    _flight_recorder().record_event(
+                        "peer_stale",
+                        peer=peer,
+                        tag=tag.decode("utf-8", "replace"),
+                        stale_s=round(now - heard, 3),
+                    )
                     raise PeerTimeoutError(
                         f"cluster peer {peer} heartbeat is {now - heard:.1f}s "
                         f"stale (> {self.heartbeat_timeout_s:.0f}s) while process "
@@ -542,6 +575,13 @@ class ClusterExchange:
                     )
                 remaining = deadline - now
                 if remaining <= 0:
+                    _stage_add("cluster.barrier_timeouts")
+                    _flight_recorder().record_event(
+                        "barrier_timeout",
+                        peer=peer,
+                        tag=tag.decode("utf-8", "replace"),
+                        timeout_s=timeout,
+                    )
                     raise PeerTimeoutError(
                         f"cluster process {self.me} timed out after "
                         f"{timeout:.0f}s waiting for {tag!r} from peer {peer}"
@@ -591,6 +631,10 @@ class ClusterExchange:
         learns about the fence from its own typed error."""
         with self._cv:
             dead = sorted(set(self._dead) | self._fence_dead)
+        _stage_add("cluster.fence_broadcasts")
+        _flight_recorder().record_event(
+            "fence_broadcast", dead_ranks=dead, epoch=self.epoch
+        )
         payload = pickle.dumps(dead, protocol=pickle.HIGHEST_PROTOCOL)
         for peer in list(self._conns):
             if peer in dead:
@@ -684,6 +728,12 @@ class ClusterExchange:
                     self._start_reader(rank, conn)
                     if self.heartbeat_interval_s > 0:
                         self._start_heartbeat(rank)
+                _stage_add("cluster.rejoins_installed")
+                _flight_recorder().record_event(
+                    "rejoin_installed",
+                    ranks=sorted(installed),
+                    epoch=self.epoch,
+                )
                 return self.epoch
             if on_wait is not None:
                 on_wait()
@@ -695,10 +745,44 @@ class ClusterExchange:
 
         Raises :class:`PeerShutdownError` when a peer's link died, or
         :class:`PeerTimeoutError` when a peer missed the barrier deadline or
-        went heartbeat-stale — never blocks forever on a dead peer."""
+        went heartbeat-stale — never blocks forever on a dead peer.
+
+        Straggler attribution: the peer whose frame this process BLOCKED on
+        longest arrived last (frames already inboxed cost ~0), so per-barrier
+        wait seconds and a per-peer straggler count land in the stage
+        counters; the flight recorder's ``note_barrier`` marks the tag in
+        flight so a death mid-barrier names it in the dump."""
+        recorder = _flight_recorder()
         for peer in self._conns:
             self._send(peer, tag, parts.get(peer, b""))
-        return {peer: self._recv(peer, tag) for peer in self._conns}
+        recorder.note_barrier(tag)
+        t0 = time.perf_counter()
+        out: Dict[int, bytes] = {}
+        slowest_peer = -1
+        slowest_wait = 0.0
+        for peer in self._conns:
+            w0 = time.perf_counter()
+            out[peer] = self._recv(peer, tag)
+            wait = time.perf_counter() - w0
+            if wait > slowest_wait:
+                slowest_wait = wait
+                slowest_peer = peer
+        updates = {
+            "exchange.barriers": 1.0,
+            "exchange.barrier_wait_s": time.perf_counter() - t0,
+        }
+        if slowest_peer >= 0 and slowest_wait > 0.001:
+            # only meaningful blocking attributes a straggler: an inboxed
+            # frame's ~µs pop must not smear the attribution
+            updates[f"exchange.straggler.peer{slowest_peer}"] = 1.0
+            updates[f"exchange.peer{slowest_peer}.straggler_wait_s"] = slowest_wait
+        _stage_add_many(updates)
+        # cleared on SUCCESS only: when a recv raises (peer death, barrier
+        # timeout) the mark must survive the unwind — the fence/crash dump's
+        # summary names this tag as the pending barrier, and the next
+        # successful barrier overwrites it anyway
+        recorder.note_barrier(None)
+        return out
 
     def allgather(self, tag: bytes, value: Any) -> List[Any]:
         """Every process contributes ``value``; all receive the full list (by rank)."""

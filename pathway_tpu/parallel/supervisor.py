@@ -377,8 +377,23 @@ class Supervisor:
             time.sleep(self.poll_interval_s)
 
     def _kill_wedged(self, rank: int, handle: subprocess.Popen) -> None:
+        """Stall-kill: SIGTERM first with a short grace so the worker's
+        flight-recorder SIGTERM hook can dump its black box (a rank wedged in
+        a barrier ``Condition.wait`` still runs Python signal handlers), then
+        SIGKILL — the wedge bound already expired, this must not hang."""
         self._terminated_by_us.add(rank)
         self._killed_for_staleness.add(rank)
+        try:
+            handle.terminate()
+        except OSError:
+            pass
+        try:
+            handle.wait(
+                timeout=_env_float("PATHWAY_SUPERVISOR_TERM_GRACE_S", 2.0)
+            )
+            return
+        except subprocess.TimeoutExpired:
+            pass  # truly wedged (stuck in C); no dump will come
         try:
             handle.kill()
         except OSError:
@@ -386,6 +401,35 @@ class Supervisor:
         handle.wait()
 
     # -- reporting -------------------------------------------------------------
+
+    def _flight_dump_line(self, rank: int) -> "Optional[str]":
+        """Locate rank's flight-recorder dump and render the one-line summary
+        (last commit, slowest operator, pending barrier). Dumps written into
+        the supervise dir are about to be rmtree'd with it, so those are
+        preserved to the system temp dir first — a post-mortem that points at
+        a deleted file is useless."""
+        flight_dir = os.environ.get("PATHWAY_FLIGHT_RECORDER_DIR") or self._supervise_dir
+        if flight_dir is None:
+            return None
+        path = os.path.join(flight_dir, f"flight-rank-{rank}.json")
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if self._supervise_dir is not None and path.startswith(self._supervise_dir):
+            kept = os.path.join(
+                tempfile.gettempdir(),
+                f"pathway-flight-{self._run_id}-rank-{rank}.json",
+            )
+            try:
+                shutil.copyfile(path, kept)
+                path = kept
+            except OSError:
+                pass
+        from pathway_tpu.engine.profile import flight_summary_line
+
+        return f"flight recorder {path}: {flight_summary_line(payload)}"
 
     def _post_mortem(self, failure: tuple, statuses: Dict[int, dict], why_final: str) -> None:
         failed_rank, reason = failure
@@ -415,6 +459,9 @@ class Supervisor:
                     parts.append(f"state {status.get('state')}")
             else:
                 parts.append("no status report")
+            flight = self._flight_dump_line(rank)
+            if flight is not None:
+                parts.append(flight)
             self._log(f"  post-mortem rank {rank}: " + ", ".join(parts))
         self._log(f"not restarting: {why_final}")
 

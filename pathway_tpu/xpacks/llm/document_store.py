@@ -161,6 +161,30 @@ class DocumentStore:
                     payload["embedder"] = stats_fn()
                 except Exception:
                     pass
+            # the same snapshot /metrics exports: commit latency percentiles
+            # + top operators by cumulative wall time (engine/profile.py).
+            # Pinned PER COMMIT, not per run: within one commit every
+            # re-derivation (cross-ref re-evaluation) must see the identical
+            # value — the snapshot moves with every commit of every runner in
+            # the process, and a value that changed between two evaluations
+            # of the same row churns nondeterministic update pairs. Across
+            # commits it reads FRESH, so a long-running server keeps serving
+            # live numbers (retraction rows replay the evaluator's memo and
+            # never re-invoke this)
+            try:
+                from pathway_tpu.engine.expression_evaluator import get_runtime
+                from pathway_tpu.engine.profile import get_profiler
+
+                token = get_runtime().get("commit_token")
+                if (
+                    token is None
+                    or getattr(self, "_engine_snapshot_token", None) != token
+                ):
+                    self._engine_snapshot_cache = get_profiler().snapshot()
+                    self._engine_snapshot_token = token
+                payload["engine"] = self._engine_snapshot_cache
+            except Exception:
+                pass
             return Json(payload)
 
         joined = info_queries.join_left(counted, id=info_queries.id).select(

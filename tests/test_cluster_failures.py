@@ -164,3 +164,66 @@ def test_connect_failure_closes_listener_and_raises_typed(monkeypatch):
         probe.bind(("127.0.0.1", port))
     finally:
         probe.close()
+
+
+def test_exchange_metrics_traffic_barrier_wait_and_straggler(monkeypatch):
+    """The exchange feeds the stage counters: per-peer bytes/frames both
+    directions, per-barrier wait seconds, and straggler attribution (the peer
+    this process blocked on longest)."""
+    from pathway_tpu.engine import telemetry
+
+    monkeypatch.setenv("PATHWAY_HEARTBEAT_INTERVAL_S", "0")  # no beacon noise
+    telemetry.stage_reset("exchange.")
+    a, b = _pair(_port_base())
+    try:
+        # b answers the barrier late: a must attribute peer 1 as the straggler
+        def b_side() -> None:
+            time.sleep(0.4)
+            b.exchange_parts(b"t-straggle", {0: b"x" * 100})
+
+        t = threading.Thread(target=b_side)
+        t.start()
+        a.exchange_parts(b"t-straggle", {1: b"y" * 200})
+        t.join(timeout=10)
+        counters = telemetry.stage_snapshot("exchange.")
+        assert counters["exchange.peer1.frames_sent"] >= 1
+        assert counters["exchange.peer1.bytes_sent"] >= 200
+        assert counters["exchange.peer1.frames_received"] >= 1
+        assert counters["exchange.peer1.bytes_received"] >= 100
+        assert counters["exchange.barriers"] >= 1
+        assert counters["exchange.barrier_wait_s"] >= 0.3
+        assert counters.get("exchange.straggler.peer1", 0) >= 1
+        assert counters.get("exchange.peer1.straggler_wait_s", 0) >= 0.3
+    finally:
+        a.close()
+        b.close()
+        telemetry.stage_reset("exchange.")
+
+
+def test_barrier_timeout_records_stage_counter_and_flight_event(monkeypatch):
+    from pathway_tpu.engine import telemetry
+    from pathway_tpu.engine.profile import get_flight_recorder
+
+    monkeypatch.setenv("PATHWAY_HEARTBEAT_INTERVAL_S", "0")
+    telemetry.stage_reset("cluster.")
+    rec = get_flight_recorder()
+    rec.reset()
+    a, b = _pair(_port_base())
+    try:
+        a.barrier_timeout_s = 0.3
+        with pytest.raises(PeerTimeoutError):
+            a.exchange_parts(b"nobody-sends-this", {1: b"x"})
+        counters = telemetry.stage_snapshot("cluster.")
+        assert counters.get("cluster.barrier_timeouts", 0) >= 1
+        events = rec.payload("test")["events"]
+        timeouts = [e for e in events if e["kind"] == "barrier_timeout"]
+        assert timeouts and timeouts[-1]["peer"] == 1
+        assert timeouts[-1]["tag"] == "nobody-sends-this"
+        # the pending-barrier mark must SURVIVE the failed barrier (the
+        # fence/crash dump names it), not be wiped during unwind
+        assert rec.payload("test")["summary"]["pending_barrier"] == "nobody-sends-this"
+    finally:
+        a.close()
+        b.close()
+        telemetry.stage_reset("cluster.")
+        rec.reset()

@@ -301,3 +301,124 @@ def test_rest_roundtrip_latency_floor():
         f"REST echo p50 {p50:.1f} ms blew the sanity ceiling — the serving tick "
         "is fundamentally broken, not merely noisy"
     )
+
+
+def test_healthz_degraded_on_probe_failure():
+    """A failing health-source callback must NOT masquerade as a healthy
+    worker: HTTP stays 200 (a probe must never 500), alive stays true (the
+    process does serve), but state reports "degraded" with the error."""
+    import json
+
+    stats = ProberStats()
+    server = MonitoringServer(stats, 0)
+
+    def exploding_source():
+        raise RuntimeError("status file unreadable")
+
+    server.health_source = exploding_source
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+    finally:
+        server.close()
+    assert payload["alive"] is True
+    assert payload["state"] == "degraded"
+    assert "status file unreadable" in payload["error"]
+
+
+def test_stats_monitor_plain_lines_without_tty(monkeypatch):
+    """Redirected/CI stderr (isatty False) must still get throttled plain
+    progress lines — the module contract the tty-gated fallback violated."""
+    import io
+    import sys
+
+    from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
+
+    class FakeErr(io.StringIO):
+        def isatty(self):
+            return False
+
+    fake = FakeErr()
+    monkeypatch.setattr(sys, "stderr", fake)
+
+    class Node:
+        def __init__(self, nid, kind):
+            self.id = nid
+            self.kind = kind
+            self.name = kind
+
+    monitor = StatsMonitor([Node(1, "input"), Node(2, "output")],
+                           level=MonitoringLevel.IN_OUT)
+    assert monitor._live is None
+    monitor._last_print = -10.0  # bypass the 1 s throttle
+    monitor.update(5, {1: 10, 2: 7})
+    out = fake.getvalue()
+    assert "commit=5" in out
+    assert "rows_processed=17" in out
+    assert "rows_per_s=" in out
+    # throttle: an immediate second update prints nothing new
+    before = fake.getvalue()
+    monitor.update(6, {1: 1})
+    assert fake.getvalue() == before
+    monitor.close()
+
+
+def test_cpu_gauge_primed_at_registration(monkeypatch):
+    """psutil.cpu_percent(interval=None) reports 0.0 on its FIRST call (no
+    baseline) — the recorder must prime it at instrument registration so the
+    first export interval carries a real number."""
+    import psutil
+
+    from pathway_tpu.engine.telemetry import MetricsRecorder
+
+    calls = []
+
+    class FakeProcess:
+        def cpu_percent(self, interval=None):
+            calls.append(interval)
+            return 0.0 if len(calls) == 1 else 12.5
+
+        def memory_info(self):
+            class M:
+                rss = 1024
+            return M()
+
+    class FakeInstrument:
+        def add(self, *a, **k):
+            pass
+
+        def record(self, *a, **k):
+            pass
+
+    class FakeMeter:
+        def __init__(self):
+            self.gauges = {}
+
+        def create_observable_gauge(self, name, callbacks=None, **kw):
+            self.gauges[name] = callbacks
+
+        def create_counter(self, name, **kw):
+            return FakeInstrument()
+
+        def create_histogram(self, name, **kw):
+            return FakeInstrument()
+
+    fake_meter = FakeMeter()
+    from opentelemetry import metrics as otel_metrics
+
+    monkeypatch.setenv("PATHWAY_TELEMETRY", "1")
+    monkeypatch.setattr(otel_metrics, "get_meter", lambda name: fake_meter)
+    monkeypatch.setattr(psutil, "Process", FakeProcess)
+
+    MetricsRecorder._instance = None
+    rec = MetricsRecorder.get(ProberStats())
+    try:
+        assert rec._enabled
+        assert calls == [None], "cpu clock must be primed once at registration"
+        (obs,) = fake_meter.gauges["process.cpu.utilization"][0](None)
+        assert obs.value == 12.5, "first exported sample must not be the 0.0 priming read"
+    finally:
+        MetricsRecorder._instance = None

@@ -195,3 +195,85 @@ def test_describe_exit_names_signals():
 
 def test_status_path_layout(tmp_path):
     assert status_path(str(tmp_path), 3).endswith("rank-3.status.json")
+
+
+def test_post_mortem_attaches_flight_recorder_summary(tmp_path, capsys):
+    """A rank that left a flight-recorder dump behind gets it named in the
+    post-mortem — path + one-line summary — and the file is preserved outside
+    the supervise dir before that dir is rmtree'd."""
+    import json
+    import re
+
+    prog = textwrap.dedent(
+        """
+        import json, os, signal, time
+        d = os.environ["PATHWAY_SUPERVISE_DIR"]
+        rank = int(os.environ["PATHWAY_PROCESS_ID"])
+        path = os.path.join(d, f"rank-{rank}.status.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump({"pid": os.getpid(), "rank": rank, "commit": 7,
+                       "persistence": False, "peers": {}, "ts": time.time()}, f)
+        os.replace(path + ".tmp", path)
+        if rank == 0:
+            dump = os.path.join(d, "flight-rank-0.json")
+            with open(dump, "w") as f:
+                json.dump({"reason": "crash: Boom", "rank": 0, "profiles": [],
+                           "events": [],
+                           "summary": {"last_commit": 6,
+                                       "slowest_operator": {"name": "groupby",
+                                                            "kind": "groupby",
+                                                            "seconds": 0.25},
+                                       "pending_barrier": "12:3:i0"}}, f)
+            time.sleep(0.3)
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(0.5)
+        """
+    )
+    sup = _supervisor(tmp_path, prog)
+    rc = sup.run()
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "flight recorder" in err
+    assert "last commit 6" in err
+    assert "slowest operator groupby (250.0 ms)" in err
+    assert "pending barrier 12:3:i0" in err
+    m = re.search(r"flight recorder (\S+):", err)
+    assert m, err
+    kept = m.group(1)
+    try:
+        assert os.path.exists(kept), "dump must be preserved past supervise-dir cleanup"
+        assert json.load(open(kept))["summary"]["last_commit"] == 6
+    finally:
+        try:
+            os.unlink(kept)
+        except OSError:
+            pass
+
+
+def test_kill_wedged_sends_sigterm_before_sigkill(tmp_path, capsys, monkeypatch):
+    """Stall-kill grace: the wedged rank gets SIGTERM first (the flight
+    recorder's dump window); one that ignores it is SIGKILLed anyway."""
+    import signal as signal_mod
+
+    # the grace knob is read by the SUPERVISOR process, not the children
+    monkeypatch.setenv("PATHWAY_SUPERVISOR_TERM_GRACE_S", "0.5")
+
+    prog = textwrap.dedent(
+        """
+        import json, os, signal, time
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)  # worst case: ignores TERM
+        d = os.environ["PATHWAY_SUPERVISE_DIR"]
+        path = os.path.join(d, "rank-0.status.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump({"pid": os.getpid(), "rank": 0, "commit": 1,
+                       "persistence": False, "peers": {}, "ts": time.time()}, f)
+        os.replace(path + ".tmp", path)
+        time.sleep(120)
+        """
+    )
+    sup = _supervisor(tmp_path, prog, n=1, stale_after=1.0)
+    rc = sup.run()
+    assert rc != 0
+    assert sup.handles[0].returncode == -signal_mod.SIGKILL
+    err = capsys.readouterr().err
+    assert "killed by supervisor for staleness" in err

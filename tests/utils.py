@@ -154,3 +154,137 @@ def assert_stream_equality(a: pw.Table, b: pw.Table) -> None:
 
 
 assert_stream_equality_wo_index = assert_stream_equality
+
+
+# -- strict OpenMetrics line-grammar checker ----------------------------------
+# Guards the /metrics exporter: a malformed exposition breaks Prometheus
+# scrapes SILENTLY (the scraper drops the target), so regressions must fail
+# tier-1 instead. Checks: metadata-before-samples ordering, one contiguous
+# block per family, counter samples named <family>_total, histogram bucket
+# monotonicity + le ordering + +Inf == _count, and the # EOF terminator.
+
+import re as _re
+
+_METRIC_NAME_RE = _re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+# the label body is scanned quote-aware: values may contain ',' and '}'
+# (operator names are user-settable and exported verbatim modulo escaping)
+_SAMPLE_RE = _re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(?:\{(?P<labels>(?:[^"}]|"(?:[^"\\]|\\.)*")*)\})?'
+    r" (?P<value>[^ ]+)(?: (?P<ts>[0-9.+-eE]+))?$"
+)
+_LABEL_PAIR_RE = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _om_parse_labels(raw: str) -> dict:
+    """Parse a label body positionally (NOT by splitting on commas — a comma
+    inside a quoted label value is legal)."""
+    labels: dict = {}
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_PAIR_RE.match(raw, pos)
+        assert m, f"malformed label body at …{raw[pos:]!r}"
+        labels[m.group(1)] = m.group(2)
+        pos = m.end()
+        if pos < len(raw):
+            assert raw[pos] == ",", f"expected ',' between labels at …{raw[pos:]!r}"
+            pos += 1
+    return labels
+
+
+def validate_openmetrics(text: str) -> dict:
+    """Assert ``text`` is a valid OpenMetrics exposition; returns
+    {family: {"type": ..., "samples": [(name, labels, value)]}}."""
+    lines = text.split("\n")
+    assert lines[-1] == "", "exposition must end with a newline"
+    lines = lines[:-1]
+    assert lines, "empty exposition"
+    assert lines[-1] == "# EOF", f"missing # EOF terminator (last: {lines[-1]!r})"
+    families: dict = {}
+    family_order: list = []
+    current_family: "str | None" = None
+    for lineno, line in enumerate(lines[:-1], 1):
+        assert line == line.strip(), f"line {lineno}: stray whitespace {line!r}"
+        assert "# EOF" != line, f"line {lineno}: # EOF before the end"
+        if line.startswith("# "):
+            parts = line.split(" ", 3)
+            assert len(parts) >= 3 and parts[1] in ("HELP", "TYPE"), (
+                f"line {lineno}: malformed metadata {line!r}"
+            )
+            kind, name = parts[1], parts[2]
+            assert _METRIC_NAME_RE.fullmatch(name), (
+                f"line {lineno}: bad metric family name {name!r}"
+            )
+            fam = families.setdefault(
+                name, {"type": None, "help": None, "samples": []}
+            )
+            assert not fam["samples"], (
+                f"line {lineno}: {kind} for {name} AFTER its samples"
+            )
+            if kind == "TYPE":
+                assert fam["type"] is None, f"line {lineno}: duplicate TYPE for {name}"
+                assert len(parts) == 4 and parts[3] in (
+                    "counter", "gauge", "histogram", "summary", "unknown", "info",
+                ), f"line {lineno}: bad TYPE {line!r}"
+                fam["type"] = parts[3]
+            else:
+                assert fam["help"] is None, f"line {lineno}: duplicate HELP for {name}"
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {lineno}: malformed sample {line!r}"
+        name, raw_labels, raw_value = m.group("name"), m.group("labels"), m.group("value")
+        # resolve which declared family this sample belongs to
+        fam_name = None
+        for suffix in ("_total", "_bucket", "_count", "_sum", ""):
+            base = name[: -len(suffix)] if suffix and name.endswith(suffix) else (
+                name if not suffix else None
+            )
+            if base and base in families:
+                fam_name = base
+                break
+        assert fam_name, f"line {lineno}: sample {name!r} has no TYPE/HELP metadata"
+        fam = families[fam_name]
+        assert fam["type"] is not None, f"line {lineno}: {fam_name} samples precede TYPE"
+        if fam["type"] == "counter":
+            assert name == fam_name + "_total", (
+                f"line {lineno}: counter sample must be {fam_name}_total, got {name!r}"
+            )
+        if fam["type"] == "histogram":
+            assert name in (
+                fam_name + "_bucket", fam_name + "_count", fam_name + "_sum",
+            ), f"line {lineno}: bad histogram sample name {name!r}"
+        labels = _om_parse_labels(raw_labels or "")
+        try:
+            value = float(raw_value.replace("+Inf", "inf"))
+        except ValueError as exc:
+            raise AssertionError(f"line {lineno}: bad value {raw_value!r}") from exc
+        # one contiguous block per family
+        if fam_name != current_family:
+            assert fam_name not in family_order, (
+                f"line {lineno}: family {fam_name} samples are not contiguous"
+            )
+            family_order.append(fam_name)
+            current_family = fam_name
+        fam["samples"].append((name, labels, value))
+    for fam_name, fam in families.items():
+        if fam["type"] != "histogram" or not fam["samples"]:
+            continue
+        buckets = [(lb, v) for (n, lb, v) in fam["samples"] if n.endswith("_bucket")]
+        counts = {n: v for (n, lb, v) in fam["samples"] if not n.endswith("_bucket")}
+        assert buckets, f"{fam_name}: histogram without buckets"
+        prev_le = float("-inf")
+        prev_count = 0.0
+        for lb, v in buckets:
+            assert "le" in lb, f"{fam_name}: bucket without le label"
+            le = float(lb["le"].replace("+Inf", "inf"))
+            assert le > prev_le, f"{fam_name}: le bounds not ascending at {lb['le']}"
+            assert v >= prev_count, (
+                f"{fam_name}: bucket counts not monotone at le={lb['le']}"
+            )
+            prev_le, prev_count = le, v
+        assert prev_le == float("inf"), f"{fam_name}: missing +Inf bucket"
+        assert counts.get(fam_name + "_count") == prev_count, (
+            f"{fam_name}: _count != +Inf bucket"
+        )
+        assert fam_name + "_sum" in counts, f"{fam_name}: missing _sum"
+    return families
