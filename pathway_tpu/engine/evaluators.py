@@ -100,6 +100,13 @@ class Evaluator:
     CLUSTER_POLICIES: Dict[int, str] = {}
     _cluster_policies: tuple = ()  # resolved per-instance by GraphRunner.setup
     _cluster_barrier: bool = False
+    # incremental rewind (GraphRunner._capture_undo_state): True means a
+    # pre-commit state_dict()/load_state_dict() round-trip exactly restores
+    # this operator, so a fenced survivor may undo an interrupted commit in
+    # place. Set False on operators whose per-commit state snapshot is
+    # unreasonable (huge or externally mutated in place) — the graph then
+    # skips the rewind rung and fences use checkpoint + tail replay.
+    REWIND_SAFE = True
 
     def cluster_input_policy(self, idx: int) -> str | None:
         return self.CLUSTER_POLICIES.get(idx)
@@ -2001,6 +2008,13 @@ class _TimeThresholdEvaluator(Evaluator):
     # (``time_column.rs:48-51`` — "we need to process all data in one worker")
     CLUSTER_POLICIES = {0: "root"}
 
+    # drain-sensitive: these operators flush on ``runner.draining``, a
+    # live-only signal that a rejoining rank's journal replay does not
+    # reproduce (``_ready`` is forced False during replay) — a rung-1 survivor
+    # keeping post-flush state while the replacement replays without the flush
+    # would diverge per rank, so graphs holding one skip the rewind rung
+    REWIND_SAFE = False
+
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.now: Any = None
@@ -2191,6 +2205,9 @@ class ExternalIndexEvaluator(Evaluator):
     # queries stay local and answer exactly against the replicated state —
     # the replicated-index pattern (queries never cross processes)
     CLUSTER_POLICIES = {0: "broadcast"}
+    # the index mutates in place (possibly device-resident pages); pickling it
+    # every commit for an undo record would dwarf the tail replay it avoids
+    REWIND_SAFE = False
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)

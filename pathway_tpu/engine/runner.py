@@ -66,6 +66,24 @@ class GraphRunner:
         self._profiler: Any = None
         self._recorder: Any = None
         self._profile_ops: "List[tuple] | None" = None
+        # coordinated cluster checkpoints (persistence/engine.py manifest
+        # protocol) + incremental rewind (undo record + mesh serve log)
+        self._ckpt_interval_s = 0.0  # 0 = coordinated checkpoints off
+        self._ckpt_compact = True  # PATHWAY_CHECKPOINT_COMPACT=0 disables
+        self._ckpt_attempts = 0
+        self._ckpt_disabled_reason: "str | None" = None
+        self._manifest_commit: "int | None" = None  # last durable manifest
+        self._undo_depth = 0  # PATHWAY_UNDO_RING_DEPTH; 0 = rewind rung off
+        self._undo_max_bytes = 0  # PATHWAY_UNDO_MAX_STATE_BYTES; 0 = unbounded
+        self._undo_current: "Dict[str, Any] | None" = None  # in-flight record
+        # adaptive rewind-cost guard: EWMA of per-commit undo-capture seconds
+        # vs whole-commit seconds — state_dict() re-pickles every touched
+        # operator's state each commit, so a large-state graph under the byte
+        # cap could still pay more for the rung than the tail replay it avoids
+        self._undo_capture_ewma = 0.0
+        self._undo_commit_ewma = 0.0
+        self._undo_armed_commits = 0
+        self._rewind_safe = True  # graph has no drain-sensitive operators
 
     def state_of(self, node: pg.Node) -> StateTable:
         if node.id not in self._materialized:
@@ -359,6 +377,7 @@ class GraphRunner:
             # the key exchange); touching them here would double-ingest
             self._sources = []
         replay_frames = []
+        ckpt_floor = 0
         if persistence_config is not None and persistence_config.backend is not None:
             from pathway_tpu.persistence.engine import PersistenceManager
 
@@ -371,19 +390,65 @@ class GraphRunner:
             self._snapshot_interval_s = (
                 getattr(persistence_config, "snapshot_interval_ms", 0) or 0
             ) / 1000.0
-            checkpoint = self._persistence.load_checkpoint(sig)
-            if checkpoint is not None and self._cluster is not None:
-                # Cluster resume is journal-only (snapshot writes are gated off
-                # under spawn): a checkpoint here comes from a single-process
-                # run. Its journal was compacted at an unsynchronized commit, so
-                # peers replaying the union of journaled ids would re-exchange
-                # rows this process's snapshot already contains — silent double
-                # counting. Refuse loudly.
-                raise NotImplementedError(
-                    "this persistence store contains an operator snapshot "
-                    "(written by a single-process run); resuming it under "
-                    "spawn -n N is not supported — restart single-process or "
-                    "start the cluster from a fresh store"
+            if self._cluster is not None:
+                if self._persistence.load_checkpoint(sig) is not None:
+                    # an UNVERSIONED per-shard snapshot can only come from a
+                    # single-process run whose journal was compacted at an
+                    # unsynchronized commit; resuming it under spawn would
+                    # silently double-count exchanged rows. (Worker-count
+                    # mismatches are also refused by the store-wide meta.)
+                    raise NotImplementedError(
+                        "this persistence store contains an operator snapshot "
+                        "(written by a single-process run); resuming it under "
+                        "spawn -n N is not supported — restart single-process or "
+                        "start the cluster from a fresh store"
+                    )
+                # coordinated cluster checkpoints: cadence from
+                # PATHWAY_CHECKPOINT_INTERVAL_S (fallback: the config's
+                # snapshot interval); the checkpoint marker rides the
+                # per-commit neu allgather so all ranks snapshot at ONE commit
+                from pathway_tpu.internals.config import env_float as _env_float
+
+                if self._persistence.supports_cluster_checkpoints:
+                    self._ckpt_interval_s = max(
+                        0.0,
+                        _env_float(
+                            "PATHWAY_CHECKPOINT_INTERVAL_S", self._snapshot_interval_s
+                        ),
+                    )
+                self._ckpt_compact = (
+                    _os.environ.get("PATHWAY_CHECKPOINT_COMPACT", "1") != "0"
+                )
+                self._snapshot_interval_s = 0.0  # the single-process path stays off
+                checkpoint = None
+                manifest = self._persistence.load_cluster_manifest(sig)
+                if manifest is not None:
+                    base = int(manifest["commit_id"])
+                    self._manifest_commit = base
+                    checkpoint = (
+                        base,
+                        self._persistence.load_cluster_snapshot(sig, base),
+                    )
+                    ckpt_floor = base + 1
+            else:
+                checkpoint = self._persistence.load_checkpoint(sig)
+            if (
+                self._surgical
+                and self._cluster is not None
+                and getattr(self._cluster, "supports_rejoin", False)
+            ):
+                # incremental rewind (fence rung 1): keep per-commit undo
+                # records + the mesh serve log so a fenced survivor undoes only
+                # the interrupted commit. Drain-sensitive operators emit on a
+                # live-only signal replay cannot reproduce, so graphs holding
+                # them skip the rewind rung (rung 2 stays exact).
+                self._undo_depth = getattr(self._cluster, "commit_log_depth", 0)
+                self._undo_max_bytes = int(
+                    _env_float("PATHWAY_UNDO_MAX_STATE_BYTES", 64 * 1024 * 1024)
+                )
+                self._rewind_safe = all(
+                    getattr(ev, "REWIND_SAFE", True)
+                    for ev in self.evaluators.values()
                 )
             replay_frames = self._persistence.load_journal(sig)
             self._persistence.open_for_append(sig)
@@ -395,6 +460,16 @@ class GraphRunner:
                 # frames ≤ the checkpointed commit are subsumed by it (compaction may
                 # have crashed before truncating the journal)
                 replay_frames = [f for f in replay_frames if f[0] > base_commit]
+                if self._cluster is not None:
+                    import logging
+
+                    # the bounded-recovery claim made observable: a replacement
+                    # rank names its base manifest + the tail it still replays
+                    logging.getLogger("pathway_tpu").warning(
+                        "rank %d: cold-starting from cluster checkpoint manifest "
+                        "at commit %d (+%d journal tail frame(s))",
+                        self._rank, base_commit, len(replay_frames),
+                    )
                 synthetic = (
                     base_commit,
                     {},
@@ -423,7 +498,7 @@ class GraphRunner:
         from pathway_tpu.internals.config import get_pathway_config
 
         if self._cluster is not None and self._persistence is not None:
-            self._cluster_replay(replay_frames)
+            self._cluster_replay(replay_frames, floor=ckpt_floor)
         else:
             if replay_frames and get_pathway_config().persistence_mode == "batch":
                 # replay the whole recording as ONE commit (reference PersistenceMode::Batch)
@@ -493,12 +568,18 @@ class GraphRunner:
                     if len(snapshot):
                         evaluator.process([snapshot])
 
-    def _take_checkpoint(self) -> bool:
-        """Snapshot every operator's state + source positions, then compact the journal.
-        Deferred while any source is mid-segment: a segment's pre-checkpoint events
-        would be baked into state while its tail stays in the journal, making a
-        changed-segment undo impossible."""
-        from pathway_tpu.engine.evaluators import InputEvaluator, OutputEvaluator
+    def _snapshot_blob(self) -> "tuple[dict | None, str]":
+        """Build the full engine snapshot (operator + state-table + source
+        state). Returns ``(blob, "ok")``, ``(None, "defer")`` while any source
+        is mid-segment (a segment's pre-checkpoint events would be baked into
+        state while its tail stays in the journal, making a changed-segment
+        undo impossible), or ``(None, "permanent: ...")`` for unpicklable
+        operator state."""
+        from pathway_tpu.engine.evaluators import (
+            InputEvaluator,
+            OutputEvaluator,
+            UnpicklableStateError,
+        )
 
         offsets = {
             # per-frame marker payloads don't belong in the checkpoint snapshot
@@ -506,12 +587,10 @@ class GraphRunner:
             for n, _ in self._sources
         }
         if any(o.get("in_progress") for o in offsets.values()):
-            return False
+            return None, "defer"
         deltas = {
             n.id: n.config["source"].checkpoint_state_deltas() for n, _ in self._sources
         }
-        from pathway_tpu.engine.evaluators import UnpicklableStateError
-
         try:
             blob = {
                 "states": {nid: st.state_blob() for nid, st in self.states.items()},
@@ -524,19 +603,127 @@ class GraphRunner:
                 "source_deltas": deltas,
             }
         except UnpicklableStateError as exc:
-            if not self._warned_unpicklable:
-                self._warned_unpicklable = True
-                import logging
+            return None, f"permanent: {exc}"
+        return blob, "ok"
 
-                logging.getLogger("pathway_tpu").warning(
-                    "operator checkpointing disabled: %s — falling back to full "
-                    "journal replay on resume",
-                    exc,
-                )
-            self._snapshot_interval_s = 0.0  # stop retrying every commit
+    def _take_checkpoint(self) -> bool:
+        """Single-process checkpoint: snapshot, then compact the journal."""
+        blob, why = self._snapshot_blob()
+        if blob is None:
+            if why.startswith("permanent"):
+                if not self._warned_unpicklable:
+                    self._warned_unpicklable = True
+                    import logging
+
+                    logging.getLogger("pathway_tpu").warning(
+                        "operator checkpointing disabled: %s — falling back to "
+                        "full journal replay on resume",
+                        why,
+                    )
+                self._snapshot_interval_s = 0.0  # stop retrying every commit
             return False
         self._persistence.dump_checkpoint(self._graph_sig, self._commit, blob)
         return True
+
+    def _coordinated_checkpoint(self) -> None:
+        """Cluster-coordinated checkpoint at ONE lockstep commit id (the
+        decision rode this commit's neu allgather, so every rank is here).
+
+        Barrier sequence: (1) every rank writes its versioned snapshot; (2)
+        durability acks are allgathered — any non-ok rank aborts the attempt
+        cluster-wide and the previous checkpoint stands; (3) rank 0 commits the
+        manifest (read-back verified) and the outcome is allgathered; (4) only
+        then does every rank compact its journal shard and prune old
+        snapshots/manifests + the mesh serve log. A crash at ANY point leaves
+        the previous checkpoint + uncompacted journal recoverable
+        (chaos-tested: post-snapshot kill, torn manifest, snapshot error)."""
+        from pathway_tpu.engine import telemetry
+        from pathway_tpu.engine.profile import histogram
+
+        cluster = self._cluster
+        t0 = time_mod.monotonic()
+        self._ckpt_attempts += 1
+        epoch = getattr(cluster, "epoch", 0)
+        if self._chaos is not None:
+            self._chaos.begin_checkpoint_attempt()
+            # plain rank death scheduled after N completed checkpoints (the
+            # acceptance headline) — before anything of THIS attempt is written
+            self._chaos.maybe_checkpoint_kill(
+                self._rank, self._commit, epoch=epoch, op="pre_snapshot_kill"
+            )
+        blob, status = self._snapshot_blob()
+        size = 0
+        if blob is not None:
+            try:
+                size = self._persistence.dump_cluster_snapshot(
+                    self._graph_sig, self._commit, blob
+                )
+            except (ConnectionError, OSError) as exc:
+                status = f"transient: {exc}"
+        if self._chaos is not None:
+            # fault window: this rank's snapshot is durable, the manifest is not
+            self._chaos.maybe_checkpoint_kill(self._rank, self._commit, epoch=epoch)
+        statuses = cluster.allgather(f"ckptack:{self._commit}".encode(), status)
+        if any(s.startswith("permanent") for s in statuses):
+            self._ckpt_disabled_reason = next(
+                s for s in statuses if s.startswith("permanent")
+            )
+            self._ckpt_interval_s = 0.0
+            import logging
+
+            logging.getLogger("pathway_tpu").warning(
+                "coordinated checkpoints disabled cluster-wide (%s) — rejoin "
+                "falls back to full journal replay",
+                self._ckpt_disabled_reason,
+            )
+            return
+        if any(s != "ok" for s in statuses):
+            # transient backend error or a mid-segment defer somewhere: no
+            # manifest, previous checkpoint stands, retry at the next commit
+            telemetry.stage_add("persist.checkpoint_retries")
+            if self._recorder is not None:
+                self._recorder.record_event(
+                    "checkpoint_deferred", commit=self._commit,
+                    statuses=[s.split(":")[0] for s in statuses],
+                )
+            return
+        ok = True
+        if self._rank == 0:
+            ok = self._persistence.commit_cluster_manifest(
+                self._graph_sig, self._commit, epoch=epoch
+            )
+        oks = cluster.allgather(f"ckptdone:{self._commit}".encode(), bool(ok))
+        if not all(oks):
+            # torn/failed manifest: every rank keeps its journal intact; the
+            # orphan snapshots are pruned by the next successful checkpoint
+            telemetry.stage_add("persist.checkpoint_manifest_failures")
+            return
+        tail_frames = 0
+        if self._ckpt_compact:
+            tail_frames = self._persistence.compact_journal(self._graph_sig)
+        self._persistence.cleanup_cluster_checkpoints(self._commit)
+        cluster.prune_commit_log(self._commit)
+        self._manifest_commit = self._commit
+        self._last_checkpoint = time_mod.monotonic()
+        duration = self._last_checkpoint - t0
+        # recovery-SLO instrumentation (PR 5 metrics plane): checkpoint
+        # cadence/size/duration and the journal-tail length it compacted away
+        histogram("pathway_checkpoint_duration_seconds").observe(duration)
+        telemetry.stage_add_many({
+            "persist.checkpoints": 1.0,
+            "persist.checkpoint_bytes": float(size),
+            "persist.checkpoint_s": duration,
+            "persist.journal_frames_compacted": float(tail_frames),
+        })
+        if self._recorder is not None:
+            self._recorder.record_event(
+                "checkpoint",
+                commit=self._commit,
+                epoch=epoch,
+                bytes=size,
+                duration_s=round(duration, 4),
+                journal_frames_compacted=tail_frames,
+            )
 
     def _restore_sources(self, frames: List[tuple]) -> None:
         """Fold journaled segment-state deltas and the unmarked tail back into each
@@ -581,7 +768,7 @@ class GraphRunner:
                 }
             node.config["source"].restore(offsets, state_deltas, tail)
 
-    def _cluster_replay(self, replay_frames: List[tuple]) -> None:
+    def _cluster_replay(self, replay_frames: List[tuple], floor: int = 0) -> None:
         """Lockstep journal replay across the cluster: journals differ after a
         mid-commit kill (one process recorded commit N, its peer died first),
         and a commit with data on only one process writes a frame only there.
@@ -591,12 +778,31 @@ class GraphRunner:
         (Reference: timely workers replay a shared total order of timestamps.)
         Runs at initial setup AND after a surgical-rejoin state reset; either
         way every rank leaves with the same ``_commit`` counter, so post-replay
-        barrier tags line up."""
+        barrier tags line up. ``floor`` is the post-replay commit counter when
+        nothing is journaled (manifest commit + 1 under a cluster checkpoint —
+        every rank computes the same floor from the same manifest)."""
+        local_frames = {cid: deltas for cid, deltas, _offs in replay_frames}
+        all_ids = self._cluster_replay_ids(local_frames)
+        # rung-coordination barrier (see _attempt_surgical_rejoin): a fresh or
+        # replacement rank has no retained state, so it votes "no interrupted
+        # commit" and always step-replays — the vote only keeps its barrier
+        # tag sequence aligned with fenced survivors deciding serve-vs-step
+        self._cluster.allgather(b"replay:mode", None)
+        self._cluster_replay_steps(local_frames, all_ids, floor)
+
+    def _cluster_replay_ids(self, local_frames: Dict[int, Any]) -> List[int]:
+        """The union of journaled commit ids across the cluster (one allgather;
+        both the step-replay and the serve-from-log rejoin paths start here, so
+        a rank may decide its mode AFTER learning the union without skewing the
+        barrier tag sequence)."""
+        id_lists = self._cluster.allgather(b"replay:ids", sorted(local_frames))
+        return sorted(set().union(*id_lists))
+
+    def _cluster_replay_steps(
+        self, local_frames: Dict[int, Any], all_ids: List[int], floor: int = 0
+    ) -> None:
         from pathway_tpu.internals.config import get_pathway_config
 
-        local_frames = {cid: deltas for cid, deltas, _offs in replay_frames}
-        id_lists = self._cluster.allgather(b"replay:ids", sorted(local_frames))
-        all_ids = sorted(set().union(*id_lists))
         if all_ids and get_pathway_config().persistence_mode == "batch":
             # batch mode, cluster flavor: collapse every local frame into ONE
             # replay commit pinned at the globally-last journaled id, so the
@@ -616,10 +822,11 @@ class GraphRunner:
             self._inject = local_frames.get(cid, {})
             self.step()
         self._inject = None
-        # nothing journaled anywhere: every rank aligns at commit 0 (a fenced
-        # survivor may arrive here mid-commit-N; leaving its counter ahead of
-        # the replacement's would skew every post-rejoin barrier tag)
-        self._commit = all_ids[-1] + 1 if all_ids else 0
+        # nothing journaled anywhere: every rank aligns at the floor (0 on a
+        # fresh store; manifest commit + 1 under a cluster checkpoint — a
+        # fenced survivor may arrive here mid-commit-N; leaving its counter
+        # ahead of the replacement's would skew every post-rejoin barrier tag)
+        self._commit = all_ids[-1] + 1 if all_ids else floor
 
     def step(self) -> bool:
         """Run one commit; returns True if any node produced output.
@@ -654,6 +861,23 @@ class GraphRunner:
             )
         self.current_time = self._commit * 2  # even data times, as in the reference
         self.draining = self._ready and self.sources_finished()
+        undo_armed = (
+            self._undo_depth > 0
+            and self._rewind_safe
+            and self._inject is None
+            and self._cluster is not None
+        )
+        if undo_armed:
+            # incremental rewind bookkeeping for THIS commit: the undo record
+            # (inverted on a fence) and the mesh serve-log entry (served to a
+            # replacement's tail replay). Both are discarded if the commit
+            # completes/fails respectively — see _undo_interrupted_commit.
+            self._undo_current = {
+                "commit": self._commit, "applied": [], "evals": {}, "bytes": 0,
+                "capture_s": 0.0,
+            }
+            self._cluster.begin_commit_log(self._commit)
+        ckpt_due = False
         any_output = self._substep(neu=False)
         neu = any(
             getattr(self.evaluators[n.id], "neu_pending", _no_pending)()
@@ -661,11 +885,55 @@ class GraphRunner:
         )
         if self._cluster is not None:
             # the neu phase is part of the lockstep commit protocol: every process
-            # must agree whether it runs (exchange points fire inside it)
-            neu = any(self._cluster.allgather(f"neu:{self._commit}".encode(), neu))
+            # must agree whether it runs (exchange points fire inside it). The
+            # coordinated-checkpoint marker RIDES this same barrier: barriers are
+            # already lockstep, so every rank learns at the same commit id that a
+            # checkpoint is due — aligned Chandy–Lamport for free.
+            want_ckpt = (
+                self._inject is None
+                and self._ckpt_interval_s > 0
+                and self._persistence is not None
+                and time_mod.monotonic() - self._last_checkpoint
+                >= self._ckpt_interval_s
+            )
+            votes = self._cluster.allgather(
+                f"neu:{self._commit}".encode(), (neu, want_ckpt)
+            )
+            neu = any(v[0] for v in votes)
+            ckpt_due = any(v[1] for v in votes)
         if neu:
             self.current_time = self._commit * 2 + 1
             any_output = self._substep(neu=True) or any_output
+        if undo_armed:
+            # mutations for this commit are final: seal the serve-log entry and
+            # drop the undo record — a fence from here on (journaling has no
+            # barriers; the checkpoint barriers come after) must NOT undo a
+            # completed commit
+            self._cluster.end_commit_log()
+            rec_done, self._undo_current = self._undo_current, None
+            if rec_done is not None and rec_done["evals"]:
+                alpha = 0.2
+                self._undo_capture_ewma += alpha * (
+                    rec_done["capture_s"] - self._undo_capture_ewma
+                )
+                self._undo_commit_ewma += alpha * (
+                    time_mod.monotonic() - commit_t0 - self._undo_commit_ewma
+                )
+                self._undo_armed_commits += 1
+                if (
+                    self._undo_armed_commits >= 8
+                    # 1 ms absolute floor: below it the rung is cheap in wall
+                    # terms and µs-level timer noise could trip the ratio
+                    and self._undo_capture_ewma > 1e-3
+                    and self._undo_capture_ewma > 0.25 * self._undo_commit_ewma
+                ):
+                    self._disable_rewind(
+                        f"undo capture averages "
+                        f"{self._undo_capture_ewma * 1e3:.1f} ms/commit "
+                        f"({self._undo_capture_ewma / self._undo_commit_ewma:.0%} "
+                        "of commit time); re-pickling this much operator state "
+                        "every commit costs more than the tail replay it avoids"
+                    )
         if self._persistence is not None and self._inject is None:
             offsets = {n.id: n.config["source"].offset_state() for n, _ in self._sources}
             # a frame is needed for data AND for data-less segment markers (a marker can
@@ -676,16 +944,21 @@ class GraphRunner:
                 self._persistence.record_commit(self._commit, self._input_deltas, offsets)
                 if (
                     self._snapshot_interval_s > 0
-                    # operator snapshots are wall-clock-driven and therefore NOT
-                    # synchronized across spawn processes; an unsynchronized
-                    # checkpoint would subsume commits whose exchanges a peer
-                    # still needs to replay. Cluster resume is journal-only.
+                    # single-process operator snapshots are wall-clock-driven;
+                    # under a cluster the COORDINATED protocol below replaces
+                    # them (an unsynchronized checkpoint would subsume commits
+                    # whose exchanges a peer still needs to replay)
                     and self._cluster is None
                     and time_mod.monotonic() - self._last_checkpoint
                     >= self._snapshot_interval_s
                 ):
                     if self._take_checkpoint():
                         self._last_checkpoint = time_mod.monotonic()
+            if ckpt_due:
+                # every rank reaches this point for a due checkpoint (the
+                # decision was allgathered), including ranks with no data this
+                # commit — the protocol is a barrier sequence of its own
+                self._coordinated_checkpoint()
         input_rows = sum(len(d) for d in self._input_deltas.values())
         if self.prober_stats is not None:
             self.prober_stats.record_commit(
@@ -746,6 +1019,8 @@ class GraphRunner:
             state=health["state"],
             restarts=health["restarts"],
             last_rejoin_s=health["last_rejoin_s"],
+            checkpoint_commit=health["checkpoint_commit"],
+            journal_tail_frames=health["journal_tail_frames"],
         )
         self._last_status_write = now
 
@@ -844,6 +1119,14 @@ class GraphRunner:
                 ):
                     delta = Delta.empty(self.output_columns_of(node))
                 else:
+                    if (
+                        self._undo_current is not None
+                        and node.id not in self._undo_current["evals"]
+                    ):
+                        # pre-mutation snapshot, taken the FIRST time this
+                        # operator runs in the commit (the neu phase re-runs
+                        # nodes; the undo target is the pre-commit state)
+                        self._capture_undo_state(node, evaluator)
                     if self._cluster is not None and any(
                         p is not None for p in evaluator._cluster_policies
                     ):
@@ -874,6 +1157,10 @@ class GraphRunner:
                 any_output = True
                 self._step_counts[node.id] = self._step_counts.get(node.id, 0) + len(delta)
                 if node.output is not None and node.id in self._materialized:
+                    if self._undo_current is not None:
+                        # applied-delta record: Delta.negated() of each entry
+                        # (in reverse) is the exact state-table undo
+                        self._undo_current["applied"].append((node.id, delta))
                     self.states[node.id].apply(delta)
             if profile_ops is not None:
                 rows = len(delta)
@@ -949,6 +1236,15 @@ class GraphRunner:
             "rejoins": self._rejoins,
             "last_rejoin_s": self._last_rejoin_s,
             "state": self._rejoin_state,
+            # recovery-SLO observability: the commit the last durable cluster
+            # checkpoint covers, and how many journal frames a recovery would
+            # still replay past it — together they bound the next rejoin
+            "checkpoint_commit": self._manifest_commit,
+            "journal_tail_frames": (
+                self._persistence.frames_since_compact
+                if self._persistence is not None
+                else None
+            ),
         }
 
     # -- surgical single-rank restart (epoch fence; parallel/cluster.py) -------
@@ -1022,6 +1318,11 @@ class GraphRunner:
                     # segment markers drained by the aborted commit re-ride the
                     # next journaled frame
                     rewind()
+        # the interrupted commit's partial serve-log entry must never be
+        # replayed to a peer (its tags are regenerated live after recovery)
+        discard_log = getattr(cluster, "discard_open_commit_log", None)
+        if discard_log is not None:
+            discard_log()
         from pathway_tpu.parallel.cluster import PeerShutdownError, PeerTimeoutError
 
         try:
@@ -1038,36 +1339,209 @@ class GraphRunner:
             return False
         self._rejoin_state = "rejoining"
         self._publish_status(force=True)
-        # the interrupted commit left partially-applied operator state (and
-        # evaluator internals) that cannot be unwound in place: rebuild from
-        # this rank's own journal shard, exactly like a relaunched process —
-        # minus the process launch, the imports, and the source re-scan
-        self._reset_operator_state()
+        # Recovery rungs, cheapest first (escalation: rewind → checkpoint+tail
+        # replay → full journal replay; the supervisor's restart-all and loud
+        # teardown sit below). The journal was compacted at the last cluster
+        # checkpoint, so reload() and the replay union are bounded by the tail.
         frames = self._persistence.reload(self._graph_sig)
-        was_ready, self._ready = self._ready, False  # replay parity with setup
-        try:
-            self._cluster_replay(frames)
-        finally:
-            self._ready = was_ready
+        manifest = self._persistence.load_cluster_manifest(self._graph_sig)
+        base: "int | None" = None
+        if manifest is not None:
+            base = int(manifest["commit_id"])
+            self._manifest_commit = base
+            # belt and braces: a crash after the manifest barrier but before
+            # this rank's compaction leaves subsumed frames behind
+            frames = [f for f in frames if f[0] > base]
+        floor = base + 1 if base is not None else 0
+        local_frames = {cid: deltas for cid, deltas, _offs in frames}
+        all_ids = self._cluster_replay_ids(local_frames)
+        from pathway_tpu.engine import telemetry
+        from pathway_tpu.internals.config import get_pathway_config
+
+        # Rung coordination. Serving logged barrier parts is only equivalent to
+        # step-replaying a tail commit when every rank's live inputs for that
+        # commit made it into a journal frame. A survivor interrupted mid-commit
+        # BEFORE journaling carries its drained rows across the fence instead —
+        # if a peer still journaled that commit (barrier skew of one commit is
+        # possible: the dead rank's last sends can reach one survivor and not
+        # another), the replayed commit diverges from the logged one, and
+        # everyone must step-replay from a reset. Each rank votes the id of its
+        # unjournaled interrupted commit (None when clean); any vote naming a
+        # journaled tail commit forces rung 2 cluster-wide. The vote is a
+        # dedicated barrier so replacements (which always step) stay aligned.
+        interrupted = (
+            self._commit
+            if (
+                self._input_deltas_commit == self._commit
+                and getattr(self._persistence, "last_commit_id", None)
+                != self._commit
+            )
+            else None
+        )
+        mode_votes = cluster.allgather(b"replay:mode", interrupted)
+        tail_clean = all(
+            v is None or not all_ids or v > all_ids[-1] for v in mode_votes
+        )
+        rewound = (
+            self._undo_depth > 0
+            and self._rewind_safe
+            and tail_clean
+            # a live in-flight record must be for THIS commit (a mismatch means
+            # bookkeeping drifted — reset rather than mis-undo); None is fine:
+            # the failure hit between commits, state is complete as-is
+            and (
+                self._undo_current is None
+                or self._undo_current["commit"] == self._commit
+            )
+            # batch-mode replay collapses frames into one renumbered commit —
+            # a shape the per-commit serve log cannot reproduce
+            and get_pathway_config().persistence_mode != "batch"
+            and cluster.commit_log_covers(all_ids)
+        )
+        if rewound:
+            # rung 1 — incremental rewind: this rank's state is current except
+            # for the interrupted commit, which is undone IN PLACE from the
+            # retained undo record; the replacement's tail replay is then
+            # served from the logged barriers instead of re-stepping anything
+            self._undo_interrupted_commit()
+            for cid in all_ids:
+                cluster.serve_commit_log(cid)
+            self._commit = all_ids[-1] + 1 if all_ids else floor
+            telemetry.stage_add("cluster.rejoin_rewinds")
+        else:
+            # rung 2/3 — the interrupted commit left partially-applied state
+            # that (here) cannot be unwound in place: reset, restore this
+            # rank's snapshot from the latest cluster checkpoint (rung 2; full
+            # journal replay when none exists — rung 3), and lockstep-replay
+            # the union of journaled tail ids, exactly like a relaunched
+            # process — minus the process launch, imports, and source re-scan
+            self._undo_current = None
+            self._reset_operator_state()
+            if base is not None:
+                self._load_checkpoint_state(
+                    self._persistence.load_cluster_snapshot(self._graph_sig, base)
+                )
+                self._commit = base + 1
+            was_ready, self._ready = self._ready, False  # replay parity with setup
+            try:
+                self._cluster_replay_steps(local_frames, all_ids, floor)
+            finally:
+                self._ready = was_ready
+            telemetry.stage_add("cluster.rejoin_resets")
         self._rejoins += 1
         self._last_rejoin_s = time_mod.monotonic() - t0
         self._rejoin_state = "running"
+        from pathway_tpu.engine.profile import histogram
+
+        # recovery-SLO instrumentation: rejoin latency distribution + the
+        # journal-tail length this recovery had to cover
+        histogram("pathway_rejoin_duration_seconds").observe(self._last_rejoin_s)
+        telemetry.stage_add("cluster.rejoin_tail_commits", float(len(all_ids)))
         if self._recorder is not None:
             self._recorder.record_event(
                 "rejoin",
                 epoch=getattr(cluster, "epoch", 0),
                 duration_s=self._last_rejoin_s,
+                mode="rewind" if rewound else (
+                    "checkpoint+tail" if base is not None else "full-replay"
+                ),
+                tail_commits=len(all_ids),
             )
         self._publish_status(force=True)
         log.warning(
-            "rank %d: rejoined the cluster at epoch %d in %.2fs (resuming at "
-            "commit %d)",
+            "rank %d: rejoined the cluster at epoch %d in %.2fs via %s "
+            "(resuming at commit %d, %d tail commit(s))",
             self._rank,
             getattr(cluster, "epoch", 0),
             self._last_rejoin_s,
+            "incremental rewind" if rewound else (
+                "checkpoint+tail replay" if base is not None else "full journal replay"
+            ),
             self._commit,
+            len(all_ids),
         )
         return True
+
+    def _capture_undo_state(self, node: Any, evaluator: Any) -> None:
+        """Pre-mutation operator snapshot for the incremental-rewind undo
+        record. Input evaluators are excluded (a source cannot un-consume;
+        the fence's carry re-ingests the interrupted commit's drained rows)
+        and output evaluators are stateless sinks — matching the checkpoint
+        snapshot's exclusions. Unpicklable or oversized state disables the
+        rewind rung permanently for this run; rung 2 (checkpoint + tail
+        replay) stays exact."""
+        from pathway_tpu.engine.evaluators import (
+            InputEvaluator,
+            OutputEvaluator,
+            UnpicklableStateError,
+        )
+
+        if isinstance(evaluator, (InputEvaluator, OutputEvaluator)):
+            return
+        rec = self._undo_current
+        _t0 = time_mod.perf_counter()
+        try:
+            state = evaluator.state_dict()
+        except UnpicklableStateError as exc:
+            self._disable_rewind(str(exc))
+            return
+        rec["capture_s"] += time_mod.perf_counter() - _t0
+        rec["evals"][node.id] = state
+        rec["bytes"] += sum(len(b) for b in state.values())
+        if self._undo_max_bytes and rec["bytes"] > self._undo_max_bytes:
+            self._disable_rewind(
+                f"per-commit undo state hit PATHWAY_UNDO_MAX_STATE_BYTES "
+                f"({rec['bytes']} > {self._undo_max_bytes}); re-pickling this "
+                "much state every commit would cost more than the tail replay "
+                "it avoids"
+            )
+
+    def _disable_rewind(self, reason: str) -> None:
+        """Turn the rewind rung off for the rest of this run (the condition —
+        unpicklable or oversized operator state — recurs every commit). The
+        serve log is dropped too: a rank that must reset on a fence recomputes
+        its barrier parts live, so logging them is dead weight."""
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "incremental rewind disabled for this run: %s — fences fall back "
+            "to checkpoint + journal-tail replay",
+            reason,
+        )
+        self._rewind_safe = False
+        self._undo_depth = 0
+        self._undo_current = None
+        cluster = self._cluster
+        if cluster is not None and hasattr(cluster, "discard_open_commit_log"):
+            cluster.discard_open_commit_log()
+            cluster.prune_commit_log(self._commit)
+            cluster.commit_log_depth = 0
+        from pathway_tpu.engine import telemetry
+
+        telemetry.stage_add("cluster.rewind_disabled")
+
+    def _undo_interrupted_commit(self) -> None:
+        """Rung-1 rollback: invert the interrupted commit's applied state-table
+        deltas (in reverse order) and restore the pre-mutation evaluator
+        snapshots captured before each operator ran. Exact by construction —
+        ``Delta.negated()`` of an applied delta removes precisely the rows it
+        inserted and re-inserts the rows it retracted (retraction rows carry
+        their values). A COMPLETED commit never reaches here: its record is
+        dropped the moment its mutations become final (see ``step``)."""
+        rec, self._undo_current = self._undo_current, None
+        if rec is None or rec["commit"] != self._commit:
+            return  # the failure hit between commits: nothing was applied
+        for nid, delta in reversed(rec["applied"]):
+            self.states[nid].apply(delta.negated())
+        for nid, blobs in rec["evals"].items():
+            self.evaluators[nid].load_state_dict(blobs)
+        self._substep_deltas = {}
+        self._input_deltas = {}
+        self._input_deltas_commit = -1
+        self._step_counts = {}
+        from pathway_tpu.engine import telemetry
+
+        telemetry.stage_add("cluster.commits_rewound")
 
     def _reset_operator_state(self) -> None:
         """Discard every evaluator and state table and rebuild them pristine

@@ -11,7 +11,17 @@ plan so a failure schedule replays exactly:
   consults :meth:`Chaos.frame_action` for every DATA frame — heartbeats are
   exempt so the injection counter stream stays deterministic per peer pair);
 - transient object-store write errors (:meth:`Chaos.wrap_object_store` wraps
-  the persistence backend; the engine's retry layer must absorb them).
+  the persistence backend; the engine's retry layer must absorb them);
+- coordinated-checkpoint-phase faults (``checkpoint`` plan entries, keyed on
+  the per-process checkpoint ATTEMPT counter ``at``): ``pre_snapshot_kill``
+  SIGKILLs a rank at the START of attempt N (so exactly N checkpoints have
+  completed — the attempt counter ticks with the wall-clock cadence, which
+  keeps the schedule deterministic on loaded hosts where commit-id gating
+  races convergence), ``post_snapshot_kill``
+  SIGKILLs a rank between its snapshot write and the manifest commit,
+  ``torn_manifest`` tears the manifest bytes mid-write (a non-atomic store),
+  ``snapshot_error`` fails the snapshot write transiently — every one must
+  leave the PREVIOUS checkpoint recoverable bit-identically.
 
 Environment contract::
 
@@ -21,7 +31,8 @@ Environment contract::
          "frames": {"drop_prob": 0.0, "delay_prob": 0.0, "delay_ms": 10,
                     "truncate_prob": 0.0},
          "rejoin": [{"rank": 0, "run": 1}],
-         "backend": {"put_error_prob": 0.5, "max_errors": 4}}
+         "backend": {"put_error_prob": 0.5, "max_errors": 4},
+         "checkpoint": [{"op": "post_snapshot_kill", "rank": 0, "run": 0, "at": 1}]}
 
 ``run`` in a kill entry matches ``PATHWAY_RESTART_COUNT`` (set by the
 supervisor, 0 for a first launch), so a kill fires once and the restarted
@@ -83,8 +94,15 @@ class Chaos:
             dict(e) for e in (plan.get("rejoin") or [])
         ]
         self._backend: Dict[str, Any] = dict(plan.get("backend") or {})
+        self._checkpoint: List[Dict[str, Any]] = [
+            dict(e) for e in (plan.get("checkpoint") or [])
+        ]
         self._streams: Dict[str, random.Random] = {}
         self._backend_errors_left = int(self._backend.get("max_errors", 3))
+        # coordinated-checkpoint attempt counter: bumped by the runner at the
+        # START of every attempt, so `at` in a checkpoint entry deterministically
+        # names the Nth attempt of this process incarnation (0-based)
+        self.checkpoint_attempt = -1
         # observability for tests: what actually fired
         self.stats: Dict[str, int] = {
             "kills": 0,
@@ -93,6 +111,7 @@ class Chaos:
             "frames_truncated": 0,
             "rejoins_dropped": 0,
             "backend_errors": 0,
+            "checkpoint_faults": 0,
         }
 
     # -- streams -------------------------------------------------------------
@@ -137,6 +156,61 @@ class Chaos:
                 except Exception:
                     pass  # the kill must fire regardless
                 os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- coordinated-checkpoint faults ----------------------------------------
+
+    def begin_checkpoint_attempt(self) -> int:
+        """Called by the runner at the start of every coordinated checkpoint
+        attempt; returns the 0-based attempt index the ``at`` field gates on."""
+        self.checkpoint_attempt += 1
+        return self.checkpoint_attempt
+
+    def checkpoint_fault(self, op: str, rank: int) -> bool:
+        """True when the plan schedules fault ``op`` for this rank at the
+        CURRENT checkpoint attempt (and restart count). ``at`` defaults to
+        every attempt; ``run`` defaults to every incarnation."""
+        for entry in self._checkpoint:
+            if entry.get("op") != op:
+                continue
+            if int(entry.get("rank", -1)) != rank:
+                continue
+            want_run = entry.get("run")
+            if want_run is not None and int(want_run) != self.run_count:
+                continue
+            want_at = entry.get("at")
+            if want_at is not None and int(want_at) != self.checkpoint_attempt:
+                continue
+            self.stats["checkpoint_faults"] += 1
+            self._record_injection(
+                f"chaos_checkpoint_{op}", rank=rank, attempt=self.checkpoint_attempt
+            )
+            return True
+        return False
+
+    def maybe_checkpoint_kill(
+        self, rank: int, commit_id: int, epoch: int = 0,
+        op: str = "post_snapshot_kill",
+    ) -> None:
+        """SIGKILL this rank when a checkpoint-phase kill entry matches.
+        ``post_snapshot_kill`` fires between the snapshot write and the
+        manifest commit — the mid-protocol crash the manifest barrier exists
+        to survive; ``pre_snapshot_kill`` fires at the start of the attempt,
+        i.e. a plain rank death scheduled AFTER ``at`` completed checkpoints."""
+        if not self.checkpoint_fault(op, rank):
+            return
+        self.stats["kills"] += 1
+        try:
+            from pathway_tpu.engine.profile import get_flight_recorder
+
+            recorder = get_flight_recorder()
+            recorder.record_event(
+                "chaos_checkpoint_kill", rank=rank, commit=commit_id, epoch=epoch,
+                attempt=self.checkpoint_attempt,
+            )
+            recorder.dump("chaos_checkpoint_kill")
+        except Exception:
+            pass  # the kill must fire regardless
+        os.kill(os.getpid(), signal.SIGKILL)
 
     # -- rejoin handshakes -----------------------------------------------------
 

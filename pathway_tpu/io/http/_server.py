@@ -11,7 +11,7 @@ import asyncio
 import json
 import threading
 import time
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Callable, Dict, Optional, Sequence
 
 from pathway_tpu.engine.datasource import StreamingDataSource
 from pathway_tpu.engine.profile import histogram as _histogram
@@ -243,6 +243,10 @@ class RestServerSubject:
         delete_completed_queries: bool,
         request_validator: Any = None,
         documentation: "EndpointDocumentation | None" = None,
+        max_pending: int = 0,
+        shed_stage: str = "rest.shed",
+        retry_after: Callable[[], float] | None = None,
+        overload_probe: Callable[[], bool] | None = None,
     ):
         self.webserver = webserver
         self.route = route
@@ -252,6 +256,17 @@ class RestServerSubject:
         self.request_validator = request_validator
         self.documentation = documentation
         self.futures: Dict[bytes, "asyncio.Future"] = {}
+        # admission control: requests already pushed into the engine and not
+        # yet answered. Past ``max_pending`` (0 = unbounded) new requests are
+        # shed with 429 + Retry-After instead of queueing without bound —
+        # first slice of the REST-plane backpressure story
+        self.max_pending = max(0, int(max_pending))
+        self.shed_stage = shed_stage
+        self._retry_after = retry_after
+        # secondary admission probe (e.g. the embed coalescer's row-queue cap):
+        # sheds on downstream queue depth, not just this route's request count
+        self._overload_probe = overload_probe
+        self.shed_requests = 0
         self._counter = 0
         self._lock = threading.Lock()
         self._source: StreamingDataSource | None = None
@@ -274,6 +289,43 @@ class RestServerSubject:
                     self.request_validator(payload)
                 except Exception as e:
                     return web.Response(status=400, text=str(e))
+            probe_hit = False
+            if self._overload_probe is not None:
+                try:
+                    probe_hit = bool(self._overload_probe())
+                except Exception:
+                    probe_hit = False
+            if probe_hit or (
+                self.max_pending and len(self.futures) >= self.max_pending
+            ):
+                # shed BEFORE pushing into the engine: an admitted request
+                # costs an engine commit + an embed slot; a shed one costs
+                # only this response
+                self.shed_requests += 1
+                from pathway_tpu.engine import telemetry
+
+                telemetry.stage_add(self.shed_stage)
+                retry_s = 1.0
+                if self._retry_after is not None:
+                    try:
+                        retry_s = float(self._retry_after())
+                    except Exception:
+                        pass
+                reason = (
+                    "downstream embed queue full"
+                    if probe_hit
+                    else (
+                        f"{len(self.futures)} requests in flight "
+                        f"(cap {self.max_pending})"
+                    )
+                )
+                return web.Response(
+                    status=429,
+                    headers={"Retry-After": str(max(1, int(round(retry_s))))},
+                    text=(
+                        f"overloaded: {reason}; retry after the indicated delay"
+                    ),
+                )
             with self._lock:
                 self._counter += 1
                 qid = self._counter
@@ -292,15 +344,21 @@ class RestServerSubject:
                 row[name] = v
             t0 = time.perf_counter()
             source.push(row, key=key, diff=1)
-            result = await future
-            # the serving-path latency histogram (/metrics exports it next to
-            # commit duration): push -> engine commit -> future resolution
-            _histogram("pathway_rest_latency_seconds").observe(
-                time.perf_counter() - t0
-            )
-            self.futures.pop(kb, None)
-            if self.delete_completed_queries:
-                source.push(row, key=key, diff=-1)
+            try:
+                result = await future
+                # the serving-path latency histogram (/metrics exports it next
+                # to commit duration): push -> engine commit -> future resolution
+                _histogram("pathway_rest_latency_seconds").observe(
+                    time.perf_counter() - t0
+                )
+            finally:
+                # a cancelled handler (client disconnect/timeout) must release
+                # its admission slot and retract its query row — under the
+                # max_pending check a leaked slot is a permanent 429 wedge,
+                # not just a memory leak
+                self.futures.pop(kb, None)
+                if self.delete_completed_queries:
+                    source.push(row, key=key, diff=-1)
             if isinstance(result, (dict, list)):
                 return web.json_response(result)
             if isinstance(result, Json):
@@ -340,15 +398,25 @@ def rest_connector(
     delete_completed_queries: bool = False,
     request_validator: Any = None,
     documentation: "EndpointDocumentation | None" = None,
+    max_pending: int = 0,
+    shed_stage: str = "rest.shed",
+    retry_after: "Callable[[], float] | None" = None,
+    overload_probe: "Callable[[], bool] | None" = None,
 ) -> tuple[Table, Any]:
-    """Expose an HTTP endpoint as a streaming table; returns (queries, response_writer)."""
+    """Expose an HTTP endpoint as a streaming table; returns (queries, response_writer).
+    ``max_pending`` caps in-flight requests on the route (0 = unbounded): past
+    it — or while the optional ``overload_probe`` callable reports a saturated
+    downstream queue — requests are shed with 429 + ``Retry-After`` (estimated
+    by the optional ``retry_after`` callable) and counted on stage counter
+    ``shed_stage``."""
     if webserver is None:
         webserver = PathwayWebserver(host=host or "0.0.0.0", port=port or 8080)
     if schema is None:
         schema = sch.schema_from_types(query=str)
     subject = RestServerSubject(
         webserver, route, methods, schema, delete_completed_queries, request_validator,
-        documentation=documentation,
+        documentation=documentation, max_pending=max_pending, shed_stage=shed_stage,
+        retry_after=retry_after, overload_probe=overload_probe,
     )
     webserver._register_docs(route, methods, schema, documentation)
 

@@ -25,6 +25,7 @@ tokenize/encode timings, padded vs real token counts.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -107,6 +108,18 @@ class EmbedCache:
             }
 
 
+class EmbedOverloadError(RuntimeError):
+    """The embed admission queue is full; the caller should shed load. Raised
+    by direct ``QueryCoalescer.embed`` callers only — the REST plane consults
+    the same cap BEFORE admission (``overloaded`` probe wired through
+    ``rest_connector``) and sheds with HTTP 429 + ``Retry-After`` there, so an
+    admitted request never dies inside an engine commit."""
+
+    def __init__(self, message: str, *, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class _Request:
     __slots__ = ("texts", "arrived", "event", "rows", "error")
 
@@ -142,13 +155,21 @@ class QueryCoalescer:
         *,
         max_wait_ms: float = 2.0,
         max_batch: int = 256,
+        max_queue_rows: int = 0,
         after_batch: Callable[[List[str], Sequence[Any]], None] | None = None,
     ):
         self._encode_rows = encode_rows
         self.max_wait_ms = float(max_wait_ms)
         self.max_batch = max(1, int(max_batch))
+        # admission cap: rows allowed to WAIT for the encoder (0 = unbounded).
+        # Past it, embed() sheds with EmbedOverloadError instead of queueing —
+        # an overloaded encoder otherwise grows the queue without bound and
+        # every client's deadline contract silently dies
+        self.max_queue_rows = max(0, int(max_queue_rows))
         self._after_batch = after_batch
         self._queue: "deque[_Request]" = deque()
+        self._queued_rows = 0
+        self._encode_ewma_s = 0.0  # smoothed per-batch encode time (Retry-After)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._worker: threading.Thread | None = None
@@ -159,18 +180,54 @@ class QueryCoalescer:
         self.coalesced_rows = 0
         self.dedup_rows = 0
         self.max_batch_rows = 0
+        self.shed_requests = 0
+
+    def overloaded(self, extra_rows: int = 0) -> bool:
+        """Admission probe: would admitting ``extra_rows`` more rows exceed
+        ``max_queue_rows``? Lock-free read — a soft cap with bounded overshoot,
+        same contract as the REST ``max_pending`` check."""
+        return bool(
+            self.max_queue_rows
+            and self._queued_rows + extra_rows >= self.max_queue_rows
+        )
+
+    def retry_after_s(self, extra_rows: int = 0) -> float:
+        """Honest Retry-After estimate: batches needed to drain the current
+        queue x (batch window + smoothed encode time), floored at 1 s."""
+        rows = self._queued_rows + extra_rows
+        batches = max(1.0, rows / self.max_batch)
+        per_batch = self.max_wait_ms / 1000.0 + (self._encode_ewma_s or 0.05)
+        return max(1.0, batches * per_batch)
 
     # -- submission ----------------------------------------------------------
 
-    def embed(self, texts: List[str]) -> List[Any]:
-        """Blocking: returns one row value per input text, in order."""
+    def embed(self, texts: List[str], *, enforce_cap: bool = True) -> List[Any]:
+        """Blocking: returns one row value per input text, in order.
+        Raises :class:`EmbedOverloadError` when ``max_queue_rows`` is set and
+        admitting these rows would exceed it. The engine serving path passes
+        ``enforce_cap=False``: its requests were already admitted against the
+        same cap at the REST boundary (``overloaded`` probe), and raising
+        mid-commit would tear down the run instead of shedding one request."""
         if not texts:
             return []
         req = _Request(list(texts))
         with self._cond:
             if self._closed:
                 raise RuntimeError("QueryCoalescer is closed")
+            if (
+                enforce_cap
+                and self.max_queue_rows
+                and self._queued_rows + len(texts) > self.max_queue_rows
+            ):
+                self.shed_requests += 1
+                telemetry.stage_add("embed.shed")
+                raise EmbedOverloadError(
+                    f"embed queue full ({self._queued_rows} rows waiting, cap "
+                    f"{self.max_queue_rows})",
+                    retry_after_s=self.retry_after_s(len(texts)),
+                )
             self._queue.append(req)
+            self._queued_rows += len(texts)
             self.requests += 1
             if self._worker is None or not self._worker.is_alive():
                 self._worker = threading.Thread(
@@ -216,6 +273,7 @@ class QueryCoalescer:
                 req = self._queue.popleft()
                 take.append(req)
                 rows += len(req.texts)
+            self._queued_rows -= rows
             return take
 
     def _run(self) -> None:
@@ -237,8 +295,15 @@ class QueryCoalescer:
                     unique.append(t)
                 slot_of.append(j)
             try:
+                _t_enc = time.monotonic()
                 with telemetry.stage_timer("embed.coalesce_encode"):
                     out = self._encode_rows(unique)
+                # smoothed encode time feeds the Retry-After estimate
+                self._encode_ewma_s = (
+                    0.8 * self._encode_ewma_s + 0.2 * (time.monotonic() - _t_enc)
+                    if self._encode_ewma_s
+                    else time.monotonic() - _t_enc
+                )
                 rows = [out[j] for j in slot_of]
             except BaseException as exc:  # propagate to every waiter in the batch
                 for r in batch:
@@ -271,6 +336,7 @@ class QueryCoalescer:
             "coalesce_rows": self.coalesced_rows,
             "coalesce_dedup_rows": self.dedup_rows,
             "coalesce_max_batch_rows": self.max_batch_rows,
+            "coalesce_shed_requests": self.shed_requests,
         }
 
 
@@ -291,16 +357,27 @@ class EmbedPipeline:
         max_batch: int = 256,
         sub_batch: int = 128,
         cache_size: int = 50_000,
+        max_queue_rows: "int | None" = None,
     ):
         self.encoder = encoder
         self.sub_batch = int(sub_batch)
         self.cache = EmbedCache(cache_size, model=model)
         self._pad_padded = 0.0
         self._pad_real = 0.0
+        if max_queue_rows is None:
+            # coalescer admission cap (rows waiting for the encoder): the REST
+            # plane probes it pre-admission and sheds with 429 + Retry-After;
+            # 0 disables. Second line of defense behind the per-route
+            # max_pending request cap — rows, not requests, are what the
+            # encoder actually queues.
+            max_queue_rows = int(
+                os.environ.get("PATHWAY_EMBED_MAX_QUEUE_ROWS", "4096")
+            )
         self.coalescer = QueryCoalescer(
             self._encode_device_rows,
             max_wait_ms=max_wait_ms,
             max_batch=max_batch,
+            max_queue_rows=max_queue_rows,
             after_batch=self._fill_cache_from_device,
         )
 
@@ -353,7 +430,11 @@ class EmbedPipeline:
                 rows[i] = hit
         self._stage_cache_counts(len(texts) - len(miss_idx), len(miss_idx))
         if miss_idx:
-            got = self.coalescer.embed([str(texts[i]) for i in miss_idx])
+            # enforce_cap=False: REST admission already probed the cap; raising
+            # here would kill the engine commit instead of shedding one request
+            got = self.coalescer.embed(
+                [str(texts[i]) for i in miss_idx], enforce_cap=False
+            )
             for i, v in zip(miss_idx, got):
                 rows[i] = v
         return rows

@@ -26,6 +26,7 @@ import socket
 import struct
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -121,6 +122,20 @@ class ClusterExchange:
         # post-rejoin replay until the barrier deadline)
         self._future_inbox: Dict[tuple, tuple] = {}  # (peer, tag) -> (payload, epoch)
         self.stale_frames_dropped = 0
+        # incremental-rewind serve log: per-commit ring of every barrier this
+        # rank sent (tag -> per-peer parts), in order. A fenced survivor that
+        # rewound only the interrupted commit SERVES a replacement's tail
+        # replay from this log instead of resetting + replaying its own
+        # journal — the replayed commits regenerate the same deterministic tag
+        # sequence, so replaying the logged parts is indistinguishable from
+        # recomputing them. Bounded by PATHWAY_UNDO_RING_DEPTH commits and
+        # pruned at every coordinated checkpoint (replays never reach behind
+        # the manifest commit).
+        self._commit_log: "OrderedDict[int, List[tuple]]" = OrderedDict()
+        self._commit_log_open: Optional[int] = None
+        self.commit_log_depth = max(
+            0, int(_env_float("PATHWAY_UNDO_RING_DEPTH", 64))
+        )
         self.barrier_timeout_s = _env_float("PATHWAY_BARRIER_TIMEOUT_S", 300.0)
         self.heartbeat_interval_s = _env_float("PATHWAY_HEARTBEAT_INTERVAL_S", 1.0)
         self.heartbeat_timeout_s = _env_float("PATHWAY_HEARTBEAT_TIMEOUT_S", 60.0)
@@ -738,6 +753,52 @@ class ClusterExchange:
             if on_wait is not None:
                 on_wait()
 
+    # -- incremental-rewind serve log -----------------------------------------
+
+    def begin_commit_log(self, commit_id: int) -> None:
+        """Open the serve-log entry for one live commit: every barrier sent
+        until :meth:`end_commit_log` is recorded under this id. Called from the
+        single engine thread only."""
+        if self.commit_log_depth <= 0:
+            return
+        self._commit_log.pop(commit_id, None)
+        self._commit_log[commit_id] = []
+        self._commit_log_open = commit_id
+
+    def end_commit_log(self) -> None:
+        """Seal the open entry (the commit completed) and evict the oldest
+        entries past the depth bound."""
+        self._commit_log_open = None
+        while len(self._commit_log) > self.commit_log_depth:
+            self._commit_log.popitem(last=False)
+
+    def discard_open_commit_log(self) -> None:
+        """Drop the in-flight entry: an interrupted commit's partial barrier
+        stream must never be served (its tags will be regenerated live after
+        the rewind)."""
+        if self._commit_log_open is not None:
+            self._commit_log.pop(self._commit_log_open, None)
+            self._commit_log_open = None
+
+    def commit_log_covers(self, commit_ids: "List[int]") -> bool:
+        return all(cid in self._commit_log for cid in commit_ids)
+
+    def serve_commit_log(self, commit_id: int) -> int:
+        """Re-run every logged barrier of one commit with the ORIGINAL parts,
+        discarding what peers send back (a serving survivor already holds the
+        results in its live state). Returns the number of barriers served."""
+        entries = self._commit_log.get(commit_id, ())
+        for tag, parts in entries:
+            self.exchange_parts(tag, parts)
+        return len(entries)
+
+    def prune_commit_log(self, through_commit: int) -> None:
+        """Drop sealed entries ≤ ``through_commit`` (a durable checkpoint
+        manifest guarantees no replay will ever reach behind it)."""
+        for cid in [c for c in self._commit_log if c <= through_commit]:
+            if cid != self._commit_log_open:
+                del self._commit_log[cid]
+
     # -- collectives ----------------------------------------------------------
 
     def exchange_parts(self, tag: bytes, parts: Dict[int, bytes]) -> Dict[int, bytes]:
@@ -753,6 +814,10 @@ class ClusterExchange:
         counters; the flight recorder's ``note_barrier`` marks the tag in
         flight so a death mid-barrier names it in the dump."""
         recorder = _flight_recorder()
+        if self._commit_log_open is not None:
+            # live commit under the rewind contract: remember exactly what this
+            # barrier sent, so a post-fence serve can replay it verbatim
+            self._commit_log[self._commit_log_open].append((tag, dict(parts)))
         for peer in self._conns:
             self._send(peer, tag, parts.get(peer, b""))
         recorder.note_barrier(tag)
@@ -997,6 +1062,10 @@ class ThreadExchange(ClusterExchange):
         # same barrier-deadline knob as the TCP lane (no heartbeats here: a
         # thread peer cannot vanish silently, only wedge — which this catches)
         self.barrier_timeout_s = _env_float("PATHWAY_BARRIER_TIMEOUT_S", 300.0)
+        # no rejoin protocol -> no serve log (inherited exchange_parts reads these)
+        self._commit_log = OrderedDict()
+        self._commit_log_open = None
+        self.commit_log_depth = 0
 
     def _send(self, peer: int, tag: bytes, payload: Any) -> None:
         if payload is not None and hasattr(payload, "columns"):

@@ -27,15 +27,23 @@ On failure, the supervisor escalates down a three-rung ladder:
   relaunched, with ``PATHWAY_RESTART_COUNT`` bumped, ``PATHWAY_CLUSTER_EPOCH``
   advanced, and ``PATHWAY_CLUSTER_REJOIN=1``; survivors quiesce at the mesh's
   epoch fence instead of dying (``parallel/cluster.py``), take the
-  replacement's re-dial, and every rank rollback-resumes by lockstep-replaying
-  the union of journaled commit ids — seven healthy workers of a ``spawn -n
-  8`` keep their processes, sockets, and warmed state;
+  replacement's re-dial, and recover bounded-time: survivors undo only the
+  interrupted commit in place (incremental rewind) or fall back to replaying
+  their journal tail, while the replacement cold-starts from the latest
+  cluster checkpoint manifest + journal tail (``engine/runner.py``) — seven
+  healthy workers of a ``spawn -n 8`` keep their processes, sockets, and
+  warmed state, and rejoin latency stays flat however long the run has been
+  up. A rejoin that does not converge within
+  ``PATHWAY_SUPERVISOR_REJOIN_DEADLINE_S`` (default: the mesh fence timeout
+  + 30 s) gets its replacement shot and escalates down the ladder;
 - **restarts the cluster** — when surgical rejoin is off or itself fails
-  (second concurrent death, dropped rejoin handshake, fence timeout) and the
-  budget remains: survivors are torn down and all ranks relaunch with
-  ``PATHWAY_RESTART_COUNT`` bumped; the restarted workers replay the union of
-  journaled commit ids in lockstep (the engine's resume path), i.e. a
-  cluster-wide rollback-resume from the last fully journaled commit; or
+  (second concurrent death, dropped rejoin handshake, fence timeout, rejoin
+  deadline) and the budget remains: survivors are torn down and all ranks
+  relaunch with ``PATHWAY_RESTART_COUNT`` bumped; the restarted workers
+  restore the latest cluster checkpoint (when one was committed) and replay
+  the union of journaled commit ids past it in lockstep (the engine's resume
+  path), i.e. a cluster-wide rollback-resume from the last fully journaled
+  commit; or
 - **tears down loudly** — persistence off, no reports, or budget exhausted:
   every survivor is terminated and a per-rank post-mortem (exit cause, last
   commit, epoch at death, heartbeat age, who killed it) goes to stderr, and
@@ -100,6 +108,8 @@ def write_status(
     state: str = "running",
     restarts: int = 0,
     last_rejoin_s: "float | None" = None,
+    checkpoint_commit: "int | None" = None,
+    journal_tail_frames: "int | None" = None,
 ) -> None:
     """Atomically publish one worker's liveness record. Called from the commit
     loop (throttled there), so recency == the loop is actually turning; a
@@ -116,6 +126,10 @@ def write_status(
         "state": state,
         "restarts": int(restarts),
         "last_rejoin_s": last_rejoin_s,
+        # recovery-SLO fields (coordinated checkpoints): what the next rejoin
+        # would cost — its checkpoint base and the journal tail past it
+        "checkpoint_commit": checkpoint_commit,
+        "journal_tail_frames": journal_tail_frames,
         "ts": time.time(),
     }
     path = status_path(supervise_dir, rank)
@@ -192,6 +206,14 @@ class Supervisor:
         # flight; a second failure in this window degrades to restart-all
         self._rejoining: "Optional[tuple]" = None
         self.last_rejoin_s: "float | None" = None
+        # hard bound on a surgical rejoin: past it the replacement is killed
+        # and recovery escalates to restart-all. Defaults past the mesh fence
+        # timeout so parked survivors fail typed FIRST (deterministic order);
+        # tests/operators set it low to fail a wedged rejoin fast. 0 disables.
+        self.rejoin_deadline_s = _env_float(
+            "PATHWAY_SUPERVISOR_REJOIN_DEADLINE_S",
+            _env_float("PATHWAY_FENCE_TIMEOUT_S", 180.0) + 30.0,
+        )
         if stale_after_s is None:
             stale_after_s = _env_float(
                 "PATHWAY_SUPERVISOR_STALE_S", _default_stale_after()
@@ -317,9 +339,9 @@ class Supervisor:
             any_alive = False
             statuses = read_statuses(self._supervise_dir, self.n)
             up_for = time.monotonic() - self._launched_at
-            if self._rejoining is not None and len(statuses) == self.n:
+            if self._rejoining is not None:
                 rejoin_rank, started_at, target_epoch = self._rejoining
-                if all(
+                if len(statuses) == self.n and all(
                     int(s.get("epoch", 0) or 0) >= target_epoch
                     for s in statuses.values()
                 ):
@@ -329,6 +351,22 @@ class Supervisor:
                         f"{target_epoch} in {self.last_rejoin_s:.1f}s"
                     )
                     self._rejoining = None
+                elif (
+                    self.rejoin_deadline_s > 0
+                    and time.monotonic() - started_at > self.rejoin_deadline_s
+                ):
+                    # a wedged rejoin must not strand the fenced survivors for
+                    # the full fence/staleness bounds: shoot the replacement
+                    # and let run() escalate to restart-all (checkpoint+journal
+                    # rollback-resume), the next rung down the recovery ladder
+                    self._kill_wedged(rejoin_rank, self.handles[rejoin_rank])
+                    return (
+                        rejoin_rank,
+                        f"rejoin did not converge within "
+                        f"{self.rejoin_deadline_s:.0f}s "
+                        "(PATHWAY_SUPERVISOR_REJOIN_DEADLINE_S); replacement "
+                        "killed as wedged",
+                    )
             for rank, handle in enumerate(self.handles):
                 rc = handle.poll()
                 if rc is None:
@@ -457,6 +495,17 @@ class Supervisor:
                 )
                 if status.get("state") not in (None, "running"):
                     parts.append(f"state {status.get('state')}")
+                # what a recovery of this rank would cost: checkpoint base +
+                # journal tail past it (no checkpoint -> full-history replay)
+                if status.get("checkpoint_commit") is not None:
+                    tail = status.get("journal_tail_frames")
+                    parts.append(
+                        f"last cluster checkpoint at commit "
+                        f"{status['checkpoint_commit']}"
+                        + (f" (+{tail} journal tail frame(s))" if tail is not None else "")
+                    )
+                elif status.get("persistence"):
+                    parts.append("no cluster checkpoint (full-journal recovery)")
             else:
                 parts.append("no status report")
             flight = self._flight_dump_line(rank)

@@ -34,6 +34,15 @@ _FRAME_HEADER = struct.Struct(">Q")
 _JOURNAL = "journal.bin"
 _CHECKPOINT = "checkpoint.pkl"
 _STORE_META = "store.meta"
+# cluster-coordinated checkpoints: per-rank snapshots are VERSIONED by commit id
+# (the manifest names the commit every rank snapshotted at), unlike the
+# single-process checkpoint.pkl which is always "the latest"
+_CLUSTER_SNAPSHOT_FMT = "checkpoint-{commit:010d}.pkl"
+# the cluster checkpoint manifest lives at the UNSHARDED base root (it spans
+# every rank's shard) and is versioned too: a torn write of manifest N must
+# never destroy manifest N-1, so recovery can always fall back one checkpoint
+_CLUSTER_MANIFEST_FMT = "cluster-manifest-{commit:010d}.json"
+_CLUSTER_MANIFEST_PREFIX = "cluster-manifest-"
 # v2: header line is a json meta object carrying the graph signature PLUS the
 # key-derivation version and worker count — frames store derived row keys, so a
 # journal from a build with different key derivation (or replayed under a
@@ -136,8 +145,19 @@ class PersistenceManager:
         # byte offset of the last complete frame, set by load_journal; open_for_append
         # truncates torn tail bytes past it so new frames never land after garbage
         self._valid_end: Optional[int] = None
+        # frames appended since the last compaction — the journal-tail length the
+        # recovery SLO metrics report at each coordinated checkpoint
+        self.frames_since_compact = 0
         if not self._memory and self._object_store is None:
             os.makedirs(self.root, exist_ok=True)
+
+    @property
+    def supports_cluster_checkpoints(self) -> bool:
+        """Cluster-coordinated checkpoints need a store every rank (and a
+        relaunched replacement) can reopen — any durable backend. The in-memory
+        backends are per-process and die with the rank, so there is nothing a
+        manifest could coordinate."""
+        return self._object_store is not None or not self._memory
 
     def _journal_path(self) -> str:
         return os.path.join(self.root, _JOURNAL)
@@ -290,6 +310,7 @@ class PersistenceManager:
             protocol=pickle.HIGHEST_PROTOCOL,
         )
         self.last_commit_id = commit_id
+        self.frames_since_compact += 1
         if self._object_store is not None:
             self._object_store.put(self._frame_key(self._next_seq), frame)
             self._next_seq += 1
@@ -351,31 +372,17 @@ class PersistenceManager:
         """Atomically persist a full engine checkpoint (operator + source state), then
         compact the journal: frames ≤ ``commit_id`` are subsumed by the checkpoint.
         Crash between the two steps is safe — load skips subsumed frames by id."""
-        payload = pickle.dumps(
-            {
-                "sig": graph_sig,
-                "commit_id": commit_id,
-                "state": blob,
-                "key_derivation": KEY_DERIVATION_VERSION,
-                "workers": self._workers,
-            },
-            protocol=pickle.HIGHEST_PROTOCOL,
-        )
+        payload = self._snapshot_payload(graph_sig, commit_id, blob)
         if self._object_store is not None:
             # single-PUT checkpoint is atomic per key; then compact by deleting
             # the subsumed frame objects. A crash between the two steps leaves
             # stale frames <= commit_id, which load skips by id.
             self._object_store.put(self._checkpoint_key(), payload)
-            for key in self._object_store.list(f"{self._object_prefix}journal/"):
-                if key.endswith(".frame"):
-                    seq = int(key.rsplit("/", 1)[-1].split(".")[0])
-                    if seq < self._next_seq:
-                        self._object_store.delete(key)
+            self.compact_journal(graph_sig)
             return
         if self._memory:
             self._mem_checkpoint = payload
-            self._mem_journal = io.BytesIO()
-            self._mem_journal.write(self._header_bytes(graph_sig))
+            self.compact_journal(graph_sig)
             return
         tmp = os.path.join(self.root, _CHECKPOINT + ".tmp")
         with open(tmp, "wb") as f:
@@ -384,11 +391,7 @@ class PersistenceManager:
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.root, _CHECKPOINT))
         # compact: restart the journal after the checkpointed commit
-        header = self._header_bytes(graph_sig)
-        self._journal_file.truncate(len(header))
-        self._journal_file.seek(0, os.SEEK_END)
-        self._journal_file.flush()
-        os.fsync(self._journal_file.fileno())
+        self.compact_journal(graph_sig)
 
     def load_checkpoint(self, graph_sig: str) -> Optional[Tuple[int, dict]]:
         if self._object_store is not None:
@@ -425,6 +428,294 @@ class PersistenceManager:
             )
         self._check_meta(data, "checkpoint")
         return data["commit_id"], data["state"]
+
+    # -- cluster-coordinated checkpoints (manifest + per-rank snapshots) ------
+    #
+    # Protocol (driven by GraphRunner._coordinated_checkpoint, one attempt per
+    # cluster at one lockstep commit id):
+    #   1. every rank writes its VERSIONED snapshot (dump_cluster_snapshot) —
+    #      atomic + fsynced, no compaction yet;
+    #   2. ranks allgather durability acks;
+    #   3. rank 0 commits the manifest (commit_cluster_manifest) naming the
+    #      commit id and every rank's snapshot — written atomically, then READ
+    #      BACK and validated before it counts (a store that tears the bytes
+    #      must fail the checkpoint, not poison recovery);
+    #   4. after a durability barrier, every rank compacts its journal shard
+    #      and prunes snapshots/manifests older than the manifest commit.
+    # A crash at ANY point leaves the previous manifest + its snapshots + the
+    # uncompacted journal intact: recovery falls back one checkpoint,
+    # bit-identically.
+
+    def _cluster_snapshot_name(self, commit_id: int) -> str:
+        return _CLUSTER_SNAPSHOT_FMT.format(commit=commit_id)
+
+    def _snapshot_payload(self, graph_sig: str, commit_id: int, blob: dict) -> bytes:
+        return pickle.dumps(
+            {
+                "sig": graph_sig,
+                "commit_id": commit_id,
+                "state": blob,
+                "key_derivation": KEY_DERIVATION_VERSION,
+                "workers": self._workers,
+            },
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+
+    def dump_cluster_snapshot(self, graph_sig: str, commit_id: int, blob: dict) -> int:
+        """Write this rank's snapshot for one coordinated checkpoint attempt.
+        Atomic + durable, NO journal compaction (that waits for the manifest
+        barrier). Returns the snapshot size in bytes. Raises ``ConnectionError``
+        /``OSError`` on backend failure (including injected chaos faults) — the
+        caller acks "transient" and the cluster keeps the previous checkpoint."""
+        from pathway_tpu.internals.chaos import get_chaos
+
+        chaos = get_chaos()
+        if chaos is not None and chaos.checkpoint_fault("snapshot_error", self._rank_id()):
+            from pathway_tpu.internals.chaos import ChaosBackendError
+
+            raise ChaosBackendError(
+                f"chaos: injected snapshot write error at commit {commit_id}"
+            )
+        payload = self._snapshot_payload(graph_sig, commit_id, blob)
+        name = self._cluster_snapshot_name(commit_id)
+        if self._object_store is not None:
+            self._object_store.put(f"{self._object_prefix}{name}", payload)
+            return len(payload)
+        if self._memory:
+            raise OSError("cluster checkpoints need a durable persistence backend")
+        tmp = os.path.join(self.root, name + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, name))
+        return len(payload)
+
+    def load_cluster_snapshot(self, graph_sig: str, commit_id: int) -> dict:
+        """This rank's snapshot named by a durable manifest. Loud on ANY
+        failure: the manifest promised this snapshot exists, and the journal
+        frames it subsumes were compacted away — treating it as absent would
+        silently lose all checkpointed history."""
+        name = self._cluster_snapshot_name(commit_id)
+        payload: "bytes | None" = None
+        if self._object_store is not None:
+            payload = self._object_store.get(f"{self._object_prefix}{name}")
+        elif not self._memory:
+            path = os.path.join(self.root, name)
+            try:
+                with open(path, "rb") as f:
+                    payload = f.read()
+            except OSError:
+                payload = None
+        if payload is None:
+            raise ValueError(
+                f"cluster checkpoint snapshot {name!r} named by the manifest is "
+                "missing from this rank's shard; the journal alone cannot restore "
+                "state (it was compacted) — restore the snapshot from a copy or "
+                "clear the persistence directory to start fresh"
+            )
+        try:
+            data = pickle.loads(payload)
+        except Exception as exc:
+            raise ValueError(
+                f"cluster checkpoint snapshot {name!r} is unreadable; the journal "
+                "alone cannot restore state (it was compacted) — restore the "
+                "snapshot from a copy or clear the persistence directory"
+            ) from exc
+        if data.get("sig") != graph_sig:
+            raise ValueError(
+                "cluster checkpoint snapshot was written by a different dataflow "
+                "graph; clear the persistence directory or keep the program unchanged"
+            )
+        self._check_meta(data, "checkpoint snapshot")
+        return data["state"]
+
+    def _rank_id(self) -> int:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        return int(getattr(get_pathway_config(), "process_id", 0) or 0)
+
+    def _manifest_name(self, commit_id: int) -> str:
+        return _CLUSTER_MANIFEST_FMT.format(commit=commit_id)
+
+    def commit_cluster_manifest(
+        self, graph_sig: str, commit_id: int, epoch: int = 0
+    ) -> bool:
+        """Rank 0 only: durably commit the cluster checkpoint manifest, then
+        read it back and validate before declaring success. Returns False when
+        the write tore (injected or store-side) — the cluster then skips
+        compaction and the previous checkpoint stands."""
+        from pathway_tpu.internals.chaos import get_chaos
+
+        meta = {
+            "format": 1,
+            "sig": graph_sig,
+            "commit_id": int(commit_id),
+            "epoch": int(epoch),
+            "workers": self._workers,
+            "key_derivation": KEY_DERIVATION_VERSION,
+            "snapshots": {
+                str(rank): f"process-{rank}/{self._cluster_snapshot_name(commit_id)}"
+                if self._workers > 1
+                else self._cluster_snapshot_name(commit_id)
+                for rank in range(self._workers)
+            },
+        }
+        payload = json.dumps(meta, sort_keys=True).encode()
+        chaos = get_chaos()
+        if chaos is not None and chaos.checkpoint_fault("torn_manifest", self._rank_id()):
+            payload = payload[: max(1, len(payload) // 2)]  # simulated torn PUT
+        name = self._manifest_name(commit_id)
+        if self._object_store is not None:
+            self._object_store.put(name, payload)  # base root: UNPREFIXED key
+        else:
+            assert self._base_root is not None
+            os.makedirs(str(self._base_root), exist_ok=True)
+            tmp = os.path.join(str(self._base_root), name + f".tmp.{os.getpid()}")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(str(self._base_root), name))
+        # read-back verification: the manifest only counts if a fresh reader
+        # would accept it — this is what turns a torn write into a clean
+        # "checkpoint failed, previous one stands" instead of data loss
+        try:
+            loaded = self.load_cluster_manifest(graph_sig)
+        except ValueError:
+            return False
+        return loaded is not None and loaded["commit_id"] == int(commit_id)
+
+    def _manifest_candidates(self) -> List[tuple]:
+        """(commit_id, raw bytes) of every versioned manifest, unsorted."""
+        out: List[tuple] = []
+        if self._object_store is not None:
+            for key in self._object_store.list(_CLUSTER_MANIFEST_PREFIX):
+                tail = key[len(_CLUSTER_MANIFEST_PREFIX):].split(".")[0]
+                if not tail.isdigit():
+                    continue
+                blob = self._object_store.get(key)
+                if blob is not None:
+                    out.append((int(tail), blob))
+            return out
+        if self._memory or self._base_root is None:
+            return out
+        try:
+            names = os.listdir(str(self._base_root))
+        except OSError:
+            return out
+        for fname in names:
+            if not (
+                fname.startswith(_CLUSTER_MANIFEST_PREFIX) and fname.endswith(".json")
+            ):
+                continue
+            tail = fname[len(_CLUSTER_MANIFEST_PREFIX):-len(".json")]
+            if not tail.isdigit():
+                continue
+            try:
+                with open(os.path.join(str(self._base_root), fname), "rb") as f:
+                    out.append((int(tail), f.read()))
+            except OSError:
+                continue
+        return out
+
+    def load_cluster_manifest(self, graph_sig: str) -> Optional[dict]:
+        """The newest VALID cluster checkpoint manifest, or None. Torn/
+        unparseable manifests are skipped with a warning (recovery falls back
+        to the previous checkpoint); a manifest from a different graph, worker
+        count, or key-derivation version is refused loudly."""
+        best: Optional[dict] = None
+        for commit_id, raw in sorted(self._manifest_candidates(), reverse=True):
+            try:
+                meta = json.loads(raw)
+            except ValueError:
+                import logging
+
+                logging.getLogger("pathway_tpu").warning(
+                    "cluster checkpoint manifest for commit %d is torn/unreadable; "
+                    "falling back to the previous checkpoint",
+                    commit_id,
+                )
+                continue
+            if meta.get("sig") != graph_sig:
+                raise ValueError(
+                    "cluster checkpoint manifest was written by a different "
+                    "dataflow graph; clear the persistence directory or keep the "
+                    "program unchanged"
+                )
+            self._check_meta(meta, "cluster manifest")
+            if meta.get("commit_id") != commit_id:
+                continue  # name/content mismatch: treat as torn
+            best = meta
+            break
+        return best
+
+    def compact_journal(self, graph_sig: str) -> int:
+        """Drop every journal frame of this shard (all frames are ≤ the
+        checkpoint commit when this is called — the commit loop is sequential
+        and the checkpoint rides the current commit's barrier). Returns the
+        number of frames dropped."""
+        dropped = self.frames_since_compact
+        if self._object_store is not None:
+            for key in self._object_store.list(f"{self._object_prefix}journal/"):
+                if key.endswith(".frame"):
+                    seq = int(key.rsplit("/", 1)[-1].split(".")[0])
+                    if seq < self._next_seq:
+                        self._object_store.delete(key)
+        elif self._memory:
+            self._mem_journal = io.BytesIO()
+            self._mem_journal.write(self._header_bytes(graph_sig))
+        else:
+            header = self._header_bytes(graph_sig)
+            self._journal_file.truncate(len(header))
+            self._journal_file.seek(0, os.SEEK_END)
+            self._journal_file.flush()
+            os.fsync(self._journal_file.fileno())
+        self.frames_since_compact = 0
+        return dropped
+
+    def cleanup_cluster_checkpoints(self, keep_commit: int) -> None:
+        """Best-effort pruning AFTER a manifest is durable: drop this shard's
+        snapshots and (rank 0) manifests older than ``keep_commit``. Never
+        raises — a failed cleanup only leaves extra files behind."""
+        try:
+            if self._object_store is not None:
+                for key in self._object_store.list(self._object_prefix or ""):
+                    base = key.rsplit("/", 1)[-1]
+                    if base.startswith("checkpoint-") and base.endswith(".pkl"):
+                        tail = base[len("checkpoint-"):-len(".pkl")]
+                        if tail.isdigit() and int(tail) < keep_commit:
+                            self._object_store.delete(key)
+                if self._rank_id() == 0:
+                    for key in self._object_store.list(_CLUSTER_MANIFEST_PREFIX):
+                        tail = key[len(_CLUSTER_MANIFEST_PREFIX):].split(".")[0]
+                        if tail.isdigit() and int(tail) < keep_commit:
+                            self._object_store.delete(key)
+                return
+            if self._memory:
+                return
+            for fname in os.listdir(self.root):
+                if fname.startswith("checkpoint-") and fname.endswith(".pkl"):
+                    tail = fname[len("checkpoint-"):-len(".pkl")]
+                    if tail.isdigit() and int(tail) < keep_commit:
+                        try:
+                            os.unlink(os.path.join(self.root, fname))
+                        except OSError:
+                            pass
+            if self._rank_id() == 0 and self._base_root is not None:
+                for fname in os.listdir(str(self._base_root)):
+                    if (
+                        fname.startswith(_CLUSTER_MANIFEST_PREFIX)
+                        and fname.endswith(".json")
+                    ):
+                        tail = fname[len(_CLUSTER_MANIFEST_PREFIX):-len(".json")]
+                        if tail.isdigit() and int(tail) < keep_commit:
+                            try:
+                                os.unlink(os.path.join(str(self._base_root), fname))
+                            except OSError:
+                                pass
+        except OSError:
+            pass
 
     # -- journal read path ---------------------------------------------------
 
@@ -470,6 +761,11 @@ class PersistenceManager:
                         offsets,
                     )
                 )
+            # every surviving frame postdates the last compaction (compaction
+            # deletes all of them), so the loaded count IS the journal tail —
+            # without this a relaunched rank reports journal_tail_frames=0 and
+            # the recovery-SLO fields understate the next recovery's replay cost
+            self.frames_since_compact = len(frames_o)
             return frames_o
         if self._memory:
             data = self._mem_journal.getvalue()
@@ -506,4 +802,6 @@ class PersistenceManager:
             )
             pos = start + length
         self._valid_end = pos
+        # see the object-store branch: loaded frame count IS the current tail
+        self.frames_since_compact = len(frames)
         return frames

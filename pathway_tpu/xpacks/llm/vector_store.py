@@ -97,12 +97,35 @@ class VectorStoreServer:
         from pathway_tpu.io.http import PathwayWebserver, rest_connector
 
         webserver = PathwayWebserver(host=host, port=port)
+        # retrieve is the embed-bound route: cap admitted-but-unanswered
+        # queries so an embed stampede sheds (429 + Retry-After, counted as
+        # pathway_stage_total{stage="embed.shed"}) instead of queueing without
+        # bound in front of the encoder
+        import os as _os
+
+        max_pending = int(_os.environ.get("PATHWAY_EMBED_MAX_PENDING", "1024"))
+        coalescer = getattr(
+            getattr(self.store, "embedder", None) or self.embedder, "pipeline", None
+        )
+        coalescer = getattr(coalescer, "coalescer", None)
         retrieve_queries, retrieve_writer = rest_connector(
             webserver=webserver,
             route="/v1/retrieve",
             schema=self.QuerySchema,
             methods=("GET", "POST"),
             delete_completed_queries=True,
+            max_pending=max_pending,
+            shed_stage="embed.shed",
+            retry_after=(
+                coalescer.retry_after_s if coalescer is not None else None
+            ),
+            # second line of defense: the coalescer's row-queue cap
+            # (PATHWAY_EMBED_MAX_QUEUE_ROWS) probed pre-admission, so a slow
+            # encoder sheds on queued ROWS even while fewer than max_pending
+            # REQUESTS are in flight
+            overload_probe=(
+                coalescer.overloaded if coalescer is not None else None
+            ),
         )
         retrieve_writer(self.retrieve_query(retrieve_queries))
 

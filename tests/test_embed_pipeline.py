@@ -641,3 +641,107 @@ def test_pipeline_torture_many_threads(tiny_encoder):
     with concurrent.futures.ThreadPoolExecutor(64) as pool:
         list(pool.map(client, range(len(texts))))
     assert errors == []
+
+
+def test_coalescer_admission_cap_sheds_with_honest_retry_after():
+    """Backpressure slice (ISSUE 6): past ``max_queue_rows`` the coalescer
+    sheds direct callers with a typed EmbedOverloadError (the REST plane
+    probes the same cap pre-admission and sheds with 429 there) carrying an
+    honest Retry-After estimate, bumps the embed.shed stage counter, and
+    admits new work again once the queue drains."""
+    from pathway_tpu.engine import telemetry
+    from pathway_tpu.models.embed_pipeline import EmbedOverloadError
+
+    release = threading.Event()
+
+    def encode_rows(texts):
+        release.wait(10.0)
+        return _hash_rows(texts)
+
+    co = QueryCoalescer(
+        encode_rows, max_wait_ms=5.0, max_batch=1, max_queue_rows=2
+    )
+    done: dict = {}
+
+    def client(name, texts):
+        done[name] = co.embed(texts)
+
+    # a: popped by the worker (max_batch=1) and held inside encode_rows
+    ta = threading.Thread(target=client, args=("a", ["a"]))
+    ta.start()
+    deadline = time.perf_counter() + 5.0
+    while (co._queued_rows, co.requests) != (0, 1):
+        assert time.perf_counter() < deadline, "worker never picked up row a"
+        time.sleep(0.01)
+    # b: fills the admission queue exactly to the cap
+    tb = threading.Thread(target=client, args=("b", ["b1", "b2"]))
+    tb.start()
+    while co._queued_rows != 2:
+        assert time.perf_counter() < deadline, "row b never queued"
+        time.sleep(0.01)
+
+    shed_before = telemetry.stage_snapshot("embed.").get("embed.shed", 0.0)
+    with pytest.raises(EmbedOverloadError) as exc_info:
+        co.embed(["c"])
+    assert exc_info.value.retry_after_s >= 1.0
+    assert co.shed_requests == 1
+    assert telemetry.stage_snapshot("embed.").get("embed.shed", 0.0) == shed_before + 1
+
+    release.set()
+    ta.join(timeout=10.0)
+    tb.join(timeout=10.0)
+    assert np.array_equal(done["a"][0], _hash_rows(["a"])[0])
+    assert np.array_equal(done["b"][1], _hash_rows(["b2"])[0])
+    # the queue drained: admission opens again, no sticky overload state
+    assert np.array_equal(co.embed(["d"])[0], _hash_rows(["d"])[0])
+    assert co.shed_requests == 1
+    co.close()
+
+
+def test_coalescer_retry_after_scales_with_queue_depth():
+    """Retry-After must be an estimate, not a constant: a deeper queue names a
+    later retry (batches-to-drain x per-batch time, floored at 1 s)."""
+    co = QueryCoalescer(lambda t: _hash_rows(t), max_wait_ms=100.0, max_batch=2)
+    co._encode_ewma_s = 2.0  # pretend the encoder runs 2 s batches
+    shallow = co.retry_after_s(extra_rows=2)    # 1 batch to drain
+    deep = co.retry_after_s(extra_rows=20)      # 10 batches to drain
+    assert shallow >= 1.0
+    assert deep > shallow * 5
+    co.close()
+
+
+def test_coalescer_overload_probe_and_engine_path_bypass():
+    """``overloaded`` is the REST pre-admission probe for the row-queue cap;
+    ``embed(enforce_cap=False)`` (the engine serving path — its request was
+    already admitted against the cap at the REST boundary) never raises even
+    past the cap, so a race between admission and the commit cannot tear the
+    run down."""
+    co = QueryCoalescer(lambda t: _hash_rows(t), max_wait_ms=1.0, max_queue_rows=2)
+    assert not co.overloaded()
+    co._queued_rows = 2  # simulate a full queue without racing the worker
+    assert co.overloaded()
+    assert co.overloaded(extra_rows=1)
+    co._queued_rows = 0
+    assert not co.overloaded()
+    co._queued_rows = 5  # past the cap: enforce_cap=False must still admit
+    got = co.embed(["x", "y", "z"], enforce_cap=False)
+    assert np.array_equal(got[2], _hash_rows(["z"])[0])
+    assert co.shed_requests == 0
+    co.close()
+
+    unbounded = QueryCoalescer(lambda t: _hash_rows(t), max_wait_ms=1.0)
+    assert not unbounded.overloaded(extra_rows=10**9)  # cap 0 = disabled
+    unbounded.close()
+
+
+def test_embed_pipeline_wires_queue_cap_from_env(monkeypatch, tiny_encoder):
+    """EmbedPipeline passes PATHWAY_EMBED_MAX_QUEUE_ROWS through to its
+    coalescer (the knob was previously constructed-but-unwired), and an
+    explicit kwarg wins over the env."""
+    monkeypatch.setenv("PATHWAY_EMBED_MAX_QUEUE_ROWS", "17")
+    pipe = EmbedPipeline(tiny_encoder, model="t")
+    assert pipe.coalescer.max_queue_rows == 17
+    pipe.coalescer.close()
+    pipe2 = EmbedPipeline(tiny_encoder, model="t", max_queue_rows=0)
+    assert pipe2.coalescer.max_queue_rows == 0
+    pipe2.coalescer.close()

@@ -277,3 +277,83 @@ def test_kill_wedged_sends_sigterm_before_sigkill(tmp_path, capsys, monkeypatch)
     assert sup.handles[0].returncode == -signal_mod.SIGKILL
     err = capsys.readouterr().err
     assert "killed by supervisor for staleness" in err
+
+
+# rank 0 crashes on run 0; its surgical REPLACEMENT (run 1) wedges without
+# ever adopting the new epoch; run 2 (the restart-all rung) completes clean
+WEDGED_REJOIN_PROG = textwrap.dedent(
+    """
+    import json, os, signal, time
+    d = os.environ["PATHWAY_SUPERVISE_DIR"]
+    rank = int(os.environ["PATHWAY_PROCESS_ID"])
+    run = int(os.environ.get("PATHWAY_RESTART_COUNT", "0"))
+    path = os.path.join(d, f"rank-{rank}.status.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump({"pid": os.getpid(), "rank": rank, "commit": 7,
+                   "persistence": True, "peers": {}, "epoch": 0,
+                   "ts": time.time()}, f)
+    os.replace(path + ".tmp", path)
+    time.sleep(0.5)
+    if rank == 0 and run == 0:
+        os.kill(os.getpid(), signal.SIGKILL)
+    if rank == 0 and run == 1:
+        time.sleep(120)  # wedged rejoin: epoch never converges
+    time.sleep(2)
+    """
+)
+
+
+def test_wedged_rejoin_hits_deadline_and_escalates(tmp_path, capsys, monkeypatch):
+    """A surgical rejoin that never converges must not strand survivors for
+    the fence/staleness bounds: past PATHWAY_SUPERVISOR_REJOIN_DEADLINE_S the
+    replacement is shot and recovery escalates to restart-all."""
+    monkeypatch.setenv("PATHWAY_SUPERVISOR_REJOIN_DEADLINE_S", "1.5")
+    sup = _supervisor(tmp_path, WEDGED_REJOIN_PROG, max_restarts=2)
+    assert sup.run() == 0, "restart-all should have recovered the cluster"
+    assert sup.restarts_used == 2
+    err = capsys.readouterr().err
+    assert "surgically relaunching rank 0 only" in err
+    assert "rejoin did not converge within 2s" in err
+    assert "falling back to restart-all" in err
+    assert "restarting the cluster" in err
+
+
+def test_status_file_carries_checkpoint_fields(tmp_path):
+    """write_status publishes the recovery-SLO pair (checkpoint base commit +
+    journal tail frames) the post-mortems and /healthz consumers read."""
+    import json as json_mod
+
+    from pathway_tpu.parallel.supervisor import write_status
+
+    write_status(
+        str(tmp_path), 0, commit=9, persistence=True,
+        checkpoint_commit=42, journal_tail_frames=7,
+    )
+    payload = json_mod.load(open(status_path(str(tmp_path), 0)))
+    assert payload["checkpoint_commit"] == 42
+    assert payload["journal_tail_frames"] == 7
+
+
+def test_post_mortem_names_last_cluster_checkpoint(tmp_path, capsys):
+    """Triage needs to know what a recovery would have cost: the post-mortem
+    names the checkpoint base + journal tail when the rank published one."""
+    prog = textwrap.dedent(
+        """
+        import json, os, signal, time
+        d = os.environ["PATHWAY_SUPERVISE_DIR"]
+        rank = int(os.environ["PATHWAY_PROCESS_ID"])
+        path = os.path.join(d, f"rank-{rank}.status.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump({"pid": os.getpid(), "rank": rank, "commit": 50,
+                       "persistence": False, "peers": {},
+                       "checkpoint_commit": 42, "journal_tail_frames": 7,
+                       "ts": time.time()}, f)
+        os.replace(path + ".tmp", path)
+        time.sleep(0.5)
+        os.kill(os.getpid(), signal.SIGKILL)
+        """
+    )
+    sup = _supervisor(tmp_path, prog, n=1)
+    assert sup.run() != 0
+    err = capsys.readouterr().err
+    assert "last cluster checkpoint at commit 42 (+7 journal tail frame(s))" in err
