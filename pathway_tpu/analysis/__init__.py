@@ -1,0 +1,104 @@
+"""Graph lint: build-time static analysis over the operator DAG.
+
+Three surfaces share this one analyzer:
+
+- ``pathway_tpu.cli analyze program.py`` — builds the program's graph without
+  running it (``PATHWAY_LINT_CAPTURE``) and reports diagnostics, with
+  ``--format json`` + the 0/1/2 exit-code contract for CI gating;
+- an automatic check at graph-run time, gated by ``PATHWAY_LINT=off|warn|error``
+  (default ``warn``; ``error`` refuses to run a graph carrying error-severity
+  diagnostics);
+- telemetry mirroring: diagnostic counts ride the PR-5 stage counters
+  (``lint.*``) and a ``lint`` flight-recorder event, so post-mortems can say
+  "this graph ran with 2 known lint errors".
+
+Diagnostic codes: PWA001 determinism, PWA002 rewind-safety, PWA003 unbounded
+state, PWA004 device placement, PWA005 checkpoint compatibility.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, List, Optional, Tuple
+
+from pathway_tpu.analysis.framework import (
+    AnalysisContext,
+    AnalysisPass,
+    AnalysisReport,
+    Diagnostic,
+    GraphCaptureInterrupt,
+    GraphLintError,
+    PassManager,
+    Severity,
+)
+from pathway_tpu.analysis.passes import (
+    CheckpointCompatibilityPass,
+    DeterminismPass,
+    DevicePlacementPass,
+    RewindSafetyPass,
+    UnboundedStatePass,
+    default_passes,
+)
+
+__all__ = [
+    "AnalysisContext",
+    "AnalysisPass",
+    "AnalysisReport",
+    "Diagnostic",
+    "GraphCaptureInterrupt",
+    "GraphLintError",
+    "PassManager",
+    "Severity",
+    "analyze_graph",
+    "capture_program_graph",
+    "default_passes",
+    "CheckpointCompatibilityPass",
+    "DeterminismPass",
+    "DevicePlacementPass",
+    "RewindSafetyPass",
+    "UnboundedStatePass",
+]
+
+_CAPTURE_ENV = "PATHWAY_LINT_CAPTURE"
+
+
+def analyze_graph(
+    graph: Any = None,
+    *,
+    persistence: bool = False,
+    passes: "Optional[List[AnalysisPass]]" = None,
+) -> AnalysisReport:
+    """Run the lint pipeline over ``graph`` (default: the global parse graph)."""
+    return PassManager(passes).run(graph, persistence=persistence)
+
+
+def capture_program_graph(
+    program: str, arguments: "Tuple[str, ...]" = ()
+) -> Tuple[Any, bool]:
+    """Execute ``program`` up to its first ``pw.run`` and return
+    ``(parse graph, persistence enabled)`` without running the dataflow.
+
+    ``PATHWAY_LINT_CAPTURE`` makes ``GraphRunner.run`` raise
+    :class:`GraphCaptureInterrupt` before any commit; code after the first
+    ``pw.run`` (result assertions, cleanup) does not execute. A program that
+    never calls ``pw.run`` still leaves its operators in the global graph."""
+    import runpy
+
+    from pathway_tpu.internals import parse_graph as pg
+
+    prev_env = os.environ.get(_CAPTURE_ENV)
+    prev_argv = sys.argv
+    os.environ[_CAPTURE_ENV] = "1"
+    sys.argv = [program, *arguments]
+    try:
+        runpy.run_path(program, run_name="__main__")
+    except GraphCaptureInterrupt as interrupt:
+        return interrupt.graph, interrupt.persistence
+    finally:
+        sys.argv = prev_argv
+        if prev_env is None:
+            os.environ.pop(_CAPTURE_ENV, None)
+        else:
+            os.environ[_CAPTURE_ENV] = prev_env
+    return pg.G._current, False

@@ -1,0 +1,376 @@
+"""Graph-lint pass framework: diagnostics, analysis context, pass manager.
+
+A build-time static-analysis layer over the operator DAG in
+``internals/parse_graph.py``. Passes walk the parsed graph (never the running
+engine) and emit structured :class:`Diagnostic` records carrying the code,
+severity, message, and the user source location captured at operator-creation
+time (``internals/trace.py`` — the same frame that annotates runtime errors).
+
+The DAG walk, consumer maps, and dtype helpers here are deliberately
+evaluator-independent so ROADMAP item 3's whole-commit XLA fusion compiler can
+reuse them for partitioning decisions instead of re-deriving the graph shape.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals import parse_graph as pg
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:
+        return self.name.lower()
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One structured finding: code + severity + message + user source location."""
+
+    code: str
+    severity: Severity
+    message: str
+    node_id: int = -1
+    node_kind: str = ""
+    node_name: str = ""
+    file: Optional[str] = None
+    line: Optional[int] = None
+    function: Optional[str] = None
+    line_text: Optional[str] = None
+    details: Dict[str, Any] = field(default_factory=dict, compare=False)
+
+    @property
+    def location(self) -> str:
+        if self.file is None:
+            return ""
+        return f"{self.file}:{self.line}" if self.line is not None else self.file
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "code": self.code,
+            "severity": str(self.severity),
+            "message": self.message,
+            "node_id": self.node_id,
+            "node_kind": self.node_kind,
+            "node_name": self.node_name,
+        }
+        if self.file is not None:
+            out["file"] = self.file
+            out["line"] = self.line
+            out["function"] = self.function
+        if self.details:
+            out["details"] = self.details
+        return out
+
+    def format(self) -> str:
+        where = f" [{self.location}]" if self.location else ""
+        node = (
+            f" node#{self.node_id}({self.node_kind})" if self.node_id >= 0 else ""
+        )
+        text = f"{self.code} {self.severity}{node}: {self.message}{where}"
+        if self.line_text:
+            text += f"\n    {self.line_text.strip()}"
+        return text
+
+
+def _diag_from_node(
+    code: str, severity: Severity, message: str, node: "pg.Node | None", **details: Any
+) -> Diagnostic:
+    frame = getattr(node, "user_frame", None) if node is not None else None
+    return Diagnostic(
+        code=code,
+        severity=severity,
+        message=message,
+        node_id=node.id if node is not None else -1,
+        node_kind=node.kind if node is not None else "",
+        node_name=getattr(node, "name", "") if node is not None else "",
+        file=frame.filename if frame is not None else None,
+        line=frame.line_number if frame is not None else None,
+        function=frame.function if frame is not None else None,
+        line_text=frame.line if frame is not None else None,
+        details=details,
+    )
+
+
+_DEVICE_DTYPES = (dt.INT, dt.FLOAT, dt.BOOL)
+
+
+class AnalysisContext:
+    """Shared graph view handed to every pass: nodes, edges, expression and
+    dtype helpers. Built once per analysis run; passes must not mutate it."""
+
+    def __init__(self, graph: Any, *, persistence: bool = False):
+        self.graph = graph
+        self.nodes: List[pg.Node] = list(graph.nodes)
+        self.persistence = persistence
+        # consumer edges (node.id -> nodes reading its output table)
+        self._consumers: Dict[int, List[pg.Node]] = {}
+        for node in self.nodes:
+            for table in node.inputs:
+                self._consumers.setdefault(table._node.id, []).append(node)
+        self._upstream_cache: Dict[int, Set[int]] = {}
+
+    # -- DAG helpers ---------------------------------------------------------
+
+    def consumers(self, node: pg.Node) -> List[pg.Node]:
+        return self._consumers.get(node.id, [])
+
+    def producers(self, node: pg.Node) -> List[pg.Node]:
+        return [t._node for t in node.inputs]
+
+    def upstream_ids(self, node: pg.Node) -> Set[int]:
+        """All transitive producer node ids of ``node`` (excluding itself)."""
+        got = self._upstream_cache.get(node.id)
+        if got is not None:
+            return got
+        out: Set[int] = set()
+        stack = [t._node for t in node.inputs]
+        while stack:
+            up = stack.pop()
+            if up.id in out:
+                continue
+            out.add(up.id)
+            stack.extend(t._node for t in up.inputs)
+        self._upstream_cache[node.id] = out
+        return out
+
+    def evaluator_class(self, node: pg.Node) -> "type | None":
+        from pathway_tpu.engine.evaluators import EVALUATORS
+
+        return EVALUATORS.get(type(node))
+
+    # -- expression helpers --------------------------------------------------
+
+    # operator kinds whose config embeds a NESTED graph's tables/expressions;
+    # their inner expressions are analyzed through the inner graph, not here
+    NESTED_KINDS = frozenset(
+        {"iterate", "iterate_result", "row_transformer", "row_transformer_result"}
+    )
+
+    def expressions(self, node: pg.Node) -> Iterator[expr.ColumnExpression]:
+        """Every ColumnExpression in the node's config (top-level, not subtrees)."""
+        if node.kind in self.NESTED_KINDS:
+            return
+        seen: Set[int] = set()
+
+        def walk(value: Any) -> Iterator[expr.ColumnExpression]:
+            if isinstance(value, expr.ColumnExpression):
+                if id(value) not in seen:
+                    seen.add(id(value))
+                    yield value
+            elif isinstance(value, dict):
+                for v in value.values():
+                    yield from walk(v)
+            elif isinstance(value, (list, tuple)):
+                for v in value:
+                    yield from walk(v)
+
+        yield from walk(node.config)
+
+    @staticmethod
+    def expr_tree(root: expr.ColumnExpression) -> Iterator[expr.ColumnExpression]:
+        """The expression and all its subexpressions, preorder."""
+        stack = [root]
+        while stack:
+            e = stack.pop()
+            yield e
+            stack.extend(e._deps())
+
+    def apply_expressions(
+        self, node: pg.Node
+    ) -> Iterator[Tuple[expr.ColumnExpression, expr.ApplyExpression]]:
+        """(root expression, apply subexpression) pairs for every UDF call site."""
+        for root in self.expressions(node):
+            for e in self.expr_tree(root):
+                if isinstance(e, expr.ApplyExpression):
+                    yield root, e
+
+    def infer_dtype(self, e: expr.ColumnExpression) -> dt.DType:
+        from pathway_tpu.internals.type_interpreter import infer_dtype
+
+        try:
+            return infer_dtype(e)
+        except Exception:
+            return dt.ANY
+
+    def is_device_dtype(self, dtype: Any) -> bool:
+        """Device-friendly scalar dtypes: the expression evaluator lowers pure
+        numeric trees over these to one jitted XLA kernel."""
+        return any(dtype == d for d in _DEVICE_DTYPES)
+
+
+class AnalysisPass:
+    """One lint pass. Subclasses set ``code``/``title`` and implement ``run``."""
+
+    code: str = "PWA000"
+    title: str = ""
+
+    def run(self, ctx: AnalysisContext) -> List[Diagnostic]:
+        raise NotImplementedError
+
+    def diag(
+        self,
+        severity: Severity,
+        message: str,
+        node: "pg.Node | None" = None,
+        **details: Any,
+    ) -> Diagnostic:
+        return _diag_from_node(self.code, severity, message, node, **details)
+
+
+class AnalysisReport:
+    """All diagnostics of one analyzer run plus per-pass timings."""
+
+    def __init__(
+        self,
+        diagnostics: List[Diagnostic],
+        *,
+        node_count: int = 0,
+        pass_seconds: "Dict[str, float] | None" = None,
+    ):
+        self.diagnostics = diagnostics
+        self.node_count = node_count
+        self.pass_seconds = pass_seconds or {}
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.WARNING]
+
+    @property
+    def infos(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.INFO]
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def exit_code(self, *, strict: bool = False) -> int:
+        """CI contract: 0 clean, 1 warnings-only, 2 errors; ``strict`` promotes
+        warnings to the error exit."""
+        if self.errors:
+            return 2
+        if self.warnings:
+            return 2 if strict else 1
+        return 0
+
+    def summary_line(self) -> str:
+        return (
+            f"graph lint: {len(self.errors)} error(s), "
+            f"{len(self.warnings)} warning(s), {len(self.infos)} info "
+            f"over {self.node_count} operator(s)"
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+            "summary": {
+                "errors": len(self.errors),
+                "warnings": len(self.warnings),
+                "info": len(self.infos),
+                "nodes": self.node_count,
+                "pass_seconds": {k: round(v, 6) for k, v in self.pass_seconds.items()},
+            },
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True, default=str)
+
+    # -- telemetry mirroring (PR-5 metrics plane) ----------------------------
+
+    def emit_telemetry(self) -> None:
+        """Mirror counts into the stage counters and the flight recorder so a
+        post-mortem dump can say "this graph ran with N known lint errors"."""
+        from pathway_tpu.engine import telemetry
+        from pathway_tpu.engine.profile import get_flight_recorder
+
+        updates: Dict[str, float] = {
+            "lint.runs": 1.0,
+            "lint.errors": float(len(self.errors)),
+            "lint.warnings": float(len(self.warnings)),
+        }
+        codes: Dict[str, int] = {}
+        for d in self.diagnostics:
+            codes[d.code] = codes.get(d.code, 0) + 1
+        for code, count in codes.items():
+            updates[f"lint.diag.{code}"] = float(count)
+        telemetry.stage_add_many(updates)
+        recorder = get_flight_recorder()
+        if self.diagnostics:
+            recorder.record_event(
+                "lint",
+                errors=len(self.errors),
+                warnings=len(self.warnings),
+                codes=codes,
+            )
+
+
+class GraphLintError(Exception):
+    """``PATHWAY_LINT=error``: the graph carries error-severity diagnostics and
+    the run was refused before the first commit."""
+
+    def __init__(self, report: AnalysisReport):
+        self.report = report
+        lines = [report.summary_line()]
+        lines += [d.format() for d in report.errors]
+        lines.append("set PATHWAY_LINT=warn (or off) to run anyway")
+        super().__init__("\n".join(lines))
+
+
+class GraphCaptureInterrupt(BaseException):
+    """Raised by ``GraphRunner.run`` under ``PATHWAY_LINT_CAPTURE=1``: the graph
+    is fully built and the program must not execute. Derives from BaseException
+    so user-level ``except Exception`` blocks cannot swallow the capture."""
+
+    def __init__(self, graph: Any, *, persistence: bool = False):
+        self.graph = graph
+        self.persistence = persistence
+        super().__init__("graph captured for lint analysis; run suppressed")
+
+
+class PassManager:
+    """Runs a pass pipeline over one graph and folds the diagnostics."""
+
+    def __init__(self, passes: "List[AnalysisPass] | None" = None):
+        if passes is None:
+            from pathway_tpu.analysis.passes import default_passes
+
+            passes = default_passes()
+        self.passes = passes
+
+    def run(self, graph: Any = None, *, persistence: bool = False) -> AnalysisReport:
+        if graph is None:
+            graph = pg.G._current
+        ctx = AnalysisContext(graph, persistence=persistence)
+        diagnostics: List[Diagnostic] = []
+        timings: Dict[str, float] = {}
+        for p in self.passes:
+            t0 = time.perf_counter()
+            try:
+                found = p.run(ctx)
+            except Exception as exc:  # a broken pass must never block a run
+                found = [
+                    p.diag(
+                        Severity.INFO,
+                        f"analysis pass crashed ({type(exc).__name__}: {exc}); "
+                        "its diagnostics are unavailable for this graph",
+                    )
+                ]
+            diagnostics.extend(found)
+            timings[p.code] = time.perf_counter() - t0
+        diagnostics.sort(key=lambda d: (-int(d.severity), d.code, d.node_id))
+        return AnalysisReport(
+            diagnostics, node_count=len(ctx.nodes), pass_seconds=timings
+        )

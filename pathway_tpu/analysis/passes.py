@@ -1,0 +1,636 @@
+"""The five shipped graph-lint passes (PWA001–PWA005).
+
+Each pass walks the parsed operator DAG statically — no evaluator is
+instantiated, no source polled — so the analyzer is safe to run at graph build
+time, in CI (``pathway_tpu.cli analyze``), and before every ``pw.run``.
+"""
+
+from __future__ import annotations
+
+import dis
+import functools
+import types
+from typing import Any, Dict, Iterator, List, Set, Tuple
+
+from pathway_tpu.analysis.framework import (
+    AnalysisContext,
+    AnalysisPass,
+    Diagnostic,
+    Severity,
+)
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals import parse_graph as pg
+
+
+# ---------------------------------------------------------------------------
+# PWA001 — determinism: bytecode inspection of apply/UDF callables
+# ---------------------------------------------------------------------------
+
+# module -> attributes whose call yields a different value per invocation
+_NONDET_MODULE_ATTRS: Dict[str, Set[str]] = {
+    "time": {
+        "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+        "perf_counter_ns", "process_time", "process_time_ns", "clock_gettime",
+        "localtime", "gmtime", "ctime", "asctime",
+    },
+    "random": {
+        "random", "randint", "randrange", "getrandbits", "uniform", "choice",
+        "choices", "sample", "shuffle", "gauss", "normalvariate", "betavariate",
+        "expovariate", "triangular", "vonmisesvariate", "paretovariate",
+        "weibullvariate", "lognormvariate", "randbytes", "seed",
+    },
+    "uuid": {"uuid1", "uuid4"},
+    "secrets": {"token_bytes", "token_hex", "token_urlsafe", "randbelow", "choice", "randbits"},
+    "os": {"urandom", "getpid", "times"},
+    "datetime": {"now", "utcnow", "today"},
+}
+
+# names that are nondeterministic when loaded as bare globals
+# (``from time import time`` / ``from random import random`` style imports)
+_NONDET_DIRECT: Set[str] = {
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "urandom", "uuid1", "uuid4", "getrandbits",
+    "token_bytes", "token_hex", "token_urlsafe", "randint", "randrange",
+    "shuffle", "gauss", "uniform", "randbytes",
+}
+
+_MUTATOR_METHODS: Set[str] = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "remove", "discard", "clear", "setdefault", "sort", "reverse",
+    "__setitem__", "__delitem__", "appendleft", "extendleft",
+}
+
+_ATTR_OPS = {"LOAD_ATTR", "LOAD_METHOD"}
+
+
+def _unwrap_callable(fn: Any) -> Any:
+    """Follow wrapper chains down to the code-bearing user callable."""
+    seen: Set[int] = set()
+    while id(fn) not in seen:
+        seen.add(id(fn))
+        if isinstance(fn, functools.partial):
+            fn = fn.func
+            continue
+        wrapped = getattr(fn, "__wrapped__", None)
+        if wrapped is not None and wrapped is not fn:
+            fn = wrapped
+            continue
+        break
+    if not hasattr(fn, "__code__"):
+        call = getattr(fn, "__call__", None)
+        inner = getattr(call, "__func__", call)
+        if hasattr(inner, "__code__"):
+            return inner
+    return fn
+
+
+def _code_objects(code: types.CodeType) -> Iterator[types.CodeType]:
+    """The code object and every nested one (lambdas, comprehensions)."""
+    yield code
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            yield from _code_objects(const)
+
+
+def _nondet_value(value: Any, attr: "str | None") -> "str | None":
+    """Classify a resolved global/closure value (module, function, class) as a
+    nondeterminism source; returns a human-readable ``what`` or None."""
+    if value is None:
+        return None
+    if isinstance(value, types.ModuleType):
+        mod = value.__name__
+        if attr is not None:
+            if attr in _NONDET_MODULE_ATTRS.get(mod, ()):
+                return f"{mod}.{attr}()"
+            if mod == "numpy" and attr == "random":
+                return "numpy.random.*"
+        return None
+    if isinstance(value, type):  # e.g. datetime.datetime.now()
+        if getattr(value, "__module__", "") == "datetime" and attr in (
+            "now", "utcnow", "today",
+        ):
+            return f"datetime.{value.__name__}.{attr}()"
+        return None
+    # direct function reference (``from time import time``, bound random methods)
+    mod = getattr(value, "__module__", None)
+    name = getattr(value, "__name__", None)
+    if mod in _NONDET_MODULE_ATTRS and name in _NONDET_MODULE_ATTRS[mod]:
+        return f"{mod}.{name}()"
+    if mod == "nt" or mod == "posix":  # os.urandom is implemented in posix/nt
+        if name == "urandom":
+            return "os.urandom()"
+    return None
+
+
+def _nondet_chain(value: Any, attrs: "Tuple[str, ...]") -> "str | None":
+    """Classify ``value.attrs[0].attrs[1]...`` by resolving the attribute chain
+    step by step — catches ``datetime.datetime.now()`` (two attrs deep from the
+    module) as well as ``time.time()`` (one) and ``from time import time``
+    direct references (zero)."""
+    what = _nondet_value(value, attrs[0] if attrs else None)
+    if what is not None:
+        return what
+    if attrs and isinstance(value, (types.ModuleType, type)):
+        try:
+            step = getattr(value, attrs[0])
+        except Exception:
+            return None
+        return _nondet_chain(step, attrs[1:])
+    return None
+
+
+def _scan_callable(fn: Any) -> List[Tuple[str, str]]:
+    """(reason_kind, what) findings for one callable's bytecode tree.
+
+    Global and closure loads are resolved to their actual values where
+    possible, so ``import random`` at any enclosing scope is caught and a user
+    function merely *named* ``random`` is not; unresolvable names fall back to
+    name matching."""
+    code = fn.__code__
+    fn_globals: Dict[str, Any] = getattr(fn, "__globals__", {})
+    closure_values: Dict[str, Any] = {}
+    for name, cell in zip(code.co_freevars, getattr(fn, "__closure__", None) or ()):
+        try:
+            closure_values[name] = cell.cell_contents
+        except ValueError:
+            pass  # not yet filled (self-referential defs)
+
+    def resolve(opname: str, name: str) -> Tuple[Any, bool]:
+        """(value, resolved) for a LOAD_GLOBAL/LOAD_DEREF name."""
+        if opname == "LOAD_GLOBAL":
+            if name in fn_globals:
+                return fn_globals[name], True
+            builtins = fn_globals.get("__builtins__")
+            bdict = (
+                builtins if isinstance(builtins, dict) else getattr(builtins, "__dict__", {})
+            )
+            if name in bdict:
+                return bdict[name], True
+            return None, False
+        if name in closure_values:
+            return closure_values[name], True
+        return None, False
+
+    findings: List[Tuple[str, str]] = []
+    for co in _code_objects(code):
+        instrs = list(dis.get_instructions(co))
+        freevars = set(co.co_freevars)
+        for i, ins in enumerate(instrs):
+            nxt = instrs[i + 1] if i + 1 < len(instrs) else None
+            if ins.opname in ("LOAD_GLOBAL", "LOAD_DEREF"):
+                name = ins.argval
+                # consecutive attribute loads form one access chain
+                # (``datetime.datetime.now`` is LOAD_GLOBAL + two LOAD_ATTRs)
+                attrs: List[str] = []
+                j = i + 1
+                while (
+                    j < len(instrs)
+                    and instrs[j].opname in _ATTR_OPS
+                    and len(attrs) < 3
+                ):
+                    attrs.append(instrs[j].argval)
+                    j += 1
+                attr = attrs[0] if attrs else None
+                # nested code objects share the top callable's globals; their
+                # own cells are unresolvable statically and fall back to names
+                value, resolved = resolve(ins.opname, name)
+                if resolved:
+                    what = _nondet_chain(value, tuple(attrs))
+                    if what is not None:
+                        findings.append(("nondet_call", what))
+                elif name in _NONDET_MODULE_ATTRS and attr is not None:
+                    if attr in _NONDET_MODULE_ATTRS[name]:
+                        findings.append(("nondet_call", f"{name}.{attr}()"))
+                elif name in _NONDET_DIRECT and ins.opname == "LOAD_GLOBAL":
+                    findings.append(("nondet_call", f"{name}()"))
+            if ins.opname == "STORE_GLOBAL":
+                findings.append(("global_mutation", f"writes global {ins.argval!r}"))
+            elif ins.opname == "DELETE_GLOBAL":
+                findings.append(("global_mutation", f"deletes global {ins.argval!r}"))
+            elif ins.opname == "STORE_DEREF" and ins.argval in freevars:
+                findings.append(
+                    ("nonlocal_mutation", f"rebinds closed-over {ins.argval!r}")
+                )
+            elif (
+                ins.opname == "LOAD_DEREF"
+                and ins.argval in freevars
+                and nxt is not None
+                and nxt.opname in _ATTR_OPS
+                and nxt.argval in _MUTATOR_METHODS
+            ):
+                findings.append(
+                    (
+                        "closure_mutation",
+                        f"mutates closed-over {ins.argval!r} via .{nxt.argval}()",
+                    )
+                )
+            elif ins.opname == "STORE_SUBSCR" and i >= 2:
+                # ``container[key] = value`` pushes value, container, key: the
+                # CONTAINER load sits two instructions back when the key is a
+                # single load. Matching the exact position (not "any deref
+                # nearby") keeps a local dict indexed by a closed-over KEY from
+                # being flagged; multi-instruction keys are conservatively
+                # skipped — an error-severity false positive blocks CI.
+                prev = instrs[i - 2]
+                if prev.opname == "LOAD_DEREF" and prev.argval in freevars:
+                    findings.append(
+                        (
+                            "closure_mutation",
+                            f"item-assigns into closed-over {prev.argval!r}",
+                        )
+                    )
+    return findings
+
+
+_REASON_TEXT = {
+    "nondet_call": "calls a nondeterministic source",
+    "global_mutation": "mutates global state",
+    "nonlocal_mutation": "mutates enclosing-scope state",
+    "closure_mutation": "mutates state captured in its closure",
+}
+
+
+class DeterminismPass(AnalysisPass):
+    """PWA001: a UDF whose bytecode reaches ``time``/``random``/``uuid``/
+    ``os.urandom`` — or mutates global/closure state — produces different
+    values on a journal/checkpoint replay, silently breaking the bit-identical
+    recovery contract every rung of the failure ladder depends on."""
+
+    code = "PWA001"
+    title = "nondeterministic or stateful UDF"
+
+    def __init__(self) -> None:
+        # one bytecode scan per distinct callable per analysis run, not per
+        # apply site: a shared UDF selected in hundreds of nodes scans once
+        # (keyed by id(fn); the stored fn reference keeps the id stable)
+        self._scan_cache: Dict[int, Tuple[Any, List[Tuple[str, str]]]] = {}
+
+    def _findings(self, fn: Any) -> List[Tuple[str, str]]:
+        got = self._scan_cache.get(id(fn))
+        if got is not None and got[0] is fn:
+            return got[1]
+        findings = _scan_callable(fn)
+        self._scan_cache[id(fn)] = (fn, findings)
+        return findings
+
+    def run(self, ctx: AnalysisContext) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for node in ctx.nodes:
+            seen: Set[Tuple[str, str, str]] = set()
+            for _root, apply_e in ctx.apply_expressions(node):
+                fn = getattr(apply_e, "_source_fun", None) or apply_e._fun
+                fn = _unwrap_callable(fn)
+                if getattr(fn, "__code__", None) is None:
+                    continue  # builtins / C callables: nothing to inspect
+                fn_name = getattr(fn, "__name__", "<udf>")
+                for kind, what in self._findings(fn):
+                    key = (fn_name, kind, what)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    deterministic = bool(getattr(apply_e, "_deterministic", False))
+                    out.append(
+                        self.diag(
+                            Severity.ERROR,
+                            f"UDF {fn_name!r} {_REASON_TEXT[kind]} ({what}); its "
+                            "output cannot be reproduced by a journal replay, so "
+                            "recovery and rejoin would silently diverge"
+                            + (
+                                " (declared deterministic=True, which replay "
+                                "relies on)"
+                                if deterministic
+                                else ""
+                            ),
+                            node,
+                            udf=fn_name,
+                            reason=kind,
+                            what=what,
+                        )
+                    )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# PWA002 — rewind safety: propagate REWIND_SAFE through the DAG
+# ---------------------------------------------------------------------------
+
+
+class RewindSafetyPass(AnalysisPass):
+    """PWA002: drain-sensitive operators (``REWIND_SAFE=False`` on the
+    evaluator class) disable the cheapest recovery rung — incremental rewind —
+    for the whole graph. Under persistence this is a build-time warning instead
+    of a mis-fired rung discovered during a failover."""
+
+    code = "PWA002"
+    title = "drain-sensitive operator disables incremental rewind"
+
+    def run(self, ctx: AnalysisContext) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        unsafe = [
+            node
+            for node in ctx.nodes
+            if not getattr(ctx.evaluator_class(node) or object, "REWIND_SAFE", True)
+        ]
+        if not unsafe:
+            return out
+        severity = Severity.WARNING if ctx.persistence else Severity.INFO
+        unsafe_ids = {n.id for n in unsafe}
+        for node in unsafe:
+            # every node downstream of an unsafe one recovers through rung 2+
+            affected = sum(
+                1 for n in ctx.nodes if node.id in ctx.upstream_ids(n)
+            )
+            out.append(
+                self.diag(
+                    severity,
+                    f"operator {node.kind!r} is not rewind-safe: a fenced "
+                    "survivor cannot undo an interrupted commit in place, so "
+                    "recovery skips the incremental-rewind rung and pays a "
+                    "checkpoint + tail replay instead"
+                    + (
+                        ""
+                        if ctx.persistence
+                        else " (informational: persistence is not enabled)"
+                    ),
+                    node,
+                    downstream_operators=affected,
+                    rewind_unsafe_total=len(unsafe_ids),
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# PWA003 — unbounded state: stateful operators over unbounded streams
+# ---------------------------------------------------------------------------
+
+# kinds whose evaluator accumulates state per distinct key/row, growing without
+# bound when fed an unbounded stream with no forget/TTL upstream
+_STATEFUL_KINDS: Dict[str, str] = {
+    "groupby": "per-group aggregates",
+    "join": "both sides' matched rows",
+    "deduplicate": "the last row of every key",
+    "sort": "the full sorted key set",
+    "sorted_index": "one tree node per row",
+    "stateful_reduce": "per-key accumulator state",
+    "gradual_broadcast": "per-row threshold positions",
+}
+
+_FORGETTING_KINDS = frozenset({"forget"})
+
+
+class UnboundedStatePass(AnalysisPass):
+    """PWA003: a stateful evaluator fed by an unbounded streaming source with
+    no ``forget``/TTL operator on the path accumulates state forever — the
+    process OOMs eventually; windows want a temporal behavior (cutoff/delay)
+    that compiles to a forget upstream."""
+
+    code = "PWA003"
+    title = "unbounded state over an unbounded stream"
+
+    def _unbounded_inputs(self, ctx: AnalysisContext) -> List[pg.Node]:
+        from pathway_tpu.engine.datasource import StreamingDataSource
+
+        out = []
+        for node in ctx.nodes:
+            if not isinstance(node, pg.InputNode):
+                continue
+            # static/batch-mode connectors ride a StreamingDataSource too but
+            # declare themselves bounded on the node (fs.read mode="static")
+            if not node.config.get("streaming", True):
+                continue
+            source = node.config.get("source")
+            if isinstance(source, StreamingDataSource) and not getattr(
+                source, "loopback", False
+            ):
+                out.append(node)
+        return out
+
+    def run(self, ctx: AnalysisContext) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        unbounded = self._unbounded_inputs(ctx)
+        if not unbounded:
+            return out
+        forgetters = [n for n in ctx.nodes if n.kind in _FORGETTING_KINDS]
+        for node in ctx.nodes:
+            what = _STATEFUL_KINDS.get(node.kind)
+            if what is None and node.kind == "external_index":
+                # live re-answered queries (asof_now=False) pin every query row
+                if node.config.get("asof_now", True):
+                    continue
+                what = "every live query for re-answering"
+            if what is None:
+                continue
+            ups = ctx.upstream_ids(node)
+            feeding = [src for src in unbounded if src.id in ups]
+            if not feeding:
+                continue
+            # a source is bounded only when EVERY path from it to this node
+            # passes through a forget: walk backward from the node, refusing to
+            # expand through forget nodes — any source still reached has a
+            # forget-free path and feeds unbounded rows (a forget on a sibling
+            # branch of a join must not mask the uncovered branch)
+            forget_ids = {f.id for f in forgetters}
+            reachable: Set[int] = set()
+            stack = list(node.inputs)
+            while stack:
+                producer = stack.pop()._node
+                if producer.id in reachable or producer.id in forget_ids:
+                    continue
+                reachable.add(producer.id)
+                stack.extend(producer.inputs)
+            uncovered = [src for src in feeding if src.id in reachable]
+            if not uncovered:
+                continue
+            out.append(
+                self.diag(
+                    Severity.WARNING,
+                    f"stateful operator {node.kind!r} keeps {what} and is fed "
+                    f"by unbounded streaming source(s) "
+                    f"{sorted(s.id for s in uncovered)} with no forget/TTL "
+                    "upstream: its state grows without bound; add a temporal "
+                    "behavior (cutoff) or ``_forget`` upstream, or feed it a "
+                    "bounded source",
+                    node,
+                    sources=sorted(s.id for s in uncovered),
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# PWA004 — device placement: dtype propagation + device kwarg consistency
+# ---------------------------------------------------------------------------
+
+
+class DevicePlacementPass(AnalysisPass):
+    """PWA004: (a) a host Python UDF embedded inside a numeric expression tree
+    splits what would lower to ONE jitted XLA kernel into device→host→device
+    round-trips every commit; (b) KNN/embed stores configured with differing
+    ``device=`` kwargs ping-pong batches between devices at every handoff."""
+
+    code = "PWA004"
+    title = "host/device placement hazard"
+
+    def run(self, ctx: AnalysisContext) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        out.extend(self._pingpong(ctx))
+        out.extend(self._device_kwargs(ctx))
+        return out
+
+    def _pingpong(self, ctx: AnalysisContext) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for node in ctx.nodes:
+            flagged: Set[int] = set()
+            for root in ctx.expressions(node):
+                for e in ctx.expr_tree(root):
+                    if not isinstance(
+                        e,
+                        (expr.ColumnBinaryOpExpression, expr.ColumnUnaryOpExpression),
+                    ):
+                        continue
+                    for sub in ctx.expr_tree(e):
+                        if sub is e or not isinstance(sub, expr.ApplyExpression):
+                            continue
+                        if id(sub) in flagged:
+                            continue
+                        args = sub._args + tuple(sub._kwargs.values())
+                        if not args:
+                            continue
+                        if not all(
+                            ctx.is_device_dtype(ctx.infer_dtype(a)) for a in args
+                        ):
+                            continue
+                        if not ctx.is_device_dtype(sub._return_type):
+                            continue
+                        flagged.add(id(sub))
+                        fn = getattr(sub, "_source_fun", None) or sub._fun
+                        fn_name = getattr(
+                            _unwrap_callable(fn), "__name__", "<udf>"
+                        )
+                        out.append(
+                            self.diag(
+                                Severity.WARNING,
+                                f"host UDF {fn_name!r} sits inside a numeric "
+                                "expression chain whose surrounding ops lower "
+                                "to one fused device kernel: every commit pays "
+                                "a device→host→device round-trip; hoist the "
+                                "UDF out of the numeric chain or express it "
+                                "with column operators",
+                                node,
+                                udf=fn_name,
+                            )
+                        )
+            del flagged
+        return out
+
+    def _device_kwargs(self, ctx: AnalysisContext) -> List[Diagnostic]:
+        placements: List[Tuple[pg.Node, Any]] = []
+
+        from pathway_tpu.internals.table import Table
+
+        def probe(node: pg.Node, value: Any, depth: int = 0) -> None:
+            # a Table column named "device" is a ColumnReference, not a placement
+            if depth > 3 or isinstance(value, (expr.ColumnExpression, pg.Node, Table)):
+                return
+            if isinstance(value, dict):
+                for v in value.values():
+                    probe(node, v, depth + 1)
+                return
+            if isinstance(value, (list, tuple)):
+                for v in value:
+                    probe(node, v, depth + 1)
+                return
+            if isinstance(value, (str, bytes, int, float, bool, type(None), type)):
+                return
+            if isinstance(value, types.ModuleType) or callable(value):
+                return
+            device = getattr(value, "device", None)
+            if device is not None and not isinstance(device, property):
+                placements.append((node, device))
+
+        for node in ctx.nodes:
+            probe(node, node.config)
+        distinct = {str(d) for _, d in placements}
+        if len(distinct) <= 1:
+            return []
+        return [
+            self.diag(
+                Severity.WARNING,
+                f"store/operator pinned to device {d!s} while other operators "
+                f"in this graph use {sorted(distinct - {str(d)})}: batches "
+                "ping-pong between devices at every handoff; pin all stores "
+                "of one pipeline to one device (or shard explicitly)",
+                node,
+                device=str(d),
+                devices_in_graph=sorted(distinct),
+            )
+            for node, d in placements
+        ]
+
+
+# ---------------------------------------------------------------------------
+# PWA005 — checkpoint compatibility under persistence
+# ---------------------------------------------------------------------------
+
+
+class CheckpointCompatibilityPass(AnalysisPass):
+    """PWA005: under persistence, operators whose state sits outside the
+    snapshot protocol (``SNAPSHOT_CAPTURE=False``) abort or silently weaken
+    checkpoints, and sources with no resumable offset state re-ingest rows on
+    resume. Quiet when persistence is off — nothing is promised then."""
+
+    code = "PWA005"
+    title = "operator/source incompatible with checkpointing"
+
+    def run(self, ctx: AnalysisContext) -> List[Diagnostic]:
+        if not ctx.persistence:
+            return []
+        from pathway_tpu.engine.datasource import DataSource
+
+        out: List[Diagnostic] = []
+        for node in ctx.nodes:
+            cls = ctx.evaluator_class(node)
+            if cls is not None and not getattr(cls, "SNAPSHOT_CAPTURE", True):
+                out.append(
+                    self.diag(
+                        Severity.ERROR,
+                        f"operator {node.kind!r} holds state outside the "
+                        "snapshot protocol (device-resident or externally "
+                        "mutated): a cluster checkpoint either aborts "
+                        "(UnpicklableStateError) or restores without it; "
+                        "recovery falls back to full journal replay — disable "
+                        "checkpoint compaction or keep this operator out of "
+                        "persistence-enabled graphs",
+                        node,
+                        evaluator=cls.__name__,
+                    )
+                )
+            if isinstance(node, pg.InputNode):
+                source = node.config.get("source")
+                if source is None:
+                    continue
+                if type(source).offset_state is DataSource.offset_state:
+                    out.append(
+                        self.diag(
+                            Severity.WARNING,
+                            f"input source {type(source).__name__!r} has no "
+                            "resumable offset state: a persistence resume "
+                            "cannot tell which rows were already journaled and "
+                            "will re-ingest them; implement "
+                            "``offset_state``/``restore``",
+                            node,
+                            source=type(source).__name__,
+                        )
+                    )
+        return out
+
+
+def default_passes() -> List[AnalysisPass]:
+    return [
+        DeterminismPass(),
+        RewindSafetyPass(),
+        UnboundedStatePass(),
+        DevicePlacementPass(),
+        CheckpointCompatibilityPass(),
+    ]

@@ -142,6 +142,53 @@ def replay(threads, processes, first_port, record_path, mode, continue_after_rep
     )
 
 
+@cli.command(context_settings=_SPAWN_SETTINGS)
+@click.option(
+    "--format",
+    "fmt",
+    type=click.Choice(["text", "json"], case_sensitive=False),
+    default="text",
+    show_default=True,
+    help="diagnostic output format (json is stable for CI parsing)",
+)
+@click.option(
+    "--strict",
+    is_flag=True,
+    help="treat warnings as errors for the exit code (exit 2 instead of 1)",
+)
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def analyze(fmt, strict, program, arguments):
+    """Static graph lint: build PROGRAM's dataflow graph without running it and
+    report PWA001-PWA005 diagnostics.
+
+    Exit-code contract (CI-gateable without parsing text): 0 = clean,
+    1 = warnings only (2 with --strict), 2 = errors, 3 = PROGRAM itself crashed
+    while building its graph (nothing was analyzed). The program executes up to
+    its first ``pw.run`` call; the dataflow itself never starts."""
+    import traceback
+
+    from pathway_tpu.analysis import analyze_graph, capture_program_graph
+
+    try:
+        graph, persistence = capture_program_graph(program, tuple(arguments))
+    except Exception:
+        # a crash in the analyzed program must not collide with the 0/1/2
+        # diagnostic contract (an uncaught ImportError would exit 1 — the
+        # "warnings only, acceptable" code)
+        traceback.print_exc()
+        click.echo(f"analyze: {program} crashed before its graph was built", err=True)
+        sys.exit(3)
+    report = analyze_graph(graph, persistence=persistence)
+    if fmt.lower() == "json":
+        click.echo(report.to_json())
+    else:
+        for diagnostic in report.diagnostics:
+            click.echo(diagnostic.format())
+        click.echo(report.summary_line())
+    sys.exit(report.exit_code(strict=strict))
+
+
 @cli.command()
 def spawn_from_env():
     cli_spawn_arguments = os.environ.get("PATHWAY_SPAWN_ARGS")

@@ -416,7 +416,12 @@ def _capture_table(table: Table, *, terminate_on_error: bool = True) -> Dict[byt
             captured.pop(kb, None)
 
     G.add_node(pg.OutputNode(inputs=[table], callback=on_change))
-    GraphRunner(G).run(terminate_on_error=terminate_on_error)
+    runner = GraphRunner(G)
+    # local inspection helper, not a production run: no lint gate (a debug
+    # print must never be refused by PATHWAY_LINT=error) and no analyze-mode
+    # capture interrupt (the analyzed program keeps executing past this call)
+    runner.lint_exempt = True
+    runner.run(terminate_on_error=terminate_on_error)
     return captured
 
 
@@ -427,7 +432,9 @@ def _capture_update_stream(table: Table, *, terminate_on_error: bool = True) -> 
         updates.append({"__key__": key, "__time__": time, "__diff__": 1 if is_addition else -1, **row})
 
     G.add_node(pg.OutputNode(inputs=[table], callback=on_change))
-    GraphRunner(G).run(terminate_on_error=terminate_on_error)
+    runner = GraphRunner(G)
+    runner.lint_exempt = True  # see _capture_table
+    runner.run(terminate_on_error=terminate_on_error)
     return updates
 
 

@@ -106,7 +106,16 @@ class Evaluator:
     # place. Set False on operators whose per-commit state snapshot is
     # unreasonable (huge or externally mutated in place) — the graph then
     # skips the rewind rung and fences use checkpoint + tail replay.
+    # The PWA002 graph-lint pass (pathway_tpu/analysis) reports every
+    # REWIND_SAFE=False operator at build time; any evaluator that flushes on
+    # ``runner.draining`` (a live-only signal replay cannot reproduce) MUST set
+    # this False — tests/test_analysis.py audits that invariant by source scan.
     REWIND_SAFE = True
+    # False when this operator's state sits outside the snapshot protocol
+    # (device-resident / externally mutated): state_dict() would abort the
+    # checkpoint or restore an empty shell. The PWA005 lint pass reports such
+    # operators in persistence-enabled graphs at build time.
+    SNAPSHOT_CAPTURE = True
 
     def cluster_input_policy(self, idx: int) -> str | None:
         return self.CLUSTER_POLICIES.get(idx)
@@ -2208,6 +2217,10 @@ class ExternalIndexEvaluator(Evaluator):
     # the index mutates in place (possibly device-resident pages); pickling it
     # every commit for an undo record would dwarf the tail replay it avoids
     REWIND_SAFE = False
+    # checkpoints cannot capture the device-resident index either: a restore
+    # rebuilds it only through journal replay (PWA005 flags this under
+    # persistence so the weaker recovery contract is visible at build time)
+    SNAPSHOT_CAPTURE = False
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)

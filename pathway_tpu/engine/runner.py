@@ -1680,6 +1680,40 @@ class GraphRunner:
             self._http_server.close()
             self._http_server = None
 
+    def _lint_gate(self, *, persistence: bool) -> None:
+        """Automatic graph lint before the first commit, gated by
+        ``PATHWAY_LINT=off|warn|error`` (default ``warn``). Diagnostics are
+        logged, mirrored into the stage counters + flight recorder, and under
+        ``error`` an error-severity finding refuses the run (GraphLintError)."""
+        import logging
+
+        mode = os.environ.get("PATHWAY_LINT", "warn").strip().lower()
+        if mode in ("off", "0", "false", "no", "none", ""):
+            return
+        if mode not in ("warn", "error"):
+            # a typo (PATHWAY_LINT=errors) must not silently disarm the gate
+            logging.getLogger("pathway_tpu.analysis").warning(
+                "unrecognized PATHWAY_LINT=%r (expected off|warn|error); "
+                "falling back to 'warn' — errors will NOT refuse the run",
+                mode,
+            )
+            mode = "warn"
+        if getattr(self, "_lint_done", False):
+            return
+        self._lint_done = True
+        from pathway_tpu.analysis import GraphLintError, analyze_graph
+
+        report = analyze_graph(self.graph, persistence=persistence)
+        report.emit_telemetry()
+        if report.diagnostics:
+            log = logging.getLogger("pathway_tpu.analysis")
+            for d in report.errors + report.warnings:
+                log.warning("%s", d.format())
+            for d in report.infos:
+                log.info("%s", d.format())
+        if mode == "error" and report.errors:
+            raise GraphLintError(report)
+
     def run(
         self,
         *,
@@ -1693,6 +1727,39 @@ class GraphRunner:
         from pathway_tpu.internals.config import get_pathway_config
 
         env_cfg = get_pathway_config()
+        # persistence may also arrive via the record/replay env contract
+        # (PATHWAY_REPLAY_STORAGE, applied below) — the persistence-gated lint
+        # passes (PWA002 severity, PWA005) must see it either way
+        lint_persistence = persistence_config is not None or bool(
+            env_cfg.replay_storage
+        )
+        lint_exempt = getattr(self, "lint_exempt", False)
+        if not lint_exempt and os.environ.get("PATHWAY_LINT_CAPTURE", "") not in (
+            "",
+            "0",
+        ):
+            # `cli analyze` build-only mode: the graph is complete, hand it to
+            # the analyzer without executing a single commit (debug capture
+            # helpers are exempt so the analyzed program runs past them to its
+            # real ``pw.run``)
+            from pathway_tpu.analysis import GraphCaptureInterrupt
+
+            raise GraphCaptureInterrupt(self.graph, persistence=lint_persistence)
+        if not lint_exempt and not self._ready and not self._materialize_all:
+            from pathway_tpu.parallel.cluster import (
+                in_thread_worker,
+                thread_worker_rank,
+                thread_worker_shared_inputs,
+            )
+
+            if not in_thread_worker():
+                self._lint_gate(persistence=lint_persistence)
+            elif not thread_worker_shared_inputs() and thread_worker_rank() == 0:
+                # run_shared_graph workers re-run the one graph the parent
+                # already linted — skip. run_threads workers each build and run
+                # their OWN graph with no parent run: rank 0's graph is
+                # representative, lint it once instead of N times
+                self._lint_gate(persistence=lint_persistence)
         if env_cfg.threads > 1 and not self._ready:
             from pathway_tpu.parallel.cluster import in_thread_worker
 

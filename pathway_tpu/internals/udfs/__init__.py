@@ -347,6 +347,10 @@ class UDF:
             e = expr.ApplyExpression(
                 fun, ret, self.propagate_none, self.deterministic, args, kwargs, self.max_batch_size
             )
+        # the executor wrappers above hide the user function from bytecode
+        # inspection; keep the raw callable reachable for the PWA001 graph-lint
+        # determinism pass (pathway_tpu/analysis)
+        e._source_fun = self.func
         return e
 
 

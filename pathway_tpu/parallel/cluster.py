@@ -1183,6 +1183,20 @@ def in_thread_worker() -> bool:
     return getattr(_thread_ctx, "hub", None) is not None
 
 
+def thread_worker_rank() -> int:
+    """This thread's worker rank (0 when not a worker thread)."""
+    return int(getattr(_thread_ctx, "me", 0) or 0)
+
+
+def thread_worker_shared_inputs() -> bool:
+    """True on a ``run_shared_graph`` worker (the ``pw.run`` PATHWAY_THREADS
+    fan-out over ONE already-built graph, which the parent runner already
+    linted); False on a ``run_threads`` worker, where each rank builds and
+    runs its own graph with no parent run."""
+    hub = getattr(_thread_ctx, "hub", None)
+    return bool(getattr(hub, "shared_inputs", False))
+
+
 def set_thread_exchange(hub: "ThreadExchangeHub | None", me: int = 0) -> None:
     """Bind this thread to a worker-thread exchange (``run_threads`` launcher);
     None unbinds."""
