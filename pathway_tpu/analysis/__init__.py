@@ -32,6 +32,13 @@ from pathway_tpu.analysis.framework import (
     PassManager,
     Severity,
 )
+from pathway_tpu.analysis.fusion import (
+    ChainSpec,
+    FusedRegion,
+    FusionPlan,
+    FusionPlanner,
+    plan_fusion,
+)
 from pathway_tpu.analysis.passes import (
     CheckpointCompatibilityPass,
     DeterminismPass,
@@ -53,6 +60,11 @@ __all__ = [
     "analyze_graph",
     "capture_program_graph",
     "default_passes",
+    "ChainSpec",
+    "FusedRegion",
+    "FusionPlan",
+    "FusionPlanner",
+    "plan_fusion",
     "CheckpointCompatibilityPass",
     "DeterminismPass",
     "DevicePlacementPass",
@@ -68,9 +80,12 @@ def analyze_graph(
     *,
     persistence: bool = False,
     passes: "Optional[List[AnalysisPass]]" = None,
+    ctx: "Optional[AnalysisContext]" = None,
 ) -> AnalysisReport:
-    """Run the lint pipeline over ``graph`` (default: the global parse graph)."""
-    return PassManager(passes).run(graph, persistence=persistence)
+    """Run the lint pipeline over ``graph`` (default: the global parse graph).
+    ``ctx`` lets callers that already hold an :class:`AnalysisContext` (the
+    GraphRunner shares one with the fusion planner) skip a second DAG walk."""
+    return PassManager(passes).run(graph, persistence=persistence, ctx=ctx)
 
 
 def capture_program_graph(

@@ -350,10 +350,20 @@ class PassManager:
             passes = default_passes()
         self.passes = passes
 
-    def run(self, graph: Any = None, *, persistence: bool = False) -> AnalysisReport:
+    def run(
+        self,
+        graph: Any = None,
+        *,
+        persistence: bool = False,
+        ctx: "AnalysisContext | None" = None,
+    ) -> AnalysisReport:
         if graph is None:
             graph = pg.G._current
-        ctx = AnalysisContext(graph, persistence=persistence)
+        if ctx is None:
+            # callers holding a context already (GraphRunner shares one between
+            # the lint gate and the fusion planner) pass it in — the DAG walk
+            # and consumer maps are built once per runner, not per consumer
+            ctx = AnalysisContext(graph, persistence=persistence)
         diagnostics: List[Diagnostic] = []
         timings: Dict[str, float] = {}
         for p in self.passes:

@@ -1,0 +1,284 @@
+"""Fusion planning pass: partition the operator DAG into fusable regions.
+
+The whole-commit fusion compiler (``pathway_tpu/engine/fusion.py``) executes
+maximal chains of pure columnar operators as single compiled programs instead
+of one evaluator dispatch per node. This module is the *planning* half: it
+walks the same :class:`~pathway_tpu.analysis.framework.AnalysisContext` the
+graph-lint passes use (consumer maps, expression walkers, dtype propagation —
+built ONCE per runner and shared with the lint gate) and decides, statically:
+
+- which nodes are **chain-eligible** — single-input ``rowwise``/``filter``
+  nodes whose expressions reference only their own input table and contain no
+  host UDF (``apply``/``udf`` call sites — the exact thing PWA004 flags as a
+  fused-kernel splitter);
+- which nodes are **region members** — stateful columnar operators
+  (``join``/``groupby``/``concat``) whose arrangements are carried across
+  commits by their evaluators and which a region may span;
+- where a region must **break** — host UDFs, cross-table references, sources,
+  sinks, nested graphs, and drain-sensitive evaluators (``REWIND_SAFE=False``:
+  their flush rides a live-only signal no compiled replay can reproduce).
+
+The plan itself is pure data (:class:`FusionPlan`): the engine-side compiler
+turns each chain into an executable :class:`~pathway_tpu.engine.fusion.ChainProgram`,
+and the flight recorder logs ``plan.to_event()`` so a post-mortem names what
+was fused at crash time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Set, Tuple
+
+from pathway_tpu.analysis.framework import AnalysisContext
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals import parse_graph as pg
+
+# Expression node types a chain program can evaluate with the stock
+# interpreter over its column environment (everything the per-node path
+# supports EXCEPT reducer leaves, which never appear in rowwise/filter
+# configs). Host-UDF call sites (ApplyExpression and subclasses) are region
+# boundaries, not chain citizens.
+_CHAIN_SAFE_EXPRS: Tuple[type, ...] = (
+    expr.ColumnConstExpression,
+    expr.ColumnReference,
+    expr.ColumnBinaryOpExpression,
+    expr.ColumnUnaryOpExpression,
+    expr.IfElseExpression,
+    expr.IsNoneExpression,
+    expr.IsNotNoneExpression,
+    expr.CoalesceExpression,
+    expr.RequireExpression,
+    expr.CastExpression,
+    expr.ConvertExpression,
+    expr.DeclareTypeExpression,
+    expr.UnwrapExpression,
+    expr.FillErrorExpression,
+    expr.PointerExpression,
+    expr.MakeTupleExpression,
+    expr.GetExpression,
+    expr.MethodCallExpression,
+)
+
+# Subset of _CHAIN_SAFE_EXPRS with no raise path: a dead (unconsumed) output
+# column built purely from these may be skipped entirely — evaluating it could
+# only produce values nobody reads (division poisons cells, it never raises).
+PURE_EXPRS: Tuple[type, ...] = (
+    expr.ColumnConstExpression,
+    expr.ColumnReference,
+    expr.ColumnBinaryOpExpression,
+    expr.ColumnUnaryOpExpression,
+    expr.IfElseExpression,
+    expr.IsNoneExpression,
+    expr.IsNotNoneExpression,
+)
+
+
+def expr_chain_safe(e: expr.ColumnExpression) -> bool:
+    """True when the whole tree is built from chain-safe expression types
+    (in particular: no ``apply``/``udf`` host call site anywhere)."""
+    for sub in AnalysisContext.expr_tree(e):
+        if isinstance(sub, expr.ApplyExpression):
+            return False  # host UDF (incl. batch/async flavors): region boundary
+        if not isinstance(sub, _CHAIN_SAFE_EXPRS):
+            return False
+    return True
+
+
+def expr_pure(e: expr.ColumnExpression) -> bool:
+    """True when evaluating the tree can neither raise nor touch host state —
+    the condition for dead-column elimination to be unobservable."""
+    return all(isinstance(sub, PURE_EXPRS) for sub in AnalysisContext.expr_tree(e))
+
+
+@dataclass
+class ChainSpec:
+    """One maximal run of CONSECUTIVE chain-eligible nodes, each consuming the
+    previous node's output (the head consumes ``input_id``). Consecutiveness in
+    graph order is required so fused execution preserves the exact substep
+    ordering every other operator observes."""
+
+    node_ids: List[int]
+    input_id: int
+
+    def __len__(self) -> int:
+        return len(self.node_ids)
+
+
+@dataclass
+class FusedRegion:
+    """A connected subgraph of fusable operators (chains + stateful members),
+    reported for observability: the flight recorder logs regions so a crash
+    dump names what was fused."""
+
+    member_ids: List[int]
+    kinds: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class FusionPlan:
+    chains: List[ChainSpec]
+    regions: List[FusedRegion]
+    # node id -> why it was refused (observability; also unit-tested)
+    boundaries: Dict[int, str] = field(default_factory=dict)
+    plan_seconds: float = 0.0
+
+    @property
+    def ops_fused(self) -> int:
+        return sum(len(c) for c in self.chains)
+
+    def to_event(self) -> Dict[str, Any]:
+        """Compact payload for the ``fusion`` flight-recorder event: enough to
+        reconstruct the region plan from a crash dump."""
+        return {
+            "chains": [
+                {"input": c.input_id, "nodes": list(c.node_ids)} for c in self.chains
+            ],
+            "regions": [
+                {"members": r.member_ids, "kinds": r.kinds} for r in self.regions
+            ],
+            "ops_fused": self.ops_fused,
+            "plan_seconds": round(self.plan_seconds, 6),
+        }
+
+
+# operator kinds whose evaluators may participate in a fused region as
+# stateful members: their arrangements (join sides, group slots, concat
+# multiplicities) are carried across commits by the evaluator itself, so a
+# region can span them without re-materializing state per substep
+_MEMBER_KINDS = frozenset({"join", "groupby", "concat"})
+_CHAIN_KINDS = frozenset({"rowwise", "filter"})
+
+
+class FusionPlanner:
+    """Static fusion planning over a shared :class:`AnalysisContext`."""
+
+    def __init__(self, ctx: AnalysisContext):
+        self.ctx = ctx
+
+    # -- per-node classification ---------------------------------------------
+
+    def chain_eligible(self, node: pg.Node) -> "str | None":
+        """None when ``node`` may join a chain; otherwise the boundary reason."""
+        ctx = self.ctx
+        if node.kind not in _CHAIN_KINDS:
+            return "kind"
+        if len(node.inputs) != 1:
+            return "multi_input"
+        cls = ctx.evaluator_class(node)
+        if cls is None or not getattr(cls, "REWIND_SAFE", True):
+            # drain-sensitive evaluators flush on a live-only signal; a fused
+            # program cannot reproduce it (none of these kinds are chain kinds
+            # today — belt and braces against future evaluator changes)
+            return "drain_sensitive"
+        own = node.inputs[0]
+        for root in ctx.expressions(node):
+            if not expr_chain_safe(root):
+                # the same condition PWA004 warns about: a host UDF embedded in
+                # the columnar chain splits the fused program
+                return "host_udf"
+            for ref in root._column_refs:
+                if ref.table is not own:
+                    # cross-table references are LIVE dependencies resolved
+                    # against materialized state mid-substep — a chain must not
+                    # absorb them (RowwiseEvaluator._cross_refresh semantics)
+                    return "cross_table_ref"
+        return None
+
+    def fusable_member(self, node: pg.Node) -> bool:
+        """Stateful operators a region may span (executed by their own
+        incremental evaluators, state carried across commits)."""
+        if node.kind not in _MEMBER_KINDS:
+            return False
+        cls = self.ctx.evaluator_class(node)
+        return cls is not None and getattr(cls, "REWIND_SAFE", True)
+
+    # -- planning -------------------------------------------------------------
+
+    def plan(self) -> FusionPlan:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        ctx = self.ctx
+        nodes = ctx.nodes
+        boundaries: Dict[int, str] = {}
+        eligible: Dict[int, bool] = {}
+        for node in nodes:
+            why = self.chain_eligible(node)
+            if why is None:
+                eligible[node.id] = True
+            else:
+                eligible[node.id] = False
+                if node.kind in _CHAIN_KINDS:
+                    boundaries[node.id] = why
+
+        # chains: maximal runs of eligible nodes that are CONSECUTIVE in graph
+        # order and linearly linked (each consumes the previous one's output)
+        chains: List[ChainSpec] = []
+        current: List[pg.Node] = []
+
+        def flush() -> None:
+            if len(current) >= 2:
+                chains.append(
+                    ChainSpec(
+                        node_ids=[n.id for n in current],
+                        input_id=current[0].inputs[0]._node.id,
+                    )
+                )
+            current.clear()
+
+        for node in nodes:
+            if eligible.get(node.id) and current and node.inputs[0]._node is current[-1]:
+                current.append(node)
+            else:
+                flush()
+                if eligible.get(node.id):
+                    current.append(node)
+        flush()
+
+        # regions (reporting): connected components over fusable nodes — chain
+        # members plus stateful member kinds — linked by direct edges
+        in_chain: Set[int] = {nid for c in chains for nid in c.node_ids}
+        fusable: Set[int] = set(in_chain)
+        node_by_id = {n.id: n for n in nodes}
+        for node in nodes:
+            if self.fusable_member(node):
+                fusable.add(node.id)
+        parent: Dict[int, int] = {nid: nid for nid in fusable}
+
+        def find(x: int) -> int:
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(a: int, b: int) -> None:
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[ra] = rb
+
+        for nid in fusable:
+            for inp in node_by_id[nid].inputs:
+                if inp._node.id in fusable:
+                    union(nid, inp._node.id)
+        groups: Dict[int, List[int]] = {}
+        for nid in sorted(fusable):
+            groups.setdefault(find(nid), []).append(nid)
+        regions = []
+        for members in groups.values():
+            if len(members) < 2:
+                continue
+            kinds: Dict[str, int] = {}
+            for nid in members:
+                k = node_by_id[nid].kind
+                kinds[k] = kinds.get(k, 0) + 1
+            regions.append(FusedRegion(member_ids=members, kinds=kinds))
+
+        plan = FusionPlan(chains=chains, regions=regions, boundaries=boundaries)
+        plan.plan_seconds = _time.perf_counter() - t0
+        return plan
+
+
+def plan_fusion(ctx: AnalysisContext) -> FusionPlan:
+    """Plan whole-commit fusion over an existing analysis context (the one the
+    lint gate already built — one DAG walk per runner, not two)."""
+    return FusionPlanner(ctx).plan()

@@ -467,7 +467,14 @@ class DevicePlacementPass(AnalysisPass):
     """PWA004: (a) a host Python UDF embedded inside a numeric expression tree
     splits what would lower to ONE jitted XLA kernel into device→host→device
     round-trips every commit; (b) KNN/embed stores configured with differing
-    ``device=`` kwargs ping-pong batches between devices at every handoff."""
+    ``device=`` kwargs ping-pong batches between devices at every handoff.
+
+    Since the whole-commit fusion compiler landed
+    (``pathway_tpu/analysis/fusion.py`` + ``engine/fusion.py``), this is no
+    longer a hypothetical: the SAME analysis decides fusion-region boundaries,
+    so every PWA004 warning is a real lost-performance report — the flagged
+    UDF is precisely what breaks an operator chain out of its fused XLA
+    program and back onto per-node host dispatch."""
 
     code = "PWA004"
     title = "host/device placement hazard"

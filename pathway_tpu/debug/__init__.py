@@ -309,6 +309,14 @@ class _TimedSource(StaticDataSource):
         if self._pos >= len(self._schedule):
             self._done = True
         idx = self._time_rows[t]
+        if len(idx) > 1 and idx[0] + len(idx) - 1 == idx[-1] and (np.diff(idx) == 1).all():
+            # time-contiguous rows (the common layout: streams are built in
+            # commit order): basic slicing returns zero-copy VIEWS instead of
+            # one fancy-gather copy per column — deltas are immutable once
+            # emitted, so sharing the backing arrays is safe
+            sl = slice(int(idx[0]), int(idx[-1]) + 1)
+            columns = {name: self._col_arrays[name][sl] for name in column_names}
+            return Delta(self._all_keys[sl], self._diffs[sl], columns)
         columns = {name: self._col_arrays[name][idx] for name in column_names}
         return Delta(self._all_keys[idx], self._diffs[idx], columns)
 

@@ -65,6 +65,26 @@ def _to_host(v: Any) -> Any:
     return v
 
 
+def filter_mask_to_bool(mask: np.ndarray) -> np.ndarray:
+    """Filter predicate column → boolean row mask: poisoned (Error) cells drop
+    the row. ONE home for the rule — FilterEvaluator and the fusion compiler's
+    composed filters (``engine/fusion.py``) must stay in bitwise lockstep."""
+    if mask.dtype == object:
+        mask = np.frompyfunc(
+            lambda v: bool(v) if not isinstance(v, Error) else False, 1, 1
+        )(mask).astype(bool)
+    return mask.astype(bool)
+
+
+def id_pointer_column(keys: np.ndarray) -> np.ndarray:
+    """The materialized ``id`` pseudo-column: row-key Pointers boxed in an
+    object array — shared by ``Evaluator._resolver_for`` and the fusion
+    chain resolver so both paths box identically."""
+    out = np.empty(len(keys), dtype=object)
+    out[:] = keys_to_pointers(keys)
+    return out
+
+
 class Evaluator:
     def __init__(self, node: pg.Node, runner: Any):
         self.node = node
@@ -180,16 +200,12 @@ class Evaluator:
         def resolver(ref: expr.ColumnReference) -> np.ndarray:
             if ref.table is table:
                 if ref.name == "id":
-                    out = np.empty(len(delta), dtype=object)
-                    out[:] = keys_to_pointers(delta.keys)
-                    return out
+                    return id_pointer_column(delta.keys)
                 return delta.columns[ref.name]
             # cross-table reference: same-universe lookup by key in materialized state
             state = self.runner.state_of(ref.table._node)
             if ref.name == "id":
-                out = np.empty(len(delta), dtype=object)
-                out[:] = keys_to_pointers(delta.keys)
-                return out
+                return id_pointer_column(delta.keys)
             slots = state.lookup(delta.keys)
             hit = slots >= 0
             if hit.all() and len(state):
@@ -379,11 +395,7 @@ class FilterEvaluator(Evaluator):
         table = self.node.inputs[0]
         resolver = self._resolver_for(table, delta)
         mask = ee.evaluate(self.node.config["expression"], len(delta), resolver)
-        if mask.dtype == object:
-            mask = np.frompyfunc(lambda v: bool(v) if not isinstance(v, Error) else False, 1, 1)(
-                mask
-            ).astype(bool)
-        return delta.select(mask.astype(bool))
+        return delta.select(filter_mask_to_bool(mask))
 
 
 class ReindexEvaluator(Evaluator):
@@ -918,6 +930,15 @@ class _JoinSide:
         from pathway_tpu.engine.index import _NativeKeyIndex, _NativeMultiMap
 
         n = len(row_keys)
+        if self._capacity == 0:
+            # first allocation: value-column dtypes come from the first batch
+            # through (StateTable does the same) — downstream gathers then stay
+            # typed int64/float64 instead of object, which keeps the groupby
+            # reducers fed by this join on their vectorized segment kernels
+            # (an object `net` column was a per-row Python sum, ~40x slower);
+            # set_cells/adopt_dtype still demote to object on any conflict
+            for c in self.names:
+                self.cols[c] = np.empty(0, dtype=np.asarray(values[c]).dtype)
         if isinstance(self.row_index, _NativeKeyIndex) and isinstance(
             self.jkmap, _NativeMultiMap
         ):
@@ -1091,9 +1112,11 @@ class JoinEvaluator(Evaluator):
         # matched events: row i of the delta x each matching other-side slot.
         # Unique-key build sides (the common case) probe to exactly one match
         # per row — the repeats collapse to identity/copy, skip them.
+        own_identity = False
         if len(match_slots) == n and counts[-1] == 1 and (counts == 1).all():
             ev_row = np.arange(n, dtype=np.int64)
             ev_d = diffs
+            own_identity = True
         else:
             ev_row = np.repeat(np.arange(n, dtype=np.int64), counts)
             ev_d = np.repeat(diffs, counts)
@@ -1163,6 +1186,9 @@ class JoinEvaluator(Evaluator):
             ev_d, ev_row, ev_other,
             null_d, null_rows,
             flip_d, flip_slots,
+            own_identity=own_identity
+            and len(null_rows) == 0
+            and len(flip_slots) == 0,
         )
 
     def _emit_side(
@@ -1177,9 +1203,13 @@ class JoinEvaluator(Evaluator):
         null_rows: np.ndarray,
         flip_d: np.ndarray,
         flip_slots: np.ndarray,
+        own_identity: bool = False,
     ) -> Delta:
         """Assemble one side-pass's output: matched events, own-null rows, and
-        other-side null-row flips, in that order."""
+        other-side null-row flips, in that order. ``own_identity`` marks the
+        unique-match inner pass where ``ev_row`` is the identity permutation:
+        own-side gathers collapse to the delta's own arrays (no copy — delta
+        columns are immutable once emitted, like every evaluator treats them)."""
         is_left = side_name == "left"
         left_table, right_table = self.node.inputs
         n_ev = len(ev_d) + len(null_d) + len(flip_d)
@@ -1210,7 +1240,9 @@ class JoinEvaluator(Evaluator):
             key = "own:" + name
             if key not in cache:
                 src = delta.columns[name]
-                if own_mask.all():
+                if own_identity:
+                    out = src  # identity permutation: the delta's array as-is
+                elif own_mask.all():
                     out = src[own_rows]
                 else:
                     out = np.empty(n_ev, dtype=object)
@@ -1269,8 +1301,11 @@ class JoinEvaluator(Evaluator):
 
         # output keys: hash (left_key, right_key, "join"); id_expr overrides where
         # the left side is present
-        own_keys = np.zeros(n_ev, dtype=KEY_DTYPE)
-        own_keys[own_mask] = delta.keys[own_rows[own_mask]]
+        if own_identity:
+            own_keys = delta.keys
+        else:
+            own_keys = np.zeros(n_ev, dtype=KEY_DTYPE)
+            own_keys[own_mask] = delta.keys[own_rows[own_mask]]
         oth_keys = np.zeros(n_ev, dtype=KEY_DTYPE)
         oth_keys[other_mask] = other.keys[other_slots[other_mask]]
         lkeys, lmask = (own_keys, own_mask) if is_left else (oth_keys, other_mask)

@@ -1,11 +1,14 @@
 """Row-wise expression compilation & evaluation.
 
 Parity with the reference's typed expression interpreter (``src/engine/expression.rs``) and the
-Python-side translation layer (``internals/graph_runner/expression_evaluator.py``). Design is
-TPU-first: an expression over device-friendly dtypes (bool/int/float) lowers to ONE jit'd JAX
-function evaluated on the whole column batch (XLA fuses the elementwise tree into a single
-kernel); everything else runs vectorized numpy on host. ``apply`` UDFs are batched at the
-column level rather than row-at-a-time.
+Python-side translation layer (``internals/graph_runner/expression_evaluator.py``). This module
+is the host INTERPRETER: vectorized numpy over whole column batches, ``apply`` UDFs batched at
+the column level rather than row-at-a-time. The device path lives in
+``pathway_tpu/engine/fusion.py``: the fusion compiler composes whole select/filter CHAINS of
+these expression trees and lowers device-friendly runs to single jitted XLA programs, using
+this interpreter both as the fallback and as the bitwise ground truth its parity probe checks
+lowered programs against — any semantic change here must keep the two in lockstep (the probe
+will catch a divergence by falling back, never by corrupting output).
 """
 
 from __future__ import annotations
@@ -21,10 +24,6 @@ from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import expression as expr
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.keys import Pointer, pointer_from
-
-# minimum batch size before dispatching the numeric tree to the TPU; below this the host
-# round-trip dominates (tiny unit-test tables stay on numpy)
-_DEVICE_THRESHOLD = 4096
 
 
 class EvalContext:

@@ -159,7 +159,12 @@ class CommitProfile:
 
     ``ops`` entries are ``(node_id, name, kind, seconds, rows, retractions,
     neu)`` tuples — one per evaluator run in ``GraphRunner._substep`` (the neu
-    forgetting phase contributes separate entries with ``neu=True``)."""
+    forgetting phase contributes separate entries with ``neu=True``). Fused
+    chains (``engine/fusion.py``) contribute one REGION row per chain
+    (``kind="fused_chain"``, real wall seconds) followed by per-member rows
+    whose seconds are row-proportional estimates partitioning the region's
+    time — so per-operator totals and the ``/metrics`` operator families stay
+    live when a chain executes as a single program."""
 
     __slots__ = (
         "commit", "rank", "duration_s", "input_rows", "output_rows", "neu",

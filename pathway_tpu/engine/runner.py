@@ -66,6 +66,14 @@ class GraphRunner:
         self._profiler: Any = None
         self._recorder: Any = None
         self._profile_ops: "List[tuple] | None" = None
+        # whole-commit fusion (engine/fusion.py): the substep schedule with
+        # operator chains collapsed into compiled ChainPrograms; None = stock
+        # per-node dispatch (PATHWAY_FUSION=off, nested runners, nothing fuses)
+        self._fusion_schedule: "List[Any] | None" = None
+        self._fusion_plan: Any = None
+        # one AnalysisContext per runner, shared by the lint gate and the
+        # fusion planner (building it twice = two full DAG walks per pw.run)
+        self._analysis_ctx: Any = None
         # coordinated cluster checkpoints (persistence/engine.py manifest
         # protocol) + incremental rewind (undo record + mesh serve log)
         self._ckpt_interval_s = 0.0  # 0 = coordinated checkpoints off
@@ -489,6 +497,7 @@ class GraphRunner:
             if restore_frames:
                 self._restore_sources(restore_frames)
         self._materialized = self._compute_materialized()
+        self._build_fusion()
         for node, evaluator in self._sources:
             node.config["source"].on_start()
         self._monitor = _make_monitor(monitoring_level, self._nodes)
@@ -518,6 +527,57 @@ class GraphRunner:
                 # future frame ids must exceed every journaled id (checkpoint subsumption
                 # filters by id)
                 self._commit = max(self._commit, replay_frames[-1][0] + 1)
+
+    def _analysis_context(self, *, persistence: "bool | None" = None) -> Any:
+        """The ONE AnalysisContext of this runner (DAG walk + consumer maps +
+        dtype propagation), built lazily and shared by the lint gate and the
+        fusion planner — a regression test asserts a single construction per
+        ``pw.run``."""
+        if self._analysis_ctx is None:
+            from pathway_tpu.analysis import AnalysisContext
+
+            if persistence is None:
+                persistence = self._persistence is not None
+            self._analysis_ctx = AnalysisContext(self.graph, persistence=persistence)
+        return self._analysis_ctx
+
+    def _fusion_mode(self) -> str:
+        mode = os.environ.get("PATHWAY_FUSION", "on").strip().lower()
+        if mode in ("off", "0", "false", "no", "none"):
+            return "off"
+        if mode not in ("on", "1", "true", "yes", ""):
+            import logging
+
+            # a typo (PATHWAY_FUSION=fast) must not silently flip the default
+            logging.getLogger("pathway_tpu").warning(
+                "unrecognized PATHWAY_FUSION=%r (expected off|on); keeping the "
+                "default 'on'",
+                mode,
+            )
+        return "on"
+
+    def _build_fusion(self) -> None:
+        """Plan whole-commit fusion and compile the substep schedule
+        (``PATHWAY_FUSION=off`` or a plan with no chains leaves the stock
+        per-node dispatch untouched). Runs inside ``setup`` after evaluators
+        and the materialization set exist — journal replay already executes
+        fused."""
+        self._fusion_schedule = None
+        self._fusion_plan = None
+        if self._materialize_all or self._fusion_mode() == "off":
+            # nested iterate runners share the outer commit's substep; fusing
+            # them would double-attribute and complicate the inner fixpoint
+            return
+        from pathway_tpu.analysis.fusion import plan_fusion
+        from pathway_tpu.engine.fusion import build_schedule
+
+        plan = plan_fusion(self._analysis_context())
+        self._fusion_plan = plan
+        self._fusion_schedule = build_schedule(self, plan)
+        if self._fusion_schedule is not None and self._recorder is not None:
+            # the region plan rides the flight recorder so a post-mortem dump
+            # names what was fused at crash time
+            self._recorder.record_event("fusion", **plan.to_event())
 
     def _bind_cluster_policies(self) -> bool:
         """Stamp every evaluator with its per-input cluster routing policies and
@@ -1036,149 +1096,178 @@ class GraphRunner:
 
         profile_ops = self._profile_ops
         runtime = ee_mod.get_runtime()
-        for node in self._nodes:
-            evaluator = self.evaluators[node.id]
-            runtime["node"] = node
-            # commit identity for UDFs that read live process-global state
-            # (the /v1/statistics engine snapshot): re-derivations WITHIN one
-            # commit must see the same value (a value that moved between two
-            # evaluations churns nondeterministic update pairs), while the
-            # next commit reads fresh — retraction rows of later commits are
-            # covered by the evaluator's memoize-on-retraction, not by this.
-            # Set per node because nested iterate runners share this
-            # thread-local and overwrite it mid-substep.
-            runtime["commit_token"] = (id(self), self._commit)
-            _t_op = time_mod.perf_counter() if profile_ops is not None else 0.0
-            if (
-                isinstance(node, pg.OutputNode)
-                and not neu
-                and (self._inject is None or self.replay_outputs)
-            ):
-                # count only rows actually delivered to sinks (not forgetting-phase
-                # retractions, not silently-replayed history)
-                self._output_rows_this_commit += sum(
-                    len(deltas.get(inp._node.id, ())) for inp in node.inputs
-                )
-            if isinstance(node, pg.InputNode):
-                if neu or self._shared_nonroot:
-                    delta = Delta.empty(self.output_columns_of(node))
-                elif self._inject is not None:
-                    # journal replay: feed the persisted delta instead of the source
-                    delta = self._inject.get(
-                        node.id, Delta.empty(self.output_columns_of(node))
-                    )
+        schedule = self._fusion_schedule
+        if schedule is None:
+            # stock per-node dispatch (PATHWAY_FUSION=off reproduces this path
+            # exactly: the schedule is never built)
+            for node in self._nodes:
+                if self._run_node(node, deltas, neu, profile_ops, runtime):
+                    any_output = True
+        else:
+            for item in schedule:
+                if isinstance(item, pg.Node):
+                    ran = self._run_node(item, deltas, neu, profile_ops, runtime)
                 else:
-                    delta = evaluator.process([])
-                    carry = self._rejoin_carry.pop(node.id, None)
-                    if carry is not None and len(carry):
-                        # input rows drained by the commit a fence interrupted,
-                        # never journaled: re-ingest them exactly once with the
-                        # first post-rejoin batch (they journal normally now)
-                        delta = (
-                            Delta.concat(
-                                [carry, delta], self.output_columns_of(node)
-                            )
-                            if len(delta)
-                            else carry
-                        )
-                if not neu:
-                    self._input_deltas[node.id] = delta
-                if self._cluster is not None and getattr(
-                    self._cluster, "shared_inputs", False
-                ):
-                    # transparent-threads mode: scatter the freshly ingested rows
-                    # by row key so rowwise/filter/join work downstream runs on
-                    # ALL ranks, not just the ingesting rank 0 (stateful ops
-                    # re-exchange by their own keys as usual). Lockstep: every
-                    # rank reaches this exchange each commit (rank > 0 with an
-                    # empty delta).
-                    tag = f"{self.current_time}:{node.id}:scatter".encode()
-                    delta = self._cluster.exchange_delta(tag, delta, delta.keys)
-            else:
-                inputs = [
-                    deltas.get(inp._node.id, Delta.empty(inp.column_names()))
-                    for inp in node.inputs
-                ]
-                originates = neu and getattr(evaluator, "neu_pending", _no_pending)()
-                cross_nodes = getattr(evaluator, "_cross_nodes", None)
-                if (
-                    all(len(d) == 0 for d in inputs)
-                    and not originates
-                    and not (not neu and _has_pending(evaluator))
-                    and node.kind != "iterate_result"
-                    # a rowwise node's cross-table references are live deps:
-                    # run when any referenced table emitted this substep
-                    and not (
-                        cross_nodes
-                        and any(len(deltas.get(n.id, ())) for n in cross_nodes)
-                    )
-                    # lockstep: exchange-point operators participate in every
-                    # commit's all-to-all even with no local rows (peers block on
-                    # our partitions)
-                    and not (self._cluster is not None and evaluator._cluster_barrier)
-                ):
-                    delta = Delta.empty(self.output_columns_of(node))
-                else:
-                    if (
-                        self._undo_current is not None
-                        and node.id not in self._undo_current["evals"]
-                    ):
-                        # pre-mutation snapshot, taken the FIRST time this
-                        # operator runs in the commit (the neu phase re-runs
-                        # nodes; the undo target is the pre-commit state)
-                        self._capture_undo_state(node, evaluator)
-                    if self._cluster is not None and any(
-                        p is not None for p in evaluator._cluster_policies
-                    ):
-                        inputs = self._route_cluster_inputs(node, evaluator, inputs)
-                    if originates:
-                        delta = evaluator.drain_neu(inputs)
-                    else:
-                        try:
-                            delta = evaluator.process(inputs)
-                        except Exception as exc:
-                            from pathway_tpu.internals.trace import add_error_context
-                            from pathway_tpu.parallel.cluster import (
-                                PeerShutdownError,
-                                PeerTimeoutError,
-                            )
+                    # a compiled ChainProgram covering several operators
+                    ran = item.execute(self, deltas, neu, profile_ops, runtime)
+                if ran:
+                    any_output = True
+        return any_output
 
-                            if isinstance(exc, (PeerShutdownError, PeerTimeoutError)):
-                                # a peer death inside this node's exchange is an
-                                # infrastructure failure, not an operator bug:
-                                # keep it TYPED so the surgical-rejoin fence (and
-                                # isinstance-based failure triage) can catch it
-                                raise
-                            raise add_error_context(exc, node) from exc
-                if neu and len(delta):
-                    delta.neu = True
-            deltas[node.id] = delta
-            if len(delta):
-                any_output = True
-                self._step_counts[node.id] = self._step_counts.get(node.id, 0) + len(delta)
-                if node.output is not None and node.id in self._materialized:
-                    if self._undo_current is not None:
-                        # applied-delta record: Delta.negated() of each entry
-                        # (in reverse) is the exact state-table undo
-                        self._undo_current["applied"].append((node.id, delta))
-                    self.states[node.id].apply(delta)
-            if profile_ops is not None:
-                rows = len(delta)
-                # count_nonzero: ONE pass over diffs (a min() pre-check reads
-                # the array twice on the update-heavy deltas that dominate
-                # steady state, doubling the per-op profiling cost)
-                retractions = (
-                    int(np.count_nonzero(delta.diffs < 0)) if rows else 0
+    def _run_node(
+        self,
+        node: pg.Node,
+        deltas: Dict[int, Delta],
+        neu: bool,
+        profile_ops: "List[tuple] | None",
+        runtime: Dict[str, Any],
+    ) -> bool:
+        """One operator's substep turn (the pre-fusion per-node dispatch body,
+        shared verbatim by the unfused loop and fused-region member nodes).
+        Returns whether the node emitted rows."""
+        any_output = False
+        evaluator = self.evaluators[node.id]
+        runtime["node"] = node
+        # commit identity for UDFs that read live process-global state
+        # (the /v1/statistics engine snapshot): re-derivations WITHIN one
+        # commit must see the same value (a value that moved between two
+        # evaluations churns nondeterministic update pairs), while the
+        # next commit reads fresh — retraction rows of later commits are
+        # covered by the evaluator's memoize-on-retraction, not by this.
+        # Set per node because nested iterate runners share this
+        # thread-local and overwrite it mid-substep.
+        runtime["commit_token"] = (id(self), self._commit)
+        _t_op = time_mod.perf_counter() if profile_ops is not None else 0.0
+        if (
+            isinstance(node, pg.OutputNode)
+            and not neu
+            and (self._inject is None or self.replay_outputs)
+        ):
+            # count only rows actually delivered to sinks (not forgetting-phase
+            # retractions, not silently-replayed history)
+            self._output_rows_this_commit += sum(
+                len(deltas.get(inp._node.id, ())) for inp in node.inputs
+            )
+        if isinstance(node, pg.InputNode):
+            if neu or self._shared_nonroot:
+                delta = Delta.empty(self.output_columns_of(node))
+            elif self._inject is not None:
+                # journal replay: feed the persisted delta instead of the source
+                delta = self._inject.get(
+                    node.id, Delta.empty(self.output_columns_of(node))
                 )
-                profile_ops.append((
-                    node.id,
-                    node.name,
-                    node.kind,
-                    time_mod.perf_counter() - _t_op,
-                    rows,
-                    retractions,
-                    neu,
-                ))
+            else:
+                delta = evaluator.process([])
+                carry = self._rejoin_carry.pop(node.id, None)
+                if carry is not None and len(carry):
+                    # input rows drained by the commit a fence interrupted,
+                    # never journaled: re-ingest them exactly once with the
+                    # first post-rejoin batch (they journal normally now)
+                    delta = (
+                        Delta.concat(
+                            [carry, delta], self.output_columns_of(node)
+                        )
+                        if len(delta)
+                        else carry
+                    )
+            if not neu:
+                self._input_deltas[node.id] = delta
+            if self._cluster is not None and getattr(
+                self._cluster, "shared_inputs", False
+            ):
+                # transparent-threads mode: scatter the freshly ingested rows
+                # by row key so rowwise/filter/join work downstream runs on
+                # ALL ranks, not just the ingesting rank 0 (stateful ops
+                # re-exchange by their own keys as usual). Lockstep: every
+                # rank reaches this exchange each commit (rank > 0 with an
+                # empty delta).
+                tag = f"{self.current_time}:{node.id}:scatter".encode()
+                delta = self._cluster.exchange_delta(tag, delta, delta.keys)
+        else:
+            inputs = [
+                deltas.get(inp._node.id, Delta.empty(inp.column_names()))
+                for inp in node.inputs
+            ]
+            originates = neu and getattr(evaluator, "neu_pending", _no_pending)()
+            cross_nodes = getattr(evaluator, "_cross_nodes", None)
+            if (
+                all(len(d) == 0 for d in inputs)
+                and not originates
+                and not (not neu and _has_pending(evaluator))
+                and node.kind != "iterate_result"
+                # a rowwise node's cross-table references are live deps:
+                # run when any referenced table emitted this substep
+                and not (
+                    cross_nodes
+                    and any(len(deltas.get(n.id, ())) for n in cross_nodes)
+                )
+                # lockstep: exchange-point operators participate in every
+                # commit's all-to-all even with no local rows (peers block on
+                # our partitions)
+                and not (self._cluster is not None and evaluator._cluster_barrier)
+            ):
+                delta = Delta.empty(self.output_columns_of(node))
+            else:
+                if (
+                    self._undo_current is not None
+                    and node.id not in self._undo_current["evals"]
+                ):
+                    # pre-mutation snapshot, taken the FIRST time this
+                    # operator runs in the commit (the neu phase re-runs
+                    # nodes; the undo target is the pre-commit state)
+                    self._capture_undo_state(node, evaluator)
+                if self._cluster is not None and any(
+                    p is not None for p in evaluator._cluster_policies
+                ):
+                    inputs = self._route_cluster_inputs(node, evaluator, inputs)
+                if originates:
+                    delta = evaluator.drain_neu(inputs)
+                else:
+                    try:
+                        delta = evaluator.process(inputs)
+                    except Exception as exc:
+                        from pathway_tpu.internals.trace import add_error_context
+                        from pathway_tpu.parallel.cluster import (
+                            PeerShutdownError,
+                            PeerTimeoutError,
+                        )
+
+                        if isinstance(exc, (PeerShutdownError, PeerTimeoutError)):
+                            # a peer death inside this node's exchange is an
+                            # infrastructure failure, not an operator bug:
+                            # keep it TYPED so the surgical-rejoin fence (and
+                            # isinstance-based failure triage) can catch it
+                            raise
+                        raise add_error_context(exc, node) from exc
+            if neu and len(delta):
+                delta.neu = True
+        deltas[node.id] = delta
+        if len(delta):
+            any_output = True
+            self._step_counts[node.id] = self._step_counts.get(node.id, 0) + len(delta)
+            if node.output is not None and node.id in self._materialized:
+                if self._undo_current is not None:
+                    # applied-delta record: Delta.negated() of each entry
+                    # (in reverse) is the exact state-table undo
+                    self._undo_current["applied"].append((node.id, delta))
+                self.states[node.id].apply(delta)
+        if profile_ops is not None:
+            rows = len(delta)
+            # count_nonzero: ONE pass over diffs (a min() pre-check reads
+            # the array twice on the update-heavy deltas that dominate
+            # steady state, doubling the per-op profiling cost)
+            retractions = (
+                int(np.count_nonzero(delta.diffs < 0)) if rows else 0
+            )
+            profile_ops.append((
+                node.id,
+                node.name,
+                node.kind,
+                time_mod.perf_counter() - _t_op,
+                rows,
+                retractions,
+                neu,
+            ))
         return any_output
 
     def _route_cluster_inputs(
@@ -1703,7 +1792,14 @@ class GraphRunner:
         self._lint_done = True
         from pathway_tpu.analysis import GraphLintError, analyze_graph
 
-        report = analyze_graph(self.graph, persistence=persistence)
+        # one DAG walk per runner: the same AnalysisContext feeds the fusion
+        # planner in setup() (building two contexts per pw.run was a full
+        # duplicate walk of consumer maps + upstream sets)
+        report = analyze_graph(
+            self.graph,
+            persistence=persistence,
+            ctx=self._analysis_context(persistence=persistence),
+        )
         report.emit_telemetry()
         if report.diagnostics:
             log = logging.getLogger("pathway_tpu.analysis")

@@ -113,6 +113,18 @@ class ColumnExpression(ABC):
     def __rxor__(self, other: Any) -> "ColumnBinaryOpExpression":
         return ColumnBinaryOpExpression(operator.xor, other, self)
 
+    def __lshift__(self, other: Any) -> "ColumnBinaryOpExpression":
+        return ColumnBinaryOpExpression(operator.lshift, self, other)
+
+    def __rlshift__(self, other: Any) -> "ColumnBinaryOpExpression":
+        return ColumnBinaryOpExpression(operator.lshift, other, self)
+
+    def __rshift__(self, other: Any) -> "ColumnBinaryOpExpression":
+        return ColumnBinaryOpExpression(operator.rshift, self, other)
+
+    def __rrshift__(self, other: Any) -> "ColumnBinaryOpExpression":
+        return ColumnBinaryOpExpression(operator.rshift, other, self)
+
     def __invert__(self) -> "ColumnUnaryOpExpression":
         return ColumnUnaryOpExpression(operator.not_, self)
 
