@@ -14,6 +14,12 @@ Three surfaces share this one analyzer:
 
 Diagnostic codes: PWA001 determinism, PWA002 rewind-safety, PWA003 unbounded
 state, PWA004 device placement, PWA005 checkpoint compatibility.
+
+A second pass family (``analysis/concurrency.py``) lints the RUNTIME's own
+threaded source instead of user graphs: PWA101 lock-order cycles, PWA102
+unbounded waits, PWA103 unlocked shared writes, PWA104 thread-lifecycle
+hygiene — surfaced as ``cli analyze --runtime`` (same exit-code contract) and
+the ``PATHWAY_RUNTIME_LINT`` gate.
 """
 
 from __future__ import annotations
@@ -38,6 +44,17 @@ from pathway_tpu.analysis.fusion import (
     FusionPlan,
     FusionPlanner,
     plan_fusion,
+)
+from pathway_tpu.analysis.concurrency import (
+    LockOrderPass,
+    RUNTIME_MODULES,
+    ThreadLifecyclePass,
+    UnboundedWaitPass,
+    UnlockedSharedWritePass,
+    analyze_runtime,
+    analyze_source,
+    default_concurrency_passes,
+    runtime_gate,
 )
 from pathway_tpu.analysis.passes import (
     CheckpointCompatibilityPass,
@@ -70,6 +87,15 @@ __all__ = [
     "DevicePlacementPass",
     "RewindSafetyPass",
     "UnboundedStatePass",
+    "LockOrderPass",
+    "RUNTIME_MODULES",
+    "ThreadLifecyclePass",
+    "UnboundedWaitPass",
+    "UnlockedSharedWritePass",
+    "analyze_runtime",
+    "analyze_source",
+    "default_concurrency_passes",
+    "runtime_gate",
 ]
 
 _CAPTURE_ENV = "PATHWAY_LINT_CAPTURE"

@@ -156,11 +156,19 @@ def replay(threads, processes, first_port, record_path, mode, continue_after_rep
     is_flag=True,
     help="treat warnings as errors for the exit code (exit 2 instead of 1)",
 )
-@click.argument("program")
+@click.option(
+    "--runtime",
+    is_flag=True,
+    help="lint the runtime's own threaded modules (PWA101-PWA104 concurrency "
+    "passes: lock-order cycles, unbounded waits, unlocked shared writes, "
+    "thread lifecycle) instead of a user program; PROGRAM is not required",
+)
+@click.argument("program", required=False)
 @click.argument("arguments", nargs=-1)
-def analyze(fmt, strict, program, arguments):
+def analyze(fmt, strict, runtime, program, arguments):
     """Static graph lint: build PROGRAM's dataflow graph without running it and
-    report PWA001-PWA005 diagnostics.
+    report PWA001-PWA005 diagnostics (or, with ``--runtime``, lint the
+    runtime's own concurrency: PWA101-PWA104 over the threaded modules).
 
     Exit-code contract (CI-gateable without parsing text): 0 = clean,
     1 = warnings only (2 with --strict), 2 = errors, 3 = PROGRAM itself crashed
@@ -170,6 +178,27 @@ def analyze(fmt, strict, program, arguments):
 
     from pathway_tpu.analysis import analyze_graph, capture_program_graph
 
+    if runtime:
+        if program is not None:
+            # a typo'd `analyze --runtime my_graph.py` must not exit 0 with
+            # the user's program silently never linted
+            raise click.UsageError(
+                "--runtime lints the runtime itself and takes no PROGRAM; "
+                "run `analyze PROGRAM` separately for the graph lint"
+            )
+        from pathway_tpu.analysis import analyze_runtime
+
+        report = analyze_runtime()
+        report.emit_telemetry()
+        if fmt.lower() == "json":
+            click.echo(report.to_json())
+        else:
+            for diagnostic in report.diagnostics:
+                click.echo(diagnostic.format())
+            click.echo(report.summary_line())
+        sys.exit(report.exit_code(strict=strict))
+    if program is None:
+        raise click.UsageError("PROGRAM is required unless --runtime is given")
     try:
         graph, persistence = capture_program_graph(program, tuple(arguments))
     except Exception:

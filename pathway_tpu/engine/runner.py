@@ -1776,6 +1776,14 @@ class GraphRunner:
         ``error`` an error-severity finding refuses the run (GraphLintError)."""
         import logging
 
+        # the runtime's OWN concurrency (PWA101-104) gate rides here too but
+        # is an independent knob: PATHWAY_LINT=off must not disarm it.
+        # Default off — the runtime tree changes with the package, not the
+        # user program, so CI runs `cli analyze --runtime` instead of every
+        # pw.run paying a re-parse
+        from pathway_tpu.analysis import runtime_gate
+
+        runtime_gate()
         mode = os.environ.get("PATHWAY_LINT", "warn").strip().lower()
         if mode in ("off", "0", "false", "no", "none", ""):
             return

@@ -32,7 +32,13 @@ Environment contract::
                     "truncate_prob": 0.0},
          "rejoin": [{"rank": 0, "run": 1}],
          "backend": {"put_error_prob": 0.5, "max_errors": 4},
-         "checkpoint": [{"op": "post_snapshot_kill", "rank": 0, "run": 0, "at": 1}]}
+         "checkpoint": [{"op": "post_snapshot_kill", "rank": 0, "run": 0, "at": 1}],
+         "sched": {"seed": 7}}
+
+``sched`` pins the deterministic model-check scheduler's seed
+(``internals/sched.py`` — :meth:`Chaos.sched_seed`): a chaos plan can name the
+exact protocol interleaving a model-check suite replays, the same way it names
+kill commits. ``PATHWAY_SCHED_SEED`` overrides it.
 
 ``run`` in a kill entry matches ``PATHWAY_RESTART_COUNT`` (set by the
 supervisor, 0 for a first launch), so a kill fires once and the restarted
@@ -211,6 +217,17 @@ class Chaos:
         except Exception:
             pass  # the kill must fire regardless
         os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- deterministic schedule seeds ------------------------------------------
+
+    def sched_seed(self) -> "Optional[int]":
+        """The plan's pinned model-check scheduler seed, or None. Consumed by
+        ``internals/sched.py`` when neither an explicit seed nor
+        ``PATHWAY_SCHED_SEED`` is given — chaos plans name protocol
+        interleavings exactly like they name kill commits."""
+        entry = self.plan.get("sched") or {}
+        seed = entry.get("seed")
+        return int(seed) if seed is not None else None
 
     # -- rejoin handshakes -----------------------------------------------------
 

@@ -1,0 +1,474 @@
+"""Checkable models of the cluster protocols for the deterministic scheduler.
+
+Each model is a small, faithful port of one hand-written thread protocol from
+the runtime — the epoch fence/rejoin install (``parallel/cluster.py``), the
+snapshot→ack→manifest→compact coordinated checkpoint (``engine/runner.py`` +
+``persistence/engine.py``), and the query-coalescer admission/shed path
+(``models/embed_pipeline.py``) — rewritten against ``internals/sched.py``
+primitives so EVERY interleaving decision is scheduler-controlled. Run them
+under :func:`~pathway_tpu.internals.sched.explore` (bounded-exhaustive DFS) or
+:func:`~pathway_tpu.internals.sched.sweep_seeds` (seeded walks) and the
+invariants below hold on every schedule — or fail with a replayable choice
+sequence:
+
+- **fence/rejoin**: no stale-epoch frame is ever delivered, future-epoch
+  frames park and deliver exactly once at install, every survivor adopts the
+  new epoch, and the protocol never deadlocks;
+- **checkpoint**: at most one manifest per commit id, compaction only behind
+  a durable manifest, and an aborted attempt leaves the previous manifest
+  intact;
+- **coalescer**: every request is shed XOR answered, admission slots are
+  always released (queued rows return to zero), and close never strands a
+  waiter.
+
+Each model takes a ``bug=`` knob that plants a realistic regression
+(``"no_purge"`` skips the install-time inbox purge, ``"toctou_commit"``
+releases the manifest lock between the read-back check and the write,
+``"leak_slot"`` drops the queued-row release on the encode error path,
+``"no_timeout"`` makes a wait unabortable). The broken variants exist so the
+model-check suite can prove it DETECTS the bug class with a replayable
+schedule — the safety net ROADMAP item 1's membership protocol will run
+under.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from pathway_tpu.internals.sched import DeterministicScheduler
+
+# ---------------------------------------------------------------------------
+# fence broadcast + rejoin install (parallel/cluster.py)
+# ---------------------------------------------------------------------------
+
+
+class _ModelSurvivor:
+    """One fenced survivor: epoch-checked inbox with park/drop semantics —
+    the reader-thread logic of ``ClusterExchange._reader`` + the install step
+    of ``await_rejoin``, minus the sockets."""
+
+    def __init__(self, sched: DeterministicScheduler, idx: int, bug: Optional[str]):
+        self.idx = idx
+        self.bug = bug
+        self.cv = sched.condition(name=f"s{idx}.cv")
+        self.epoch = 0
+        self.inbox: List[tuple] = []  # (frame_epoch, payload) awaiting delivery
+        self.parked: List[tuple] = []  # future-epoch frames
+        self.delivered: List[tuple] = []  # (frame_epoch, epoch_at_delivery, payload)
+        self.stale_dropped = 0
+        self.fence_pending = False
+        self.rejoin_ready = False
+        self.installed = False
+
+    def on_frame(self, frame_epoch: int, payload: str) -> None:
+        """A peer/replacement/zombie frame arrives (any thread)."""
+        with self.cv:
+            if frame_epoch < self.epoch and self.bug != "deliver_stale":
+                self.stale_dropped += 1
+                return
+            if frame_epoch > self.epoch:
+                self.parked.append((frame_epoch, payload))
+                self.cv.notify_all()
+                return
+            self.inbox.append((frame_epoch, payload))
+            self.cv.notify_all()
+
+    def set_fence(self) -> None:
+        with self.cv:
+            self.fence_pending = True
+            self.cv.notify_all()
+
+    def set_rejoin_ready(self) -> None:
+        with self.cv:
+            self.rejoin_ready = True
+            self.cv.notify_all()
+
+    def install(self, new_epoch: int) -> None:
+        """Adopt the rejoin: purge the aborted epoch's inbox, deliver parked
+        frames already sent at the adopted epoch."""
+        with self.cv:
+            if self.bug != "no_purge":
+                self.stale_dropped += len(self.inbox)
+                self.inbox = []
+            self.epoch = new_epoch
+            keep = [(e, p) for (e, p) in self.parked if e == new_epoch]
+            self.stale_dropped += len(self.parked) - len(keep)
+            self.inbox.extend(keep)
+            self.parked = []
+            self.installed = True
+            self.cv.notify_all()
+
+    def drain(self, expect: int) -> None:
+        """Deliver frames until ``expect`` post-install frames arrived."""
+        while True:
+            with self.cv:
+                while self.inbox:
+                    frame_epoch, payload = self.inbox.pop(0)
+                    self.delivered.append((frame_epoch, self.epoch, payload))
+                if len([d for d in self.delivered if d[1] == self.epoch]) >= expect:
+                    return
+                self.cv.wait()
+
+
+def fence_rejoin_model(
+    n_survivors: int = 2, *, bug: Optional[str] = None
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The surgical-restart epoch fence: ``n_survivors`` fenced survivors, a
+    fence broadcaster, a zombie still sending epoch-0 frames (the dead rank's
+    in-flight traffic), and a replacement dialing in and then talking at
+    epoch 1. Survivors that install first immediately send epoch-1 frames to
+    the others — the future-epoch parking path races exactly like the real
+    mesh. Invariants: every delivered frame matches the epoch at delivery, no
+    parked frames are stranded, all survivors converge to epoch 1, and the
+    protocol cannot deadlock."""
+
+    new_epoch = 1
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        survivors = [_ModelSurvivor(sched, i, bug) for i in range(n_survivors)]
+        # post-install each survivor expects: one replacement frame + one
+        # frame from every other survivor
+        expect = 1 + (n_survivors - 1)
+
+        def survivor_body(me: _ModelSurvivor) -> None:
+            # barrier-wait aborted by the fence (ClusterFenceError path)
+            with me.cv:
+                while not me.fence_pending:
+                    me.cv.wait()
+            # await_rejoin: quiesce until the replacement re-dialed
+            with me.cv:
+                while not me.rejoin_ready:
+                    me.cv.wait()
+            me.install(new_epoch)
+            # replayed barriers: talk to the other survivors at the new epoch
+            for peer in survivors:
+                if peer is not me:
+                    peer.on_frame(new_epoch, f"s{me.idx}->s{peer.idx}")
+            me.drain(expect)
+
+        def zombie_body() -> None:
+            # the dead rank's frames still in flight: stale once epochs move
+            for peer in survivors:
+                peer.on_frame(0, f"zombie->s{peer.idx}")
+
+        def fence_body() -> None:
+            for peer in survivors:
+                peer.set_fence()
+
+        def replacement_body() -> None:
+            # re-dial each survivor (install order is scheduler-chosen) …
+            for peer in survivors:
+                peer.set_rejoin_ready()
+            # … then run the replayed barriers at the new epoch
+            for peer in survivors:
+                peer.on_frame(new_epoch, f"replacement->s{peer.idx}")
+
+        for surv in survivors:
+            sched.spawn(survivor_body, surv, name=f"survivor{surv.idx}")
+        sched.spawn(fence_body, name="fence")
+        sched.spawn(zombie_body, name="zombie")
+        sched.spawn(replacement_body, name="replacement")
+
+        def check() -> None:
+            for surv in survivors:
+                assert surv.epoch == new_epoch, (
+                    f"survivor {surv.idx} never adopted epoch {new_epoch}"
+                )
+                assert not surv.parked, (
+                    f"survivor {surv.idx} stranded parked frames: {surv.parked}"
+                )
+                for frame_epoch, at_epoch, payload in surv.delivered:
+                    assert frame_epoch == at_epoch, (
+                        f"stale-epoch delivery on survivor {surv.idx}: frame "
+                        f"{payload!r} from epoch {frame_epoch} delivered at "
+                        f"epoch {at_epoch}"
+                    )
+                post = [d for d in surv.delivered if d[1] == new_epoch]
+                assert len(post) == expect, (
+                    f"survivor {surv.idx} delivered {len(post)} post-install "
+                    f"frames, expected {expect}"
+                )
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
+# coordinated checkpoint: snapshot → ack → manifest → compact
+# ---------------------------------------------------------------------------
+
+
+def checkpoint_model(
+    n_ranks: int = 3,
+    *,
+    crash_rank: Optional[int] = None,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The aligned checkpoint protocol at one commit id: every rank snapshots,
+    acks durability, rank 0 commits the read-back-verified manifest only after
+    ALL acks, everyone compacts only behind the manifest. A ``backup``
+    committer models the retry path — with the real protocol's
+    check-and-commit held under one lock it can never double-commit; with
+    ``bug="toctou_commit"`` the lock drops between the read-back check and the
+    write, and some interleaving commits the manifest twice.
+    ``crash_rank`` kills one rank after its snapshot (the chaos
+    ``post_snapshot_kill``): the ack barrier must then abort on its deadline
+    and leave the PREVIOUS manifest intact."""
+
+    commit_id = 7
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("store")
+        cv = sched.condition(lock, name="store.cv")
+        store: Dict[str, Any] = {
+            "snapshots": {},  # rank -> commit id
+            "acks": set(),
+            "manifests": [("prev", commit_id - 1)],  # the durable previous checkpoint
+            "compacted": set(),
+            "aborted": False,
+        }
+        # a barrier wait is abortable by construction in the real protocol
+        # (the mesh barrier deadline); model the deadline as a bounded number
+        # of timeout wakeups
+        deadline_polls = 4
+
+        def ack_barrier_wait() -> bool:
+            """True when every rank acked; False = deadline expired (abort)."""
+            polls = 0
+            with cv:
+                while len(store["acks"]) < n_ranks:
+                    if store["aborted"]:
+                        return False
+                    timeout = None if bug == "no_timeout" else 1.0
+                    if not cv.wait(timeout=timeout):
+                        polls += 1
+                        if polls >= deadline_polls:
+                            store["aborted"] = True
+                            cv.notify_all()
+                            return False
+                return not store["aborted"]
+
+        def commit_manifest() -> None:
+            """Read-back-verified manifest commit (rank 0 and the retry path
+            race through here; the lock must cover check AND write)."""
+            if bug == "toctou_commit":
+                with lock:
+                    already = any(m[0] == "ckpt" for m in store["manifests"])
+                sched.yield_point("manifest-gap")  # lock dropped: the TOCTOU window
+                if not already:
+                    with lock:
+                        store["manifests"].append(("ckpt", commit_id))
+            else:
+                with lock:
+                    if not any(m[0] == "ckpt" for m in store["manifests"]):
+                        store["manifests"].append(("ckpt", commit_id))
+            with cv:
+                cv.notify_all()
+
+        def rank_body(rank: int) -> None:
+            with cv:
+                store["snapshots"][rank] = commit_id
+            sched.yield_point("snapshot-durable")
+            if rank == crash_rank:
+                return  # post-snapshot kill: no ack ever arrives
+            with cv:
+                store["acks"].add(rank)
+                cv.notify_all()
+            ok = ack_barrier_wait()
+            if rank == 0 and ok:
+                commit_manifest()
+            # outcome: compact only once a manifest for THIS commit is durable
+            polls = 0
+            with cv:
+                while not any(m == ("ckpt", commit_id) for m in store["manifests"]):
+                    if store["aborted"]:
+                        return
+                    if not cv.wait(timeout=1.0):
+                        polls += 1
+                        if polls >= deadline_polls:
+                            return
+                store["compacted"].add(rank)
+
+        def backup_committer() -> None:
+            """The retry path: re-drive the manifest commit once every ack is
+            in (a supervisor re-poke after a slow rank 0). Safe only because
+            commit_manifest re-verifies under the lock."""
+            polls = 0
+            with cv:
+                while len(store["acks"]) < n_ranks:
+                    if store["aborted"]:
+                        return
+                    if not cv.wait(timeout=1.0):
+                        polls += 1
+                        if polls >= deadline_polls:
+                            return
+            commit_manifest()
+
+        for rank in range(n_ranks):
+            sched.spawn(rank_body, rank, name=f"rank{rank}")
+        sched.spawn(backup_committer, name="backup")
+
+        def check() -> None:
+            manifests = [m for m in store["manifests"] if m == ("ckpt", commit_id)]
+            assert len(manifests) <= 1, (
+                f"double manifest commit for commit {commit_id}: "
+                f"{store['manifests']}"
+            )
+            assert ("prev", commit_id - 1) in store["manifests"], (
+                "previous checkpoint manifest was lost"
+            )
+            if crash_rank is not None:
+                assert not manifests, (
+                    "manifest committed although a rank died before acking"
+                )
+            for rank in store["compacted"]:
+                assert manifests, (
+                    f"rank {rank} compacted its journal with no durable manifest"
+                )
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
+# query-coalescer admission / shed (models/embed_pipeline.py)
+# ---------------------------------------------------------------------------
+
+
+def coalescer_model(
+    n_clients: int = 3,
+    *,
+    cap: int = 2,
+    fail_batch: bool = False,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The QueryCoalescer admission protocol: clients admit one row each
+    against ``cap`` queued rows (past it they shed), a worker batches the
+    queue and answers every taken request, close() wakes everyone. With
+    ``fail_batch`` the encoder raises on the first batch — the error must
+    propagate to exactly the taken requests WITH their admission slots
+    released (``bug="leak_slot"`` drops the release on that path, the real
+    regression class behind a permanently-429 coalescer)."""
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("coalescer")
+        cv = sched.condition(lock, name="coalescer.cv")
+        state: Dict[str, Any] = {
+            "queue": [],  # request ids waiting for the worker
+            "queued_rows": 0,
+            "shed": set(),
+            "answered": set(),
+            "errored": set(),
+            "closed": False,
+            "batches": 0,
+        }
+
+        def client_body(req: int) -> None:
+            with cv:
+                if state["queued_rows"] + 1 > cap:
+                    state["shed"].add(req)
+                    cv.notify_all()  # a shed is a terminal outcome too
+                    return
+                state["queue"].append(req)
+                state["queued_rows"] += 1
+                cv.notify_all()
+
+        def worker_body() -> None:
+            while True:
+                with cv:
+                    # notify-driven idle wait (every queue/closed transition
+                    # notifies): an untimed wait here also makes the deadlock
+                    # detector prove no state change can be missed
+                    while not state["queue"]:
+                        if state["closed"]:
+                            return
+                        cv.wait()
+                    take = list(state["queue"])
+                    state["queue"] = []
+                fail = fail_batch and state["batches"] == 0
+                state["batches"] += 1
+                sched.yield_point("encode")
+                with cv:
+                    if fail:
+                        state["errored"].update(take)
+                        if bug != "leak_slot":
+                            state["queued_rows"] -= len(take)
+                    else:
+                        state["answered"].update(take)
+                        state["queued_rows"] -= len(take)
+                    cv.notify_all()
+
+        def closer_body() -> None:
+            # close after every client's request reached a terminal state
+            with cv:
+                while (
+                    len(state["shed"]) + len(state["answered"]) + len(state["errored"])
+                    < n_clients
+                ):
+                    cv.wait()
+                state["closed"] = True
+                cv.notify_all()
+
+        sched.spawn(worker_body, name="worker")
+        for req in range(n_clients):
+            sched.spawn(client_body, req, name=f"client{req}")
+        sched.spawn(closer_body, name="closer")
+
+        def check() -> None:
+            outcomes = [state["shed"], state["answered"], state["errored"]]
+            seen: set = set()
+            for group in outcomes:
+                assert not (seen & group), f"request answered twice: {seen & group}"
+                seen |= group
+            assert seen == set(range(n_clients)), (
+                f"requests stranded with no outcome: {set(range(n_clients)) - seen}"
+            )
+            assert state["queued_rows"] == 0, (
+                f"admission slots leaked: {state['queued_rows']} rows still "
+                "counted after every request terminated"
+            )
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
+# planted lock-order inversion (the PWA101 <-> model-check bridge)
+# ---------------------------------------------------------------------------
+
+
+def lock_order_model(
+    *, inverted: bool = False
+) -> Callable[[DeterministicScheduler], Optional[Callable[[], None]]]:
+    """Two threads over two locks. ``inverted=False`` is the fixed ordering
+    discipline (both take A before B — never deadlocks); ``inverted=True``
+    plants the classic AB/BA inversion, which deadlocks under the right
+    interleaving. The same shape, written with real ``threading`` primitives,
+    is what PWA101 catches statically — the model-check run is the dynamic
+    proof of the same bug."""
+
+    def model(sched: DeterministicScheduler) -> None:
+        a = sched.lock("A")
+        b = sched.lock("B")
+
+        def forward() -> None:
+            with a:
+                sched.yield_point("between")
+                with b:
+                    pass
+
+        def backward() -> None:
+            first, second = (b, a) if inverted else (a, b)
+            with first:
+                sched.yield_point("between")
+                with second:
+                    pass
+
+        sched.spawn(forward, name="forward")
+        sched.spawn(backward, name="backward")
+        return None
+
+    return model

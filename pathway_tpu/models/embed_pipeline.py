@@ -167,6 +167,12 @@ class QueryCoalescer:
         # every client's deadline contract silently dies
         self.max_queue_rows = max(0, int(max_queue_rows))
         self._after_batch = after_batch
+        # hard bound on one request's total wait (0 = no bound; the wait is
+        # still abortable — see _await). Covers a wedged encoder device: the
+        # fence deadline must never sit behind an unbounded embed wait.
+        self.wait_timeout_s = float(
+            os.environ.get("PATHWAY_EMBED_WAIT_TIMEOUT_S", "0") or 0
+        )
         self._queue: "deque[_Request]" = deque()
         self._queued_rows = 0
         self._encode_ewma_s = 0.0  # smoothed per-batch encode time (Retry-After)
@@ -235,13 +241,57 @@ class QueryCoalescer:
                 )
                 self._worker.start()
             self._cond.notify_all()
-        req.event.wait()
+        self._await(req)
         if req.error is not None:
             raise req.error
         assert req.rows is not None
         return req.rows
 
+    def _await(self, req: _Request) -> None:
+        """Abortable wait for a submitted request (the PWA102 contract: every
+        runtime wait must wake periodically so teardown and the fence deadline
+        can abort it — the previous untimed ``event.wait()`` wedged the engine
+        thread forever when the coalescer died with the request still queued).
+        The worker drains the queue on close, so the typed abort only fires
+        when the request is still queued and no worker remains to take it;
+        ``PATHWAY_EMBED_WAIT_TIMEOUT_S`` (0 = unbounded) additionally bounds
+        the total wait against a wedged encoder device."""
+        deadline = (
+            time.monotonic() + self.wait_timeout_s if self.wait_timeout_s > 0 else None
+        )
+        while not req.event.wait(timeout=0.25):
+            with self._cond:
+                if req.event.is_set():
+                    break
+                worker = self._worker
+                if (
+                    self._closed
+                    and req in self._queue
+                    and (worker is None or not worker.is_alive())
+                ):
+                    self._queue.remove(req)
+                    self._queued_rows -= len(req.texts)
+                    req.error = RuntimeError(
+                        "QueryCoalescer closed before this request was "
+                        "dispatched (no worker left to drain the queue)"
+                    )
+                    req.event.set()
+                    break
+            if deadline is not None and time.monotonic() > deadline:
+                with self._cond:
+                    if req in self._queue:
+                        self._queue.remove(req)
+                        self._queued_rows -= len(req.texts)
+                raise TimeoutError(
+                    f"embed request not answered within "
+                    f"{self.wait_timeout_s:.0f}s "
+                    "(PATHWAY_EMBED_WAIT_TIMEOUT_S) — encoder wedged?"
+                )
+
     def close(self) -> None:
+        """Idempotent. A live worker drains the queue before exiting (every
+        already-admitted request is still answered); requests stranded with no
+        worker fail typed from :meth:`_await` instead of hanging."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()

@@ -611,13 +611,18 @@ class ClusterExchange:
 
     def _heartbeat_loop(self, peer: int, gen: int = 0) -> None:
         while not self._stop.wait(self.heartbeat_interval_s):
-            if (
-                self._closed
-                or peer in self._dead
-                # the link was replaced by a rejoin; its NEW heartbeat thread
-                # owns the beacons now
-                or self._conn_gen.get(peer, 0) != gen
-            ):
+            with self._cv:
+                # _dead/_conn_gen are _cv-owned state; reading them unlocked
+                # raced the rejoin install (PWA103 — a torn read could keep a
+                # stale beacon thread alive against a replaced link)
+                stale = (
+                    self._closed
+                    or peer in self._dead
+                    # the link was replaced by a rejoin; its NEW heartbeat
+                    # thread owns the beacons now
+                    or self._conn_gen.get(peer, 0) != gen
+                )
+            if stale:
                 return
             try:
                 self._send(peer, HEARTBEAT_TAG, b"")
@@ -695,6 +700,9 @@ class ClusterExchange:
                         self._conn_gen[rank] = self._conn_gen.get(rank, 0) + 1
                         self._dead.pop(rank, None)
                         self._last_heard[rank] = time.monotonic()
+                        # minted under _cv: _send reads this dict from
+                        # heartbeat threads concurrently with the install
+                        self._send_locks.setdefault(rank, threading.Lock())
                     # the aborted epoch's frames must never meet the replayed
                     # barriers that reuse their tags: purge the whole inbox
                     # (parked readers wake, re-check the epoch, and drop)
@@ -739,7 +747,6 @@ class ClusterExchange:
                         pass
                 for rank, (conn, _e) in installed.items():
                     self._tune_socket(conn)
-                    self._send_locks.setdefault(rank, threading.Lock())
                     self._start_reader(rank, conn)
                     if self.heartbeat_interval_s > 0:
                         self._start_heartbeat(rank)

@@ -66,7 +66,7 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         self,
         model: str = "sentence-transformers/all-MiniLM-L6-v2",
         *,
-        call_kwargs: dict = {},
+        call_kwargs: "dict | None" = None,
         device: str = "tpu",
         batch_size: int = 1024,
         max_wait_ms: float = 2.0,

@@ -122,7 +122,7 @@ class HFPipelineChat(BaseChat):
     def __init__(
         self,
         model: str | None = None,
-        call_kwargs: dict = {},
+        call_kwargs: "dict | None" = None,
         device: str = "cpu",
         cache_strategy: CacheStrategy | None = None,
         **pipeline_kwargs: Any,
@@ -135,7 +135,7 @@ class HFPipelineChat(BaseChat):
         from transformers import pipeline
 
         self.pipeline = pipeline("text-generation", model=model, device=device, **pipeline_kwargs)
-        self.call_kwargs = dict(call_kwargs)
+        self.call_kwargs = dict(call_kwargs or {})
 
         def chat(messages: Any, **kwargs: Any) -> str | None:
             coerced = _coerce_messages(messages)

@@ -1,0 +1,238 @@
+"""Model-check suite (pathway_tpu/internals/protocol_models.py): the cluster
+protocols under ≥200 distinct interleavings each, invariants holding on every
+schedule; the planted-bug variants proving the harness DETECTS each bug class
+with a replayable schedule; and the PWA101 ↔ model-check bridge — the same
+lock-order inversion caught statically and dynamically.
+
+Budgeted for tier-1: the whole module runs in well under the 60 s modelcheck
+budget (each explore() of a few hundred schedules is ~1-3 s)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import pytest
+
+from pathway_tpu.analysis import analyze_source
+from pathway_tpu.internals import protocol_models as pm
+from pathway_tpu.internals.sched import (
+    DeadlockError,
+    InvariantViolation,
+    explore,
+    run_once,
+    sweep_seeds,
+)
+
+pytestmark = pytest.mark.modelcheck
+
+# acceptance: >= 200 distinct interleavings per protocol
+N_SCHEDULES = 200
+
+# wall seconds of the acceptance batteries, recorded by the tests themselves
+# and asserted by test_model_check_battery_within_budget (runs last in file
+# order) — the documented <60 s tier-1 budget is enforced, not aspirational
+_BATTERY_SECONDS: Dict[str, float] = {}
+
+
+# ---------------------------------------------------------------------------
+# fence / rejoin
+# ---------------------------------------------------------------------------
+
+
+def test_fence_rejoin_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.fence_rejoin_model(2), max_schedules=N_SCHEDULES, name="fence"
+    )
+    _BATTERY_SECONDS["fence"] = time.monotonic() - t0
+    assert result.ok, (
+        f"fence/rejoin invariant failed on schedule {result.failing_schedule}: "
+        f"{result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+def test_fence_rejoin_invariants_hold_seeded():
+    result = sweep_seeds(
+        pm.fence_rejoin_model(2), n_seeds=100, base_seed=1, name="fence-seeded"
+    )
+    assert result.ok, f"seed {result.failing_seed}: {result.failure}"
+    assert result.distinct_schedules == 100
+
+
+def test_fence_rejoin_three_survivors():
+    result = explore(pm.fence_rejoin_model(3), max_schedules=100, name="fence3")
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+
+
+def test_fence_rejoin_no_purge_bug_caught_and_replayable():
+    result = explore(
+        pm.fence_rejoin_model(2, bug="no_purge"),
+        max_schedules=400,
+        name="fence-no-purge",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the install-purge regression went undetected"
+    )
+    assert "stale-epoch delivery" in str(result.failure)
+    # the failing schedule replays the exact interleaving
+    with pytest.raises(InvariantViolation, match="stale-epoch delivery"):
+        run_once(
+            pm.fence_rejoin_model(2, bug="no_purge"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
+# coordinated checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.checkpoint_model(3), max_schedules=N_SCHEDULES, name="ckpt"
+    )
+    _BATTERY_SECONDS["ckpt"] = time.monotonic() - t0
+    assert result.ok, (
+        f"checkpoint invariant failed on schedule {result.failing_schedule}: "
+        f"{result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+def test_checkpoint_invariants_hold_seeded():
+    result = sweep_seeds(
+        pm.checkpoint_model(3), n_seeds=100, base_seed=5, name="ckpt-seeded"
+    )
+    assert result.ok, f"seed {result.failing_seed}: {result.failure}"
+
+
+def test_checkpoint_crash_leaves_previous_manifest_intact():
+    # post-snapshot kill of rank 1: the ack barrier must abort on its
+    # deadline and nobody may commit or compact
+    result = explore(
+        pm.checkpoint_model(3, crash_rank=1), max_schedules=N_SCHEDULES,
+        name="ckpt-crash",
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+
+
+def test_checkpoint_toctou_double_commit_caught_with_seed():
+    result = sweep_seeds(
+        pm.checkpoint_model(3, bug="toctou_commit"),
+        n_seeds=300,
+        base_seed=10,
+        name="ckpt-toctou",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the manifest TOCTOU regression went undetected"
+    )
+    assert "double manifest commit" in str(result.failure)
+    assert result.failing_seed is not None
+    # the SEED alone reproduces the double commit (deterministic walk)
+    with pytest.raises(InvariantViolation, match="double manifest commit"):
+        run_once(
+            pm.checkpoint_model(3, bug="toctou_commit"), seed=result.failing_seed
+        )
+
+
+# ---------------------------------------------------------------------------
+# coalescer admission / shed
+# ---------------------------------------------------------------------------
+
+
+def test_coalescer_invariants_hold_exhaustive():
+    result = explore(
+        pm.coalescer_model(3, cap=2), max_schedules=N_SCHEDULES, name="coal"
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+def test_coalescer_error_path_releases_slots():
+    result = explore(
+        pm.coalescer_model(3, cap=2, fail_batch=True),
+        max_schedules=N_SCHEDULES,
+        name="coal-err",
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+
+
+def test_coalescer_slot_leak_bug_caught_and_replayable():
+    result = explore(
+        pm.coalescer_model(3, cap=2, fail_batch=True, bug="leak_slot"),
+        max_schedules=300,
+        name="coal-leak",
+    )
+    assert isinstance(result.failure, InvariantViolation)
+    assert "admission slots leaked" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="admission slots leaked"):
+        run_once(
+            pm.coalescer_model(3, cap=2, fail_batch=True, bug="leak_slot"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
+# PWA101 <-> model check: the same inversion caught both ways
+# ---------------------------------------------------------------------------
+
+_INVERSION_SOURCE = '''
+import threading
+
+class MeshLocks:
+    def __init__(self):
+        self.inbox_lock = threading.Lock()
+        self.gen_lock = threading.Lock()
+
+    def deliver(self):
+        with self.inbox_lock:
+            with self.gen_lock:
+                pass
+
+    def install(self):
+        with self.gen_lock:
+            with self.inbox_lock:
+                pass
+'''
+
+
+def test_planted_inversion_caught_by_pwa101_and_model_check():
+    # statically: the lint pass names the cycle
+    report = analyze_source(_INVERSION_SOURCE)
+    pwa101 = report.by_code("PWA101")
+    assert pwa101, report.to_json()
+    assert "MeshLocks.inbox_lock" in pwa101[0].message
+    assert "MeshLocks.gen_lock" in pwa101[0].message
+    # dynamically: the scheduler finds the deadlocking interleaving of the
+    # same AB/BA shape, with a replayable schedule
+    result = explore(
+        pm.lock_order_model(inverted=True), max_schedules=200, name="inversion"
+    )
+    assert isinstance(result.failure, DeadlockError)
+    with pytest.raises(DeadlockError):
+        run_once(pm.lock_order_model(inverted=True), choices=result.failing_schedule)
+    # and the disciplined ordering is clean under BOTH
+    fixed = _INVERSION_SOURCE.replace(
+        "with self.gen_lock:\n            with self.inbox_lock:",
+        "with self.inbox_lock:\n            with self.gen_lock:",
+    )
+    assert not analyze_source(fixed).by_code("PWA101")
+    assert explore(pm.lock_order_model(inverted=False), max_schedules=200).ok
+
+
+# ---------------------------------------------------------------------------
+# budget guard: the whole protocol battery stays inside tier-1 bounds
+# ---------------------------------------------------------------------------
+
+
+def test_model_check_battery_within_budget():
+    # the acceptance batteries above recorded their own wall time (no work is
+    # redone here); each 200-schedule explore is a few seconds solo, and the
+    # documented <60 s budget must hold even under full-suite load
+    if set(_BATTERY_SECONDS) != {"fence", "ckpt"}:
+        pytest.skip("acceptance batteries did not run in this session (-k selection)")
+    total = sum(_BATTERY_SECONDS.values())
+    assert total < 60, f"model-check acceptance batteries too slow: {_BATTERY_SECONDS}"

@@ -1,0 +1,288 @@
+"""Deterministic-scheduler tests (pathway_tpu/internals/sched.py): seeded and
+choice-list replay identity, deadlock/livelock detection, DFS distinctness,
+modeled-timeout semantics, invariant plumbing, telemetry, and thread hygiene
+of the harness itself."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from pathway_tpu.internals.sched import (
+    DeadlockError,
+    DeterministicScheduler,
+    InvariantViolation,
+    LivelockError,
+    SchedulingError,
+    default_seed,
+    explore,
+    run_once,
+    sweep_seeds,
+)
+
+pytestmark = pytest.mark.modelcheck
+
+
+def _locked_counter_model(sched):
+    """Two workers increment a shared counter under a lock: always 6."""
+    state = {"x": 0}
+    lock = sched.lock("L")
+
+    def worker():
+        for _ in range(3):
+            with lock:
+                v = state["x"]
+                sched.yield_point("compute")
+                state["x"] = v + 1
+
+    sched.spawn(worker, name="w1")
+    sched.spawn(worker, name="w2")
+
+    def check():
+        assert state["x"] == 6, f"locked counter lost updates: {state['x']}"
+
+    return check
+
+
+def _racy_counter_model(sched):
+    """Same, no lock: a classic lost update on the right interleaving."""
+    state = {"x": 0}
+
+    def worker():
+        for _ in range(2):
+            v = state["x"]
+            sched.yield_point("compute")
+            state["x"] = v + 1
+
+    sched.spawn(worker, name="w1")
+    sched.spawn(worker, name="w2")
+
+    def check():
+        assert state["x"] == 4, f"lost update: {state['x']}"
+
+    return check
+
+
+# ---------------------------------------------------------------------------
+# replay identity
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_schedules_replay_identically():
+    a = run_once(_locked_counter_model, seed=42)
+    b = run_once(_locked_counter_model, seed=42)
+    assert a.choices_taken == b.choices_taken
+    assert a.trace == b.trace
+
+
+def test_different_seeds_reach_different_schedules():
+    schedules = {
+        tuple(run_once(_locked_counter_model, seed=s).choices_taken)
+        for s in range(10)
+    }
+    assert len(schedules) > 1
+
+
+def test_choice_list_replay_is_exact():
+    a = run_once(_locked_counter_model, seed=7)
+    b = run_once(_locked_counter_model, choices=a.choices_taken)
+    assert b.choices_taken == a.choices_taken
+    assert b.trace == a.trace
+
+
+def test_failing_schedule_replays_the_failure():
+    result = explore(_racy_counter_model, max_schedules=300, name="racy")
+    assert result.failure is not None
+    assert isinstance(result.failure, InvariantViolation)
+    assert result.failing_schedule == result.failure.schedule
+    with pytest.raises(InvariantViolation):
+        run_once(_racy_counter_model, choices=result.failing_schedule)
+
+
+# ---------------------------------------------------------------------------
+# failure detection
+# ---------------------------------------------------------------------------
+
+
+def test_lock_inversion_deadlock_detected_with_schedule():
+    def inverted(sched):
+        a, b = sched.lock("A"), sched.lock("B")
+
+        def t1():
+            with a:
+                sched.yield_point("gap")
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                sched.yield_point("gap")
+                with a:
+                    pass
+
+        sched.spawn(t1, name="t1")
+        sched.spawn(t2, name="t2")
+        return None
+
+    result = explore(inverted, max_schedules=200, name="inverted")
+    assert isinstance(result.failure, DeadlockError)
+    assert result.failing_schedule
+    with pytest.raises(DeadlockError) as exc_info:
+        run_once(inverted, choices=result.failing_schedule)
+    assert exc_info.value.schedule == result.failing_schedule
+
+
+def test_untimed_wait_deadlocks_timed_wait_survives():
+    def waiter(timeout):
+        def model(sched):
+            cv = sched.condition(name="cv")
+            done = {"ok": False}
+
+            def t1():
+                with cv:
+                    while not done["ok"]:
+                        if not cv.wait(timeout=timeout):
+                            done["ok"] = True  # deadline abort path
+
+            sched.spawn(t1, name="t1")
+            return None
+
+        return model
+
+    # nobody will ever notify: the untimed wait is a guaranteed deadlock —
+    # the dynamic proof of the PWA102 rule
+    assert isinstance(explore(waiter(None), max_schedules=20).failure, DeadlockError)
+    assert explore(waiter(1.0), max_schedules=20).ok
+
+
+def test_livelock_bound():
+    def spinner(sched):
+        def t1():
+            while True:
+                sched.yield_point("spin")
+
+        sched.spawn(t1, name="t1")
+        return None
+
+    with pytest.raises(LivelockError):
+        run_once(spinner, seed=0, max_steps=50)
+
+
+def test_model_exception_is_typed_and_replayable():
+    def crasher(sched):
+        def t1():
+            sched.yield_point("pre")
+            raise ValueError("boom")
+
+        sched.spawn(t1, name="t1")
+        return None
+
+    with pytest.raises(SchedulingError) as exc_info:
+        run_once(crasher, seed=0)
+    assert "boom" in str(exc_info.value)
+    assert exc_info.value.schedule  # replayable
+
+
+# ---------------------------------------------------------------------------
+# exploration
+# ---------------------------------------------------------------------------
+
+
+def test_explore_produces_distinct_schedules():
+    result = explore(_locked_counter_model, max_schedules=120, name="distinct")
+    assert result.ok
+    assert result.schedules_run == 120
+    assert result.distinct_schedules == 120  # DFS: every schedule differs
+
+
+def test_explore_exhausts_tiny_trees():
+    def tiny(sched):
+        def t1():
+            sched.yield_point("only")
+
+        sched.spawn(t1, name="t1")
+        return None
+
+    result = explore(tiny, max_schedules=100, name="tiny")
+    assert result.ok
+    assert result.schedules_run < 100  # exhausted, not capped
+
+
+def test_sweep_seeds_records_failing_seed():
+    result = sweep_seeds(_racy_counter_model, n_seeds=100, base_seed=0)
+    assert result.failure is not None
+    assert result.failing_seed is not None
+    with pytest.raises(InvariantViolation):
+        run_once(_racy_counter_model, seed=result.failing_seed)
+
+
+# ---------------------------------------------------------------------------
+# seed resolution + telemetry + hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_default_seed_env_and_chaos_plan(monkeypatch):
+    from pathway_tpu.internals import chaos as chaos_mod
+
+    monkeypatch.setenv("PATHWAY_SCHED_SEED", "1234")
+    assert default_seed() == 1234
+    monkeypatch.delenv("PATHWAY_SCHED_SEED")
+    monkeypatch.setenv("PATHWAY_CHAOS_PLAN", '{"sched": {"seed": 77}}')
+    chaos_mod.reset_chaos()
+    try:
+        assert default_seed() == 77
+        assert chaos_mod.get_chaos().sched_seed() == 77
+    finally:
+        monkeypatch.delenv("PATHWAY_CHAOS_PLAN")
+        chaos_mod.reset_chaos()
+
+
+def test_failure_emits_modelcheck_flight_event_and_counters(monkeypatch):
+    from pathway_tpu.engine import telemetry
+    from pathway_tpu.engine.profile import get_flight_recorder
+
+    recorder = get_flight_recorder()
+    monkeypatch.setattr(recorder, "enabled", True)
+    telemetry.stage_reset("modelcheck.")
+    result = sweep_seeds(_racy_counter_model, n_seeds=100, base_seed=0, name="racy-tel")
+    assert result.failure is not None
+    counters = telemetry.stage_snapshot("modelcheck.")
+    assert counters.get("modelcheck.runs", 0) >= 1, counters
+    assert counters.get("modelcheck.failures", 0) >= 1, counters
+    events = [
+        ev for ev in list(recorder._events) if ev.get("kind") == "modelcheck"
+    ]
+    assert events, "no modelcheck flight event recorded"
+    ev = events[-1]
+    assert ev["model"] == "racy-tel"
+    assert ev["seed"] == result.failing_seed
+    assert ev["schedule"] == result.failing_schedule
+
+
+def test_scheduler_leaks_no_threads():
+    before = {t.ident for t in threading.enumerate()}
+    run_once(_locked_counter_model, seed=3)
+    result = explore(_racy_counter_model, max_schedules=50)
+    assert result.failure is not None  # aborted runs must clean up too
+    leaked = [
+        t
+        for t in threading.enumerate()
+        if t.ident not in before and t.name.startswith("pathway:sched")
+    ]
+    for t in leaked:
+        t.join(timeout=5)
+    leaked = [
+        t
+        for t in threading.enumerate()
+        if t.ident not in before and t.name.startswith("pathway:sched")
+    ]
+    assert not leaked, [t.name for t in leaked]
+
+
+def test_one_scheduler_drives_one_run():
+    sched = DeterministicScheduler(seed=0)
+    sched.spawn(lambda: None, name="t")
+    sched.run()
+    with pytest.raises(RuntimeError):
+        sched.run()

@@ -1,0 +1,168 @@
+"""Thread-lifecycle hygiene (the PWA104 contract, audited dynamically): after
+``pw.run`` / stepped-run teardown and after a monitoring/REST server stop, no
+non-daemon thread beyond the main thread survives — a leaked non-daemon
+thread blocks interpreter shutdown and holds its resources across back-to-back
+runs. Plus regression tests for the PWA102 fix in ``QueryCoalescer``: the
+previously-untimed ``event.wait()`` now aborts typed instead of wedging the
+engine thread when the coalescer dies with the request still queued."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.models.embed_pipeline import QueryCoalescer
+
+
+def _non_daemon_threads():
+    main = threading.main_thread()
+    return [
+        t
+        for t in threading.enumerate()
+        if t is not main and not t.daemon and t.is_alive()
+    ]
+
+
+def _assert_no_leaks(before, what: str):
+    # allow a short settle for threads mid-exit at teardown
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        leaked = [t for t in _non_daemon_threads() if t not in before]
+        if not leaked:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"non-daemon threads leaked after {what}: {leaked}")
+
+
+def test_no_nondaemon_threads_after_pw_run():
+    before = _non_daemon_threads()
+    t = pw.debug.table_from_rows(pw.schema_builder({"v": int}), [(1,), (2,)])
+    got = []
+    pw.io.subscribe(t, lambda key, row, time, is_addition: got.append(row["v"]))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert sorted(got) == [1, 2]
+    _assert_no_leaks(before, "pw.run teardown")
+
+
+def test_no_nondaemon_threads_after_stepped_run():
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals import parse_graph as pg
+
+    before = _non_daemon_threads()
+    t = pw.debug.table_from_rows(pw.schema_builder({"v": int}), [(3,)])
+    got = []
+    pw.io.subscribe(t, lambda key, row, time, is_addition: got.append(row["v"]))
+    runner = GraphRunner(pg.G._current)
+    runner.setup()
+    while runner.step():
+        pass
+    runner.finish()
+    assert got == [3]
+    _assert_no_leaks(before, "stepped-run teardown")
+
+
+def test_no_nondaemon_threads_after_monitoring_server_stop():
+    from pathway_tpu.engine.http_server import MonitoringServer, ProberStats
+
+    before = _non_daemon_threads()
+    server = MonitoringServer(ProberStats(), 0)  # ephemeral port
+    assert server.port > 0
+    server.close()
+    server.close()  # idempotent
+    _assert_no_leaks(before, "MonitoringServer stop")
+    # the serving thread itself (daemon) must also exit, not just be orphaned
+    server.thread.join(timeout=5)
+    assert not server.thread.is_alive()
+
+
+def test_no_nondaemon_threads_after_rest_webserver_stop():
+    aiohttp = pytest.importorskip("aiohttp")
+    del aiohttp
+    import socket
+
+    from pathway_tpu.io.http._server import PathwayWebserver
+
+    before = _non_daemon_threads()
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    server = PathwayWebserver(host="127.0.0.1", port=port)
+    server._ensure_running()
+    assert server._started.wait(timeout=10)
+    _assert_no_leaks(before, "REST webserver start+stop")
+    # the aiohttp loop thread is daemon by contract (PWA104): it must never
+    # keep the interpreter alive
+    assert server._thread.daemon
+
+
+# ---------------------------------------------------------------------------
+# QueryCoalescer PWA102 regression: the wait is bounded and abortable
+# ---------------------------------------------------------------------------
+
+
+def _rows(texts):
+    return [np.zeros(4, dtype=np.float32) for _ in texts]
+
+
+def test_coalescer_close_with_live_worker_still_answers():
+    co = QueryCoalescer(_rows, max_wait_ms=1.0, max_batch=8)
+    out = co.embed(["a", "b"])
+    assert len(out) == 2
+    co.close()
+    co.close()  # idempotent
+
+
+def test_coalescer_close_with_dead_worker_fails_typed_not_wedged():
+    """A request stranded in the queue with no worker to drain it must fail
+    typed within the poll interval — before the fix, embed() sat in an
+    untimed event.wait() forever (the PWA102 finding)."""
+    co = QueryCoalescer(_rows, max_wait_ms=1.0, max_batch=8)
+    # plant a stranded request: queued, no worker thread, coalescer closed —
+    # the state a worker crash (or an exec-env teardown) leaves behind
+    from pathway_tpu.models.embed_pipeline import _Request
+
+    req = _Request(["stuck"])
+    with co._cond:
+        co._queue.append(req)
+        co._queued_rows += 1
+        co._closed = True
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="closed before this request"):
+        co._await(req)
+        raise req.error  # _await sets the typed error; embed() re-raises it
+    assert time.monotonic() - t0 < 5.0, "abort took longer than the poll bound"
+    assert co._queued_rows == 0, "admission slot leaked on the abort path"
+
+
+def test_coalescer_wait_timeout_knob(monkeypatch):
+    """PATHWAY_EMBED_WAIT_TIMEOUT_S bounds the total wait against a wedged
+    encoder device."""
+    release = threading.Event()
+
+    def wedged_encoder(texts):
+        release.wait(timeout=30)
+        return _rows(texts)
+
+    monkeypatch.setenv("PATHWAY_EMBED_WAIT_TIMEOUT_S", "1")
+    co = QueryCoalescer(wedged_encoder, max_wait_ms=1.0, max_batch=8)
+    assert co.wait_timeout_s == 1.0
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="PATHWAY_EMBED_WAIT_TIMEOUT_S"):
+        co.embed(["x"])
+    assert time.monotonic() - t0 < 10.0
+    release.set()  # un-wedge the worker so it exits
+    co.close()
+
+
+def test_coalescer_error_propagation_still_works():
+    def failing(texts):
+        raise ValueError("encoder down")
+
+    co = QueryCoalescer(failing, max_wait_ms=1.0, max_batch=8)
+    with pytest.raises(ValueError, match="encoder down"):
+        co.embed(["x"])
+    co.close()
