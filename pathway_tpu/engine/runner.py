@@ -1768,6 +1768,18 @@ class GraphRunner:
         if self._http_server is not None:
             self._http_server.close()
             self._http_server = None
+        # stop idle encoder-service workers (drain + join): teardown must not
+        # leave a device-owning thread behind a finished run — services stay
+        # usable, the worker respawns lazily on the next submit. Module never
+        # imported = no services exist = nothing to stop.
+        import sys as _sys
+
+        svc_mod = _sys.modules.get("pathway_tpu.models.encoder_service")
+        if svc_mod is not None:
+            try:
+                svc_mod.stop_all_workers()
+            except Exception:
+                pass
 
     def _lint_gate(self, *, persistence: bool) -> None:
         """Automatic graph lint before the first commit, gated by

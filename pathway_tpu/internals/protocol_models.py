@@ -19,7 +19,11 @@ sequence:
   intact;
 - **coalescer**: every request is shed XOR answered, admission slots are
   always released (queued rows return to zero), and close never strands a
-  waiter.
+  waiter;
+- **encoder service**: the continuous-batching admission/tick/shutdown
+  protocol (``models/encoder_service.py``) — every request shed XOR answered,
+  waiting and in-flight row counts return to zero, shutdown drains the queue,
+  and the timed tick keeps the idle wait abortable (no lost-wakeup deadlock).
 
 Each model takes a ``bug=`` knob that plants a realistic regression
 (``"no_purge"`` skips the install-time inbox purge, ``"toctou_commit"``
@@ -429,6 +433,164 @@ def coalescer_model(
                 f"admission slots leaked: {state['queued_rows']} rows still "
                 "counted after every request terminated"
             )
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
+# encoder-service admission / tick / shutdown (models/encoder_service.py)
+# ---------------------------------------------------------------------------
+
+
+def encoder_service_model(
+    n_clients: int = 3,
+    *,
+    cap: int = 2,
+    max_inflight: int = 2,
+    fail_batch: bool = False,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The EncoderService protocol, modeled BEFORE the real threads were wired
+    (the PR-9 discipline): clients admit one row each against ``cap`` waiting
+    rows (past it they shed); a continuous-batching worker takes up to
+    ``max_inflight`` rows per tick, encodes, answers exactly the taken
+    requests, and releases the in-flight slots; a stopper requests shutdown
+    once every client made its admission decision, and the worker must DRAIN
+    the queue before exiting. Clients abort typed only when the worker is gone
+    with their request still queued (the self-heal/abort path of
+    ``EncoderService._await``).
+
+    All waits are modeled UNTIMED (notify-driven, like the coalescer model):
+    under the deadlock detector that PROVES every state transition notifies
+    its waiters — the real implementation's timed tick/poll bounds are
+    defense-in-depth on top of a protocol shown to need no timeout wakeups.
+
+    Invariants: no deadlock, every request shed XOR answered XOR errored
+    (none aborted/dropped under the correct protocol), and slots always
+    released (waiting AND in-flight row counts return to zero).
+
+    Planted bugs: ``"leak_inflight"`` drops the in-flight release on the
+    encode-error path (the slot-leak class behind a permanently-"full"
+    service); ``"drop_on_close"`` makes the worker exit on stop WITHOUT
+    draining, stranding admitted requests (caught as aborted requests);
+    ``"lost_close_wakeup"`` drops the stop notify — the lost-wakeup deadlock
+    class, caught because the idle wait is notify-driven."""
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("svc")
+        cv = sched.condition(lock, name="svc.cv")
+        state: Dict[str, Any] = {
+            "queue": [],  # admitted request ids waiting for the worker
+            "queued_rows": 0,
+            "inflight_rows": 0,
+            "decided": 0,  # clients whose admission decision happened
+            "shed": set(),
+            "answered": set(),
+            "errored": set(),
+            "aborted": set(),
+            "stop": False,
+            "worker_done": False,
+            "ticks": 0,
+        }
+
+        def client_body(req: int) -> None:
+            with cv:
+                if state["queued_rows"] + 1 > cap:
+                    state["shed"].add(req)
+                    state["decided"] += 1
+                    cv.notify_all()
+                    return
+                state["queue"].append(req)
+                state["queued_rows"] += 1
+                state["decided"] += 1
+                cv.notify_all()
+            # notify-driven wait for a terminal outcome; typed abort only when
+            # no worker remains to drain the queue
+            with cv:
+                while req not in state["answered"] and req not in state["errored"]:
+                    if state["worker_done"] and req in state["queue"]:
+                        state["queue"].remove(req)
+                        state["queued_rows"] -= 1
+                        state["aborted"].add(req)
+                        cv.notify_all()
+                        return
+                    cv.wait()
+
+        def worker_body() -> None:
+            while True:
+                with cv:
+                    while not state["queue"]:
+                        if state["stop"]:
+                            state["worker_done"] = True
+                            cv.notify_all()
+                            return
+                        cv.wait()  # notify-driven idle wait (see docstring)
+                    if bug == "drop_on_close" and state["stop"]:
+                        # exits with the queue non-empty: admitted requests drop
+                        state["worker_done"] = True
+                        cv.notify_all()
+                        return
+                    take = []
+                    while state["queue"] and len(take) < max_inflight:
+                        take.append(state["queue"].pop(0))
+                    state["queued_rows"] -= len(take)
+                    state["inflight_rows"] += len(take)
+                fail = fail_batch and state["ticks"] == 0
+                state["ticks"] += 1
+                sched.yield_point("encode")
+                with cv:
+                    if fail:
+                        state["errored"].update(take)
+                        if bug != "leak_inflight":
+                            state["inflight_rows"] -= len(take)
+                    else:
+                        state["answered"].update(take)
+                        state["inflight_rows"] -= len(take)
+                    cv.notify_all()
+
+        def stopper_body() -> None:
+            # server stop races the in-flight tick: shutdown may begin as soon
+            # as every client made its admission decision — admitted-but-
+            # unanswered requests must still be drained
+            with cv:
+                while state["decided"] < n_clients:
+                    cv.wait()
+                state["stop"] = True
+                if bug != "lost_close_wakeup":
+                    cv.notify_all()
+
+        sched.spawn(worker_body, name="worker")
+        for req in range(n_clients):
+            sched.spawn(client_body, req, name=f"client{req}")
+        sched.spawn(stopper_body, name="stopper")
+
+        def check() -> None:
+            groups = [
+                state["shed"], state["answered"], state["errored"], state["aborted"],
+            ]
+            seen: set = set()
+            for group in groups:
+                assert not (seen & group), f"request in two outcomes: {seen & group}"
+                seen |= group
+            assert seen == set(range(n_clients)), (
+                f"requests stranded with no outcome: {set(range(n_clients)) - seen}"
+            )
+            assert not state["aborted"], (
+                f"admitted requests dropped at shutdown (worker exited without "
+                f"draining): {state['aborted']}"
+            )
+            assert state["queued_rows"] == 0, (
+                f"admission slots leaked: {state['queued_rows']} rows still "
+                "queued after every request terminated"
+            )
+            assert state["inflight_rows"] == 0, (
+                f"in-flight slots leaked: {state['inflight_rows']} rows still "
+                "counted after every request terminated"
+            )
+            if not fail_batch:
+                assert not state["errored"]
 
         return check
 

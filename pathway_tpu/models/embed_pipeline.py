@@ -1,6 +1,6 @@
 """Pipelined embedding runtime shared by the ingest and query paths.
 
-Three stages in front of ``JaxSentenceEncoder``, each measured through
+Stages in front of ``JaxSentenceEncoder``, each measured through
 ``engine/telemetry.py`` stage counters:
 
 1. **Content-hash embed cache** (:class:`EmbedCache`): an LRU keyed on
@@ -10,17 +10,27 @@ Three stages in front of ``JaxSentenceEncoder``, each measured through
    contract for non-deterministic UDFs: retraction rows are replayed from the
    evaluator's per-key memo and never reach this layer — the cache only
    deduplicates *forward* work across distinct rows/commits with equal text.
-2. **Overlapped length-sorted ingest** (``JaxSentenceEncoder.encode_pipelined``):
+2. **Semantic query cache** (query path only;
+   :class:`~pathway_tpu.models.encoder_service.SemanticQueryCache`): above the
+   content hash — exact mode keys on the tokenizer's canonical form so
+   whitespace/case variants of a served query hit without a forward pass, and
+   stay bitwise-honest by construction; cosine mode is opt-in.
+3. **Overlapped length-sorted ingest** (``JaxSentenceEncoder.encode_pipelined``):
    commit batches split into length-sorted sub-batches, host tokenization of
    sub-batch k+1 overlapping the device's forward of k via JAX async dispatch.
-3. **Query coalescing** (:class:`QueryCoalescer`): a deadline-based
-   micro-batcher in front of ``encode_device`` that merges concurrent
-   in-flight retrieve queries into ONE encoder dispatch (``max_wait_ms`` /
-   ``max_batch``), so N concurrent clients pay ~1 dispatch instead of N.
+4. **Query serving** — by default the persistent continuously-batched
+   :class:`~pathway_tpu.models.encoder_service.EncoderService`
+   (``PATHWAY_ENCSVC=off`` reverts to the PR-4 deadline path). The
+   :class:`QueryCoalescer` stays as the ADMISSION SHIM in front of it: the
+   ``max_queue_rows`` cap, ``overloaded`` pre-admission probe, typed shed with
+   honest Retry-After, and the ``embed.shed`` counter keep their PR-6
+   contract; only the batching mechanics moved into the service (a solo query
+   no longer waits for a deadline window).
 
 Counters (``telemetry.stage_snapshot("embed.")``): cache hits/misses/evictions,
-coalesce requests/batches/rows (avg batch = rows/batches), dedup_rows,
-tokenize/encode timings, padded vs real token counts.
+semantic hits/misses, coalesce/service requests/batches/rows, dedup_rows,
+tokenize/encode timings, padded vs real token counts, ``embed.svc.*`` service
+stages.
 """
 
 from __future__ import annotations
@@ -147,7 +157,17 @@ class QueryCoalescer:
     thread; row values may be host arrays or device-resident jax slices — the
     coalescer never inspects them. An optional ``after_batch(texts, rows)``
     hook runs AFTER responders are released (cache fill without adding to
-    request latency)."""
+    request latency).
+
+    **Service shim mode** (``service=`` set, the default through
+    ``EmbedPipeline`` since the encoder-service PR): the deadline worker is
+    bypassed — :meth:`embed` enforces the admission cap / shed contract here
+    (unchanged REST semantics: ``overloaded`` probed pre-admission, typed
+    :class:`EmbedOverloadError` with honest Retry-After, ``embed.shed``
+    counter) and then submits into the
+    :class:`~pathway_tpu.models.encoder_service.EncoderService`'s ragged
+    queue, whose continuous-batching tick replaces the ``max_wait_ms``
+    window."""
 
     def __init__(
         self,
@@ -157,6 +177,7 @@ class QueryCoalescer:
         max_batch: int = 256,
         max_queue_rows: int = 0,
         after_batch: Callable[[List[str], Sequence[Any]], None] | None = None,
+        service: Any = None,
     ):
         self._encode_rows = encode_rows
         self.max_wait_ms = float(max_wait_ms)
@@ -167,6 +188,7 @@ class QueryCoalescer:
         # every client's deadline contract silently dies
         self.max_queue_rows = max(0, int(max_queue_rows))
         self._after_batch = after_batch
+        self._service = service
         # hard bound on one request's total wait (0 = no bound; the wait is
         # still abortable — see _await). Covers a wedged encoder device: the
         # fence deadline must never sit behind an unbounded embed wait.
@@ -188,21 +210,35 @@ class QueryCoalescer:
         self.max_batch_rows = 0
         self.shed_requests = 0
 
+    def _rows_pending(self) -> int:
+        """Rows admitted against the cap but not yet answered — the shim
+        delegates to the service's queue (waiting + in-flight), the legacy
+        path counts its own queue. Lock-free read either way."""
+        if self._service is not None:
+            return int(self._service.queue_depth_rows())
+        return self._queued_rows
+
     def overloaded(self, extra_rows: int = 0) -> bool:
         """Admission probe: would admitting ``extra_rows`` more rows exceed
         ``max_queue_rows``? Lock-free read — a soft cap with bounded overshoot,
         same contract as the REST ``max_pending`` check."""
         return bool(
             self.max_queue_rows
-            and self._queued_rows + extra_rows >= self.max_queue_rows
+            and self._rows_pending() + extra_rows >= self.max_queue_rows
         )
 
     def retry_after_s(self, extra_rows: int = 0) -> float:
         """Honest Retry-After estimate: batches needed to drain the current
-        queue x (batch window + smoothed encode time), floored at 1 s."""
-        rows = self._queued_rows + extra_rows
-        batches = max(1.0, rows / self.max_batch)
-        per_batch = self.max_wait_ms / 1000.0 + (self._encode_ewma_s or 0.05)
+        queue x (batch window + smoothed encode time), floored at 1 s. In shim
+        mode the window term drops (the service has no deadline wait) and the
+        smoothed encode time comes from the service's ticks."""
+        rows = self._rows_pending() + extra_rows
+        if self._service is not None:
+            batches = max(1.0, rows / self._service.max_in_flight)
+            per_batch = self._service.encode_ewma_s() or 0.05
+        else:
+            batches = max(1.0, rows / self.max_batch)
+            per_batch = self.max_wait_ms / 1000.0 + (self._encode_ewma_s or 0.05)
         return max(1.0, batches * per_batch)
 
     # -- submission ----------------------------------------------------------
@@ -216,6 +252,8 @@ class QueryCoalescer:
         mid-commit would tear down the run instead of shedding one request."""
         if not texts:
             return []
+        if self._service is not None:
+            return self._embed_via_service(list(texts), enforce_cap)
         req = _Request(list(texts))
         with self._cond:
             if self._closed:
@@ -246,6 +284,27 @@ class QueryCoalescer:
             raise req.error
         assert req.rows is not None
         return req.rows
+
+    def _embed_via_service(self, texts: List[str], enforce_cap: bool) -> List[Any]:
+        """Shim path: admission accounting + shed here (the PR-6 contract the
+        REST plane depends on), batching in the service."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("QueryCoalescer is closed")
+            if (
+                enforce_cap
+                and self.max_queue_rows
+                and self._rows_pending() + len(texts) > self.max_queue_rows
+            ):
+                self.shed_requests += 1
+                telemetry.stage_add("embed.shed")
+                raise EmbedOverloadError(
+                    f"embed queue full ({self._rows_pending()} rows pending, "
+                    f"cap {self.max_queue_rows})",
+                    retry_after_s=self.retry_after_s(len(texts)),
+                )
+            self.requests += 1
+        return self._service.submit(texts, enforce_cap=False)
 
     def _await(self, req: _Request) -> None:
         """Abortable wait for a submitted request (the PWA102 contract: every
@@ -392,11 +451,15 @@ class QueryCoalescer:
 
 class EmbedPipeline:
     """The embed runtime shared by ingest (``encode_batch``) and query
-    (``embed_query_rows``) paths: cache → overlapped/coalesced encode → fill.
+    (``embed_query_rows``) paths: caches → service/overlapped encode → fill.
 
-    Knobs: ``max_wait_ms``/``max_batch`` (coalescer window), ``sub_batch``
-    (length-sorted ingest sub-batch rows), ``cache_size`` (LRU entries; 0
-    disables)."""
+    Knobs: ``max_wait_ms``/``max_batch`` (legacy coalescer window),
+    ``sub_batch`` (length-sorted ingest sub-batch rows), ``cache_size`` (LRU
+    entries; 0 disables), ``service_mode`` (None = ``PATHWAY_ENCSVC`` env,
+    default on), ``semantic_mode``/``semantic_size``/``semantic_threshold``
+    (None = ``PATHWAY_ENCSVC_SEMANTIC*`` env; exact/4096/0.95),
+    ``tick_ms``/``max_in_flight``/``prewarm`` forwarded to the
+    :class:`~pathway_tpu.models.encoder_service.EncoderService`."""
 
     def __init__(
         self,
@@ -408,7 +471,23 @@ class EmbedPipeline:
         sub_batch: int = 128,
         cache_size: int = 50_000,
         max_queue_rows: "int | None" = None,
+        service_mode: "bool | None" = None,
+        semantic_mode: "str | None" = None,
+        semantic_size: "int | None" = None,
+        semantic_threshold: "float | None" = None,
+        tick_ms: "float | None" = None,
+        max_in_flight: "int | None" = None,
+        prewarm: "bool | None" = None,
     ):
+        from pathway_tpu.models.encoder_service import (
+            EncoderService,
+            SemanticQueryCache,
+            _env_flag,
+            _env_float,
+            _env_int,
+            default_canonicalize,
+        )
+
         self.encoder = encoder
         self.sub_batch = int(sub_batch)
         self.cache = EmbedCache(cache_size, model=model)
@@ -423,12 +502,46 @@ class EmbedPipeline:
             max_queue_rows = int(
                 os.environ.get("PATHWAY_EMBED_MAX_QUEUE_ROWS", "4096")
             )
+        if service_mode is None:
+            service_mode = _env_flag("PATHWAY_ENCSVC", True)
+        self.service = (
+            EncoderService(
+                encoder,
+                tick_ms=tick_ms,
+                max_in_flight=max_in_flight,
+                prewarm=prewarm,
+                after_batch=self._fill_cache_from_device,
+            )
+            if service_mode
+            else None
+        )
+        # semantic query cache (query path ONLY — ingest and retraction rows
+        # never consult it): exact mode keys on the tokenizer's canonical form
+        # so hits stay bitwise-honest; cosine is opt-in; disabled entirely when
+        # the content cache is disabled (cache_size=0 means "no caching")
+        if semantic_mode is None:
+            semantic_mode = os.environ.get("PATHWAY_ENCSVC_SEMANTIC", "exact") or "exact"
+        if semantic_mode not in ("exact", "cosine", "off"):
+            semantic_mode = "exact"
+        if cache_size <= 0:
+            semantic_mode = "off"
+        if semantic_size is None:
+            semantic_size = _env_int("PATHWAY_ENCSVC_SEMANTIC_SIZE", 4096)
+        if semantic_threshold is None:
+            semantic_threshold = _env_float("PATHWAY_ENCSVC_SEMANTIC_THRESHOLD", 0.95)
+        self.semantic_cache = SemanticQueryCache(
+            semantic_size,
+            mode=semantic_mode,
+            threshold=semantic_threshold,
+            canonicalize=getattr(encoder, "canonicalize", None) or default_canonicalize,
+        )
         self.coalescer = QueryCoalescer(
             self._encode_device_rows,
             max_wait_ms=max_wait_ms,
             max_batch=max_batch,
             max_queue_rows=max_queue_rows,
             after_batch=self._fill_cache_from_device,
+            service=self.service,
         )
 
     # -- ingest path ---------------------------------------------------------
@@ -466,19 +579,38 @@ class EmbedPipeline:
     # -- query path ----------------------------------------------------------
 
     def embed_query_rows(self, texts: List[str]) -> List[Any]:
-        """Per-row embedding values for the serving path. Cache hits return
-        host rows; misses coalesce with every other in-flight query into one
-        ``encode_device`` dispatch and return DEVICE-resident jax slices (the
-        downstream KNN kernel consumes either without an extra round trip)."""
+        """Per-row embedding values for the serving path. Cache hits (content
+        hash first, then the semantic query cache) return host rows; misses
+        ride the encoder service's continuous batch (or the legacy coalescer)
+        and return DEVICE-resident jax slices (the downstream KNN kernel
+        consumes either without an extra round trip)."""
         rows: List[Any] = [None] * len(texts)
         miss_idx: List[int] = []
+        sem_hits = 0
         for i, t in enumerate(texts):
             hit = self.cache.get(t)
+            if hit is None:
+                hit = self.semantic_cache.get(str(t))
+                if hit is not None:
+                    sem_hits += 1
+                    # promote: future lookups of THIS raw text hit the cheaper
+                    # content-hash layer directly
+                    self.cache.put(t, hit)
+            else:
+                # promote the other way: a content hit (possibly filled by the
+                # INGEST path for identical chunk text) seeds the semantic
+                # layer so canonical variants of this query hit too (no-op
+                # once the key exists — steady-state hits stay a single read)
+                self.semantic_cache.seed(str(t), hit)
             if hit is None:
                 miss_idx.append(i)
             else:
                 rows[i] = hit
         self._stage_cache_counts(len(texts) - len(miss_idx), len(miss_idx))
+        if sem_hits:
+            telemetry.stage_add("embed.svc.semantic_hits", sem_hits)
+        if miss_idx and self.semantic_cache.max_entries > 0:
+            telemetry.stage_add("embed.svc.semantic_misses", len(miss_idx))
         if miss_idx:
             # enforce_cap=False: REST admission already probed the cap; raising
             # here would kill the engine commit instead of shedding one request
@@ -494,10 +626,11 @@ class EmbedPipeline:
         return [dev[i] for i in range(len(texts))]
 
     def _fill_cache_from_device(self, texts: List[str], rows: Sequence[Any]) -> None:
-        """Runs on the coalescer worker AFTER responders are released: ONE
-        device→host fetch of the whole batch (restacked from the rows the
+        """Runs on the service/coalescer worker AFTER responders are released:
+        ONE device→host fetch of the whole batch (restacked from the rows the
         responders got — no hidden state shared with the encode call) fills
-        the cache without adding a sync to any query's latency."""
+        the content-hash AND semantic caches without adding a sync to any
+        query's latency."""
         if self.cache.max_entries <= 0 or not texts:
             return
         import jax.numpy as jnp
@@ -505,6 +638,7 @@ class EmbedPipeline:
         host = np.asarray(jnp.stack(list(rows[: len(texts)])), dtype=np.float32)
         for t, v in zip(texts, host):
             self.cache.put(t, v)
+            self.semantic_cache.put(t, v)
 
     def _stage_cache_counts(self, hits: int, misses: int) -> None:
         """ONE batch-level telemetry add per counter per commit (the telemetry
@@ -528,5 +662,8 @@ class EmbedPipeline:
         out: Dict[str, Any] = {}
         out.update(self.cache.stats())
         out.update(self.coalescer.stats())
+        out.update(self.semantic_cache.stats())
+        if self.service is not None:
+            out.update(self.service.stats())
         out["pad_waste_ratio"] = round(self.pad_waste_ratio(), 4)
         return out

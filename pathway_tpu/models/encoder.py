@@ -257,8 +257,18 @@ class JaxSentenceEncoder:
         hf_tok = _load_hf_tokenizer(model_name)
         if hf_tok is not None:
             self._tokenize = lambda texts: self._hf_tokenize(hf_tok, texts)
+            self._tokenizer_lowercases = bool(getattr(hf_tok, "do_lower_case", False))
+            # whitespace-run collapse is id-preserving ONLY for BERT-family
+            # basic tokenization (splits on any whitespace); byte-level BPE
+            # (RoBERTa-style) encodes the runs, so the canonical form must
+            # stay identity there or exact-mode cache hits stop being bitwise
+            self._tokenizer_ws_invariant = (
+                hasattr(hf_tok, "do_lower_case") or "Bert" in type(hf_tok).__name__
+            )
         else:
             self._tokenize = HashTokenizer(self.config.vocab_size, max_length)
+            self._tokenizer_lowercases = True  # HashTokenizer lower()s every word
+            self._tokenizer_ws_invariant = True  # str.split() collapses runs
         params = convert_hf_weights(model_name, self.config)
         if params is None:
             ids = jnp.zeros((1, 8), dtype=jnp.int32)
@@ -298,6 +308,22 @@ class JaxSentenceEncoder:
     @property
     def dim(self) -> int:
         return self.config.hidden_size
+
+    def canonicalize(self, text: str) -> str:
+        """Tokenizer-equivalence canonical form: two texts with equal
+        canonical forms tokenize to IDENTICAL ids, hence bitwise-identical
+        embeddings. Whitespace runs collapse only when the active tokenizer is
+        whitespace-invariant (BERT-family basic tokenization / the hash
+        fallback) and case folds only when it is uncased; for any other
+        tokenizer family the canonical form is the identity — no equivalence
+        is claimed that the tokenizer does not actually provide. The semantic
+        query cache's exact mode keys on this, which is what makes an
+        exact-mode hit bitwise-honest."""
+        s = str(text)
+        if not self._tokenizer_ws_invariant:
+            return s
+        s = " ".join(s.split())
+        return s.lower() if self._tokenizer_lowercases else s
 
     def encode_device(self, texts: list[str]) -> Any:
         """Embeddings as a DEVICE-resident (n, dim) jax array — no host sync.

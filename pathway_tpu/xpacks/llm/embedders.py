@@ -74,12 +74,28 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         sub_batch: int = 128,
         embed_cache_size: int = 50_000,
         encoder_config: Any = None,
+        encoder_service: "bool | None" = None,
+        semantic_cache: "str | None" = None,
+        semantic_cache_size: "int | None" = None,
+        semantic_threshold: "float | None" = None,
+        encsvc_tick_ms: "float | None" = None,
+        encsvc_max_in_flight: "int | None" = None,
+        encsvc_prewarm: "bool | None" = None,
         **kwargs: Any,
     ):
-        """``max_wait_ms``/``max_coalesce_batch``: query-coalescer batch window;
-        ``sub_batch``: length-sorted ingest sub-batch rows; ``embed_cache_size``:
+        """``max_wait_ms``/``max_coalesce_batch``: legacy query-coalescer batch
+        window (only used with the encoder service off); ``sub_batch``:
+        length-sorted ingest sub-batch rows; ``embed_cache_size``:
         content-hash LRU entries (0 disables); ``encoder_config``: override
-        ``EncoderConfig`` (tests use a tiny architecture)."""
+        ``EncoderConfig`` (tests use a tiny architecture);
+        ``encoder_service``: persistent continuously-batched encoder worker on
+        the query path (None = ``PATHWAY_ENCSVC`` env, default on);
+        ``semantic_cache``: ``exact``/``cosine``/``off`` (None =
+        ``PATHWAY_ENCSVC_SEMANTIC``, default exact — bitwise-honest) with
+        ``semantic_cache_size``/``semantic_threshold``;
+        ``encsvc_tick_ms``/``encsvc_max_in_flight``/``encsvc_prewarm``:
+        service tick bound, rows packed per tick, and startup jit pre-warm
+        (None = ``PATHWAY_ENCSVC_TICK_MS``/``_MAX_INFLIGHT``/``_PREWARM``)."""
         super().__init__(**kwargs)
         from pathway_tpu.models.embed_pipeline import EmbedPipeline
         from pathway_tpu.models.encoder import JaxSentenceEncoder
@@ -109,6 +125,13 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             max_batch=max_coalesce_batch,
             sub_batch=sub_batch,
             cache_size=embed_cache_size,
+            service_mode=encoder_service,
+            semantic_mode=semantic_cache,
+            semantic_size=semantic_cache_size,
+            semantic_threshold=semantic_threshold,
+            tick_ms=encsvc_tick_ms,
+            max_in_flight=encsvc_max_in_flight,
+            prewarm=encsvc_prewarm,
         )
 
         def embed_one(text: str) -> np.ndarray:
@@ -136,9 +159,11 @@ class SentenceTransformerEmbedder(BaseEmbedder):
     def device_expression(self, *args: Any, **kwargs: Any) -> expr.ColumnExpression:
         """Query-path variant: embedding cells are DEVICE-resident jax slices so
         downstream device kernels (KNN search) chain without a host round-trip.
-        Runs through the pipeline's content-hash cache and query coalescer, so
-        concurrent retrieve queries share one encoder dispatch and repeated
-        texts skip the forward entirely.
+        Runs through the pipeline's content-hash + semantic caches and submits
+        misses into the persistent encoder service's continuous batch (the
+        coalescer admission shim), so a solo query dispatches immediately into
+        a pre-warmed jit bucket, concurrent retrieve queries share one encoder
+        dispatch, and repeated/equivalent texts skip the forward entirely.
 
         Declared ``deterministic=False`` so the engine memoizes each query row's
         embedding and REPLAYS it on retraction (the rest connector's

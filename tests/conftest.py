@@ -10,6 +10,12 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Background jit pre-warm of every pow2 bucket is the encoder SERVICE's startup
+# behavior; under the test suite it would burn CPU compiling tiny throwaway
+# models per embedder construction. Default it off (the pre-warm tests opt back
+# in with monkeypatch / explicit ctor args).
+os.environ.setdefault("PATHWAY_ENCSVC_PREWARM", "0")
+
 try:
     import jax
     from jax._src import xla_bridge as _xb

@@ -1,0 +1,536 @@
+"""Encoder service tests (ISSUE 11): continuous batching, pre-warmed jit
+buckets, the semantic query cache's honesty contract (exact mode bitwise;
+retraction/re-ingest isolation), the preserved shed/backpressure contract
+through the coalescer shim, and the fence-replay exactly-once extension for
+service-queued in-flight queries. All tier-1 (CPU, tiny encoder config)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.keys import KEY_DTYPE, pointer_from
+from pathway_tpu.models.embed_pipeline import EmbedOverloadError, EmbedPipeline
+from pathway_tpu.models.encoder import EncoderConfig, JaxSentenceEncoder
+from pathway_tpu.models.encoder_service import (
+    EncoderService,
+    SemanticQueryCache,
+    stop_all_workers,
+)
+
+pytestmark = pytest.mark.encsvc
+
+TINY = EncoderConfig(
+    vocab_size=8192, hidden_size=64, num_layers=2, num_heads=4, intermediate_size=128
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_encoder() -> JaxSentenceEncoder:
+    return JaxSentenceEncoder("pw-test-tiny", config=TINY, max_length=64)
+
+
+def _tiny_embedder(**kwargs):
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    return SentenceTransformerEmbedder(
+        model="pw-test-tiny", encoder_config=TINY, **kwargs
+    )
+
+
+def _hash_rows(texts):
+    out = []
+    for t in texts:
+        h = np.frombuffer(str(t).encode().ljust(8, b"\0")[:8], dtype=np.uint8)
+        out.append(h.astype(np.float32))
+    return out
+
+
+class _HashEncoder:
+    """Instant deterministic encoder: row value encodes the text identity."""
+
+    dim = 8
+
+    def __init__(self):
+        self.calls = []
+
+    def encode_device(self, texts):
+        self.calls.append(list(texts))
+        return np.stack(_hash_rows(texts))
+
+
+# ---------------------------------------------------------------------------
+# SemanticQueryCache
+# ---------------------------------------------------------------------------
+
+
+def test_semantic_cache_exact_mode_normalized_key():
+    cache = SemanticQueryCache(8, mode="exact")
+    vec = np.arange(4, dtype=np.float32)
+    cache.put("what is rag?", vec)
+    # whitespace runs and case fold onto the same canonical key
+    hit = cache.get("  What   is  RAG? ")
+    assert hit is not None and np.array_equal(hit, vec)
+    assert not hit.flags.writeable
+    assert cache.get("what is ivf?") is None
+    s = cache.stats()
+    assert s["semantic_exact_hits"] == 1 and s["semantic_misses"] == 1
+    assert s["semantic_cosine_hits"] == 0  # exact mode never fuzzy-matches
+
+
+def test_semantic_cache_lru_eviction_and_off_mode():
+    cache = SemanticQueryCache(2, mode="exact")
+    v = np.ones(2, dtype=np.float32)
+    cache.put("a", v)
+    cache.put("b", v * 2)
+    cache.put("c", v * 3)  # evicts "a"
+    assert cache.get("a") is None
+    assert np.array_equal(cache.get("c"), v * 3)
+    assert cache.stats()["semantic_evictions"] == 1
+    off = SemanticQueryCache(8, mode="off")
+    off.put("a", v)
+    assert off.get("a") is None and len(off) == 0
+
+
+def test_semantic_cache_cosine_mode_near_match():
+    cache = SemanticQueryCache(8, mode="cosine", threshold=0.8)
+    vec = np.arange(4, dtype=np.float32)
+    cache.put("how do i restart a crashed worker rank", vec)
+    # near-duplicate phrasing: high bag-of-words cosine, different exact key
+    hit = cache.get("how do i restart a crashed worker")
+    assert hit is not None and np.array_equal(hit, vec)
+    assert cache.stats()["semantic_cosine_hits"] == 1
+    # unrelated text stays a miss even in cosine mode
+    assert cache.get("tumbling window aggregation semantics") is None
+
+
+def test_semantic_cache_cosine_threshold_respected():
+    strict = SemanticQueryCache(8, mode="cosine", threshold=0.999)
+    strict.put("alpha beta gamma delta", np.ones(2, dtype=np.float32))
+    assert strict.get("alpha beta gamma epsilon") is None  # below threshold
+    assert strict.get("alpha  BETA gamma delta") is not None  # exact canonical key
+
+
+# ---------------------------------------------------------------------------
+# EncoderService: continuous batching
+# ---------------------------------------------------------------------------
+
+
+def test_service_solo_submit_no_deadline_wait():
+    """A solo request dispatches the moment the worker is free — well under
+    any deadline-window latency (the legacy path waited max_wait_ms)."""
+    enc = _HashEncoder()
+    svc = EncoderService(enc, tick_ms=5_000.0, prewarm=False)  # absurd tick
+    t0 = time.perf_counter()
+    out = svc.submit(["solo"])
+    elapsed = time.perf_counter() - t0
+    assert np.array_equal(out[0], _hash_rows(["solo"])[0])
+    assert elapsed < 2.0, f"solo submit waited for a window: {elapsed:.3f}s"
+    svc.close()
+
+
+def test_service_concurrent_clients_coalesce_and_get_own_rows():
+    release = threading.Event()
+    first_gate = [True]
+
+    class _GatedHashEncoder(_HashEncoder):
+        def encode_device(self, texts):
+            if first_gate[0]:
+                first_gate[0] = False
+                release.wait(timeout=10)  # hold tick 1 so a burst piles up
+            return super().encode_device(texts)
+
+    enc = _GatedHashEncoder()
+    svc = EncoderService(enc, prewarm=False)
+    results: dict = {}
+
+    def client(i: int) -> None:
+        results[i] = svc.submit([f"query {i}"])[0]
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(16)]
+    threads[0].start()
+    time.sleep(0.2)  # worker now held inside tick 1
+    for t in threads[1:]:
+        t.start()
+    deadline = time.monotonic() + 5.0
+    while svc.queue_depth_rows() < 16 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    release.set()
+    for t in threads:
+        t.join(timeout=10)
+    for i in range(16):  # every client got exactly ITS row
+        assert np.array_equal(results[i], _hash_rows([f"query {i}"])[0]), i
+    assert svc.ticks < svc.requests  # the pile-up coalesced into fewer ticks
+    assert svc.max_tick_rows > 1
+    assert svc.queue_depth_rows() == 0  # slots always released
+    svc.close()
+
+
+def test_service_dedups_identical_texts_within_tick():
+    release = threading.Event()
+    first_gate = [True]
+
+    class _GatedHashEncoder(_HashEncoder):
+        def encode_device(self, texts):
+            if first_gate[0]:
+                first_gate[0] = False
+                release.wait(timeout=10)
+            return super().encode_device(texts)
+
+    enc = _GatedHashEncoder()
+    svc = EncoderService(enc, prewarm=False)
+    out: list = [None] * 8
+
+    def client(i: int) -> None:
+        out[i] = svc.submit(["same question"])[0]
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    threads[0].start()
+    time.sleep(0.2)
+    for t in threads[1:]:
+        t.start()
+    deadline = time.monotonic() + 5.0
+    while svc.queue_depth_rows() < 8 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    release.set()
+    for t in threads:
+        t.join(timeout=10)
+    expect = _hash_rows(["same question"])[0]
+    assert all(np.array_equal(v, expect) for v in out)
+    # the duplicate text encoded once per tick, not once per client
+    assert sum(len(b) for b in enc.calls) == svc.ticks
+    assert svc.dedup_rows == 8 - svc.ticks
+    svc.close()
+
+
+def test_service_error_propagates_and_releases_slots():
+    class _FailingEncoder:
+        dim = 4
+
+        def encode_device(self, texts):
+            raise RuntimeError("encoder exploded")
+
+    svc = EncoderService(_FailingEncoder(), prewarm=False)
+    with pytest.raises(RuntimeError, match="encoder exploded"):
+        svc.submit(["x"])
+    assert svc.queue_depth_rows() == 0  # the leak_inflight invariant, live
+    # the worker survives a failing tick
+    svc.encoder = _HashEncoder()
+    assert np.array_equal(svc.submit(["later"])[0], _hash_rows(["later"])[0])
+    svc.close()
+
+
+def test_service_large_tick_splits_length_sorted():
+    enc = _HashEncoder()
+    svc = EncoderService(enc, sub_batch=4, prewarm=False)
+    texts = [f"{'w ' * (i % 7 + 1)}q{i}" for i in range(10)]
+    out = svc.submit(texts)
+    for i, t in enumerate(texts):
+        assert np.array_equal(out[i], _hash_rows([t])[0]), i
+    # one submission of 10 rows with sub_batch=4 → 3 length-sorted dispatches
+    assert len(enc.calls) == 3
+    assert sorted(len(b) for b in enc.calls) == [2, 4, 4]
+    lengths = [len(t.split()) for b in enc.calls for t in b]
+    assert lengths == sorted(lengths), "packing was not length-sorted"
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# pre-warm: startup honesty
+# ---------------------------------------------------------------------------
+
+
+def test_prewarm_compiles_buckets_and_reports_wall_time(tiny_encoder):
+    from pathway_tpu.engine import telemetry
+
+    before = telemetry.stage_snapshot("embed.svc.").get("embed.svc.prewarm_s", 0.0)
+    svc = EncoderService(
+        tiny_encoder, prewarm=True, prewarm_max_batch=8, max_in_flight=8
+    )
+    assert svc.wait_warm(timeout_s=120.0), "pre-warm never finished"
+    # batch bucket {8} x seq buckets {8,16,32,64} for max_length=64
+    assert svc.prewarm_compiles == 4
+    assert svc.prewarm_s > 0.0
+    snap = telemetry.stage_snapshot("embed.svc.")
+    assert snap.get("embed.svc.prewarm_s", 0.0) > before
+    assert snap.get("embed.svc.prewarm_compiles", 0.0) >= 4
+    stats = svc.stats()
+    assert stats["svc_warm"] and stats["svc_prewarm_compiles"] == 4
+    # warm path still answers correctly
+    row = np.asarray(svc.submit(["warm bucket query"])[0], dtype=np.float32)
+    assert np.array_equal(row, tiny_encoder.encode(["warm bucket query"])[0])
+    svc.close()
+
+
+def test_stop_worker_aborts_prewarm_even_without_worker(tiny_encoder):
+    """pw.run teardown (stop_all_workers) must cancel an in-flight pre-warm
+    compile matrix even when no query ever spawned a worker — the abort rides
+    its own event, not the worker's _stop_requested flag."""
+    svc = EncoderService(
+        tiny_encoder, prewarm=True, prewarm_max_batch=256, max_in_flight=256
+    )
+    svc.stop_worker()
+    pt = svc._prewarm_thread
+    assert pt is None or not pt.is_alive(), "pre-warm thread survived stop_worker"
+    assert svc._prewarm_abort.is_set()
+    assert svc.warm  # nobody blocks on wait_warm after an abort
+    svc.close()
+
+
+def test_prewarm_skipped_for_non_jax_encoders():
+    svc = EncoderService(_HashEncoder(), prewarm=True)
+    assert svc.warm  # nothing to compile: warm immediately, no thread spun
+    assert svc.prewarm_compiles == 0
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration: semantic cache honesty
+# ---------------------------------------------------------------------------
+
+
+def _wait_cache_fill(pipe: EmbedPipeline, n: int, timeout: float = 10.0) -> None:
+    deadline = time.monotonic() + timeout
+    while len(pipe.cache) < n and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(pipe.cache) >= n, "after-batch cache fill never ran"
+
+
+def test_exact_mode_hit_is_bitwise_identical_to_direct_encode(tiny_encoder):
+    pipe = EmbedPipeline(tiny_encoder, model="t", cache_size=64, prewarm=False)
+    pipe.embed_query_rows(["What is a Vector  Index?"])
+    _wait_cache_fill(pipe, 1)
+    variant = "  what IS a vector index?  "
+    row = pipe.embed_query_rows([variant])[0]
+    assert pipe.semantic_cache.stats()["semantic_exact_hits"] == 1
+    direct = tiny_encoder.encode([variant])[0]
+    assert np.array_equal(np.asarray(row, dtype=np.float32), direct), (
+        "exact-mode semantic hit is not bitwise-identical to a fresh encode"
+    )
+    stop_all_workers()
+
+
+def test_semantic_hit_skips_the_forward_entirely(tiny_encoder):
+    pipe = EmbedPipeline(tiny_encoder, model="t2", cache_size=64, prewarm=False)
+    calls = []
+    orig = tiny_encoder.encode_device
+    tiny_encoder.encode_device = lambda t: (calls.append(list(t)), orig(t))[1]
+    try:
+        pipe.embed_query_rows(["semantic skip test"])
+        _wait_cache_fill(pipe, 1)
+        n_before = sum(len(b) for b in calls)
+        pipe.embed_query_rows(["  SEMANTIC   skip   test "])
+        assert sum(len(b) for b in calls) == n_before  # no new forward rows
+    finally:
+        tiny_encoder.encode_device = orig
+    stop_all_workers()
+
+
+def test_cosine_mode_is_opt_in_and_off_by_default(tiny_encoder):
+    pipe = EmbedPipeline(tiny_encoder, model="t3", cache_size=64, prewarm=False)
+    assert pipe.semantic_cache.mode == "exact"
+    pipe2 = EmbedPipeline(
+        tiny_encoder, model="t4", cache_size=64, prewarm=False,
+        semantic_mode="cosine", semantic_threshold=0.8,
+    )
+    assert pipe2.semantic_cache.mode == "cosine"
+    stop_all_workers()
+
+
+def test_reingest_never_served_from_semantic_cache(tiny_encoder):
+    """The ingest path (encode_batch) must not consult the semantic cache: a
+    poisoned semantic entry for the same canonical text must never leak into
+    document embeddings on re-ingest."""
+    pipe = EmbedPipeline(tiny_encoder, model="t5", cache_size=64, prewarm=False)
+    text = "document chunk about cats"
+    truth = pipe.encode_batch([text])[0]
+    # plant a poisoned semantic entry under the same canonical key
+    pipe.semantic_cache.put(text, np.full(TINY.hidden_size, 777.0, dtype=np.float32))
+    pipe.cache.clear()  # force the content cache to miss on re-ingest
+    again = pipe.encode_batch(["  DOCUMENT chunk about cats  "])[0]
+    assert not np.array_equal(again, np.full(TINY.hidden_size, 777.0)), (
+        "re-ingest was served from the semantic query cache"
+    )
+    reingest = pipe.encode_batch([text])[0]
+    assert np.array_equal(reingest, truth)
+    stop_all_workers()
+
+
+def test_retractions_never_reach_semantic_cache():
+    """device_expression is deterministic=False: retraction rows replay from
+    the engine memo — neither the service, the content cache, nor the semantic
+    cache may see them (a semantic near-match answering a retraction would
+    break the bit-identical replay contract)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals import parse_graph as pg
+
+    emb = _tiny_embedder(embed_cache_size=64, encsvc_prewarm=False)
+    forwards = []
+    orig = emb.encoder.encode_device
+    emb.encoder.encode_device = lambda t: (forwards.append(list(t)), orig(t))[1]
+
+    sem_gets = []
+    orig_get = emb.pipeline.semantic_cache.get
+    emb.pipeline.semantic_cache.get = lambda t: (sem_gets.append(t), orig_get(t))[1]
+
+    pg.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"q": str}),
+        [("what is a cat", 0, 1), ("what is a dog", 0, 1), ("what is a cat", 2, -1)],
+        is_stream=True,
+    )
+    res = t.select(v=emb.device_expression(t.q))
+    got = []
+    pw.io.subscribe(
+        res,
+        on_batch=lambda keys, diffs, columns, time: got.extend(
+            zip(columns["v"], diffs.tolist())
+        ),
+    )
+    GraphRunner(pg.G._current).run(monitoring_level=pw.MonitoringLevel.NONE)
+    # the two inserts consulted the caches; the retraction consulted NOTHING
+    # (replayed from the evaluator memo): 2 lookups, 2 forward rows, no more
+    assert len(sem_gets) == 2
+    assert sum(len(b) for b in forwards) == 2
+    ret = [np.asarray(v) for v, d in got if d == -1]
+    ins = [np.asarray(v) for v, d in got if d == 1]
+    assert len(ret) == 1 and any(np.array_equal(ret[0], v) for v in ins)
+
+
+# ---------------------------------------------------------------------------
+# shed/backpressure contract preserved through the coalescer shim
+# ---------------------------------------------------------------------------
+
+
+def test_shim_sheds_with_honest_retry_after_when_service_backed_up():
+    from pathway_tpu.engine import telemetry
+
+    release = threading.Event()
+
+    class _GatedEncoder:
+        dim = 4
+
+        def encode_device(self, texts):
+            release.wait(timeout=10)
+            return np.zeros((len(texts), 4), dtype=np.float32)
+
+    pipe = EmbedPipeline(
+        _GatedEncoder(), model="shed", cache_size=0, max_queue_rows=2,
+        prewarm=False,
+    )
+    assert pipe.coalescer._service is pipe.service  # shim mode active
+    done: dict = {}
+
+    def client(name, texts):
+        done[name] = pipe.coalescer.embed(texts)
+
+    ta = threading.Thread(target=client, args=("a", ["a"]))
+    ta.start()
+    deadline = time.perf_counter() + 5.0
+    # row a is in flight (worker holds it inside encode_device)
+    while pipe.service.queue_depth_rows() != 1:
+        assert time.perf_counter() < deadline, "worker never picked up row a"
+        time.sleep(0.01)
+    tb = threading.Thread(target=client, args=("b", ["b"]))
+    tb.start()
+    while pipe.service.queue_depth_rows() != 2:
+        assert time.perf_counter() < deadline, "row b never queued"
+        time.sleep(0.01)
+
+    assert pipe.coalescer.overloaded()
+    shed_before = telemetry.stage_snapshot("embed.").get("embed.shed", 0.0)
+    with pytest.raises(EmbedOverloadError) as exc_info:
+        pipe.coalescer.embed(["c"])
+    assert exc_info.value.retry_after_s >= 1.0
+    assert pipe.coalescer.shed_requests == 1
+    assert telemetry.stage_snapshot("embed.").get("embed.shed", 0.0) == shed_before + 1
+    # the engine path (already admitted at the REST boundary) still bypasses
+    done["d"] = None
+    td = threading.Thread(
+        target=lambda: done.update(d=pipe.coalescer.embed(["d"], enforce_cap=False))
+    )
+    td.start()
+    release.set()
+    for t in (ta, tb, td):
+        t.join(timeout=10)
+    assert all(done[k] is not None for k in ("a", "b", "d"))
+    # queue drained: admission opens again, no sticky overload
+    assert not pipe.coalescer.overloaded()
+    assert len(pipe.coalescer.embed(["e"])) == 1
+    pipe.service.close()
+
+
+# ---------------------------------------------------------------------------
+# fence replay: service-queued in-flight queries, exactly once
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_fence_replay_service_inflight_queries_exactly_once():
+    """The PR-3 replay contract extended to the encoder service: a fence
+    aborts the commit AFTER service-queued queries were encoded but before
+    results committed; the replay with a fresh memo must answer every query
+    exactly once with identical values, absorbed by the content cache — the
+    service's forward must not run a second time, and the semantic cache must
+    not have answered any retraction."""
+    from pathway_tpu.engine.expression_evaluator import evaluate
+
+    emb = _tiny_embedder(embed_cache_size=64, encsvc_prewarm=False)
+    assert emb.pipeline.service is not None  # the service path is under test
+    forwards = []
+    orig = emb.encoder.encode_device
+    emb.encoder.encode_device = lambda t: (forwards.append(list(t)), orig(t))[1]
+
+    texts = np.array(
+        [f"inflight svc query {i}" for i in range(4)] + ["inflight svc query 0"],
+        dtype=object,
+    )
+    e = emb.device_expression(expr.ColumnReference(None, "q"))
+    keys = np.empty(len(texts), dtype=KEY_DTYPE)
+    for i in range(len(texts)):
+        p = pointer_from(f"row{i}")
+        keys[i] = (p.hi, p.lo)
+
+    def run_commit(memo: dict, diffs: np.ndarray) -> np.ndarray:
+        return evaluate(
+            e,
+            len(texts),
+            lambda ref: texts,
+            keys=keys,
+            diffs=diffs,
+            memo=memo,
+            memo_tokens={id(e): "nd0"},
+        )
+
+    ins = np.ones(len(texts), dtype=np.int64)
+    first = run_commit({}, ins)
+    n_rows_first = sum(len(b) for b in forwards)
+    assert n_rows_first == 4  # 5 rows, 1 duplicate deduped in the tick
+    assert emb.pipeline.service.ticks >= 1
+
+    _wait_cache_fill(emb.pipeline, 4, timeout=30.0)
+
+    # FENCE: evaluator state reset → lockstep replay with a FRESH memo
+    memo_after: dict = {}
+    replay = run_commit(memo_after, ins)
+    assert len(replay) == len(first) == len(texts)
+    for i in range(len(texts)):
+        assert np.array_equal(np.asarray(first[i]), np.asarray(replay[i])), i
+    # absorbed by the content cache: the service ran no new forward rows
+    assert sum(len(b) for b in forwards) == n_rows_first
+
+    # post-fence retraction: engine memo replay, no cache/service involvement
+    sem_before = emb.pipeline.semantic_cache.stats()
+    retr = run_commit(memo_after, -np.ones(len(texts), dtype=np.int64))
+    assert sum(len(b) for b in forwards) == n_rows_first
+    sem_after = emb.pipeline.semantic_cache.stats()
+    assert sem_after["semantic_exact_hits"] == sem_before["semantic_exact_hits"]
+    assert sem_after["semantic_cosine_hits"] == sem_before["semantic_cosine_hits"]
+    for i in range(len(texts)):
+        assert np.array_equal(np.asarray(retr[i]), np.asarray(replay[i]))
+    assert len(memo_after["nd0"]) == 0  # memo entries popped on retraction

@@ -176,6 +176,106 @@ def test_coalescer_slot_leak_bug_caught_and_replayable():
 
 
 # ---------------------------------------------------------------------------
+# encoder service admission / tick / shutdown
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.encsvc
+def test_encoder_service_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.encoder_service_model(3, cap=2, max_inflight=2),
+        max_schedules=N_SCHEDULES,
+        name="encsvc",
+    )
+    _BATTERY_SECONDS["encsvc"] = time.monotonic() - t0
+    assert result.ok, (
+        f"encoder-service invariant failed on schedule "
+        f"{result.failing_schedule}: {result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.encsvc
+def test_encoder_service_invariants_hold_seeded():
+    result = sweep_seeds(
+        pm.encoder_service_model(3, cap=2, max_inflight=2),
+        n_seeds=100,
+        base_seed=21,
+        name="encsvc-seeded",
+    )
+    assert result.ok, f"seed {result.failing_seed}: {result.failure}"
+    assert result.distinct_schedules == 100
+
+
+@pytest.mark.encsvc
+def test_encoder_service_error_path_releases_slots():
+    result = explore(
+        pm.encoder_service_model(3, cap=3, max_inflight=2, fail_batch=True),
+        max_schedules=N_SCHEDULES,
+        name="encsvc-err",
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+
+
+@pytest.mark.encsvc
+def test_encoder_service_inflight_leak_bug_caught_and_replayable():
+    result = explore(
+        pm.encoder_service_model(3, cap=3, max_inflight=2, fail_batch=True,
+                                 bug="leak_inflight"),
+        max_schedules=400,
+        name="encsvc-leak",
+    )
+    assert isinstance(result.failure, InvariantViolation)
+    assert "in-flight slots leaked" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="in-flight slots leaked"):
+        run_once(
+            pm.encoder_service_model(3, cap=3, max_inflight=2, fail_batch=True,
+                                     bug="leak_inflight"),
+            choices=result.failing_schedule,
+        )
+
+
+@pytest.mark.encsvc
+def test_encoder_service_drop_on_close_bug_caught_and_replayable():
+    # shutdown racing admitted requests: the no-drain worker strands them
+    result = sweep_seeds(
+        pm.encoder_service_model(3, cap=3, max_inflight=1, bug="drop_on_close"),
+        n_seeds=300,
+        base_seed=31,
+        name="encsvc-drop",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the shutdown-drop regression went undetected"
+    )
+    assert "dropped at shutdown" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="dropped at shutdown"):
+        run_once(
+            pm.encoder_service_model(3, cap=3, max_inflight=1, bug="drop_on_close"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.encsvc
+def test_encoder_service_lost_close_wakeup_deadlocks():
+    # a notify-less stop against the notify-driven idle wait = the lost-wakeup
+    # class (the real service's timed tick is the defense); proven a deadlock
+    result = explore(
+        pm.encoder_service_model(2, cap=2, max_inflight=2,
+                                 bug="lost_close_wakeup"),
+        max_schedules=400,
+        name="encsvc-lostwake",
+    )
+    assert isinstance(result.failure, DeadlockError), result.failure
+    with pytest.raises(DeadlockError):
+        run_once(
+            pm.encoder_service_model(2, cap=2, max_inflight=2,
+                                     bug="lost_close_wakeup"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
 # PWA101 <-> model check: the same inversion caught both ways
 # ---------------------------------------------------------------------------
 
@@ -232,7 +332,7 @@ def test_model_check_battery_within_budget():
     # the acceptance batteries above recorded their own wall time (no work is
     # redone here); each 200-schedule explore is a few seconds solo, and the
     # documented <60 s budget must hold even under full-suite load
-    if set(_BATTERY_SECONDS) != {"fence", "ckpt"}:
+    if set(_BATTERY_SECONDS) != {"fence", "ckpt", "encsvc"}:
         pytest.skip("acceptance batteries did not run in this session (-k selection)")
     total = sum(_BATTERY_SECONDS.values())
     assert total < 60, f"model-check acceptance batteries too slow: {_BATTERY_SECONDS}"

@@ -166,3 +166,101 @@ def test_coalescer_error_propagation_still_works():
     with pytest.raises(ValueError, match="encoder down"):
         co.embed(["x"])
     co.close()
+
+
+# ---------------------------------------------------------------------------
+# EncoderService worker hygiene: clean shutdown on service stop/close and on
+# pw.run teardown (the leaked-thread check for the service worker)
+# ---------------------------------------------------------------------------
+
+
+class _InstantEncoder:
+    dim = 4
+
+    def encode_device(self, texts):
+        return np.zeros((len(texts), 4), dtype=np.float32)
+
+
+def test_encoder_service_worker_stops_on_stop_and_close():
+    from pathway_tpu.models.encoder_service import EncoderService
+
+    svc = EncoderService(_InstantEncoder(), prewarm=False)
+    assert not svc.worker_alive()  # lazy spawn: no thread before first submit
+    out = svc.submit(["a", "b"])
+    assert len(out) == 2
+    assert svc.worker_alive()
+    svc.stop_worker()
+    assert not svc.worker_alive()
+    # stopped, not closed: the next submit respawns the worker and answers
+    assert len(svc.submit(["c"])) == 1
+    assert svc.worker_alive()
+    svc.close()
+    svc.close()  # idempotent
+    assert not svc.worker_alive()
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.submit(["d"])
+
+
+def test_encoder_service_stop_with_inflight_request_still_answers():
+    """stop_all_workers racing an admitted request must drain, not drop (the
+    drop_on_close bug class from the protocol model, checked on real threads)."""
+    from pathway_tpu.models.encoder_service import EncoderService
+
+    release = threading.Event()
+
+    class _GatedEncoder:
+        dim = 4
+
+        def encode_device(self, texts):
+            release.wait(timeout=10)
+            return np.zeros((len(texts), 4), dtype=np.float32)
+
+    svc = EncoderService(_GatedEncoder(), prewarm=False)
+    got = []
+    t = threading.Thread(target=lambda: got.append(svc.submit(["x"])))
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not svc.worker_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stopper = threading.Thread(target=svc.stop_worker)
+    stopper.start()
+    release.set()
+    t.join(timeout=10)
+    stopper.join(timeout=10)
+    assert got and len(got[0]) == 1, "admitted request dropped at stop"
+    assert not svc.worker_alive()
+    svc.close()
+
+
+def test_no_encoder_service_worker_after_pw_run():
+    """pw.run teardown stops the service worker (GraphRunner.finish →
+    stop_all_workers); the embedder stays usable — the worker respawns on the
+    next query."""
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    tiny = EncoderConfig(
+        vocab_size=8192, hidden_size=32, num_layers=1, num_heads=2,
+        intermediate_size=64,
+    )
+    emb = SentenceTransformerEmbedder(
+        model="pw-test-tiny", encoder_config=tiny, encoder_service=True,
+        encsvc_prewarm=False,
+    )
+    before = _non_daemon_threads()
+    t = pw.debug.table_from_rows(pw.schema_builder({"q": str}), [("hygiene query",)])
+    res = t.select(v=emb.device_expression(t.q))
+    got = []
+    pw.io.subscribe(res, lambda key, row, time, is_addition: got.append(row["v"]))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert len(got) == 1
+    _assert_no_leaks(before, "pw.run with encoder service")
+    svc = emb.pipeline.service
+    assert svc is not None
+    deadline = time.monotonic() + 5.0
+    while svc.worker_alive() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not svc.worker_alive(), "service worker leaked past pw.run teardown"
+    # still serviceable afterwards
+    assert len(emb.pipeline.embed_query_rows(["again"])) == 1
+    svc.close()
