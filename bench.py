@@ -2009,6 +2009,206 @@ def bench_rejoin() -> dict:
     return res
 
 
+_ELASTIC_PROG = """
+import json, os
+import pathway_tpu as pw
+
+tmp = os.environ["PW_BENCH_TMP"]
+pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+class WordSchema(pw.Schema):
+    word: str
+
+t = pw.io.fs.read(
+    os.path.join(tmp, "in"), format="csv", schema=WordSchema, mode="streaming"
+)
+counts = t.groupby(t.word).reduce(t.word, total=pw.reducers.count())
+
+out_path = os.path.join(tmp, f"out_{pid}.json")
+rows = {}
+def on_change(key, row, time, is_addition):
+    if is_addition:
+        rows[repr(key)] = {"word": row["word"], "total": int(row["total"])}
+    else:
+        rows.pop(repr(key), None)
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(list(rows.values()), f)
+    os.replace(out_path + ".tmp", out_path)
+
+pw.io.subscribe(counts, on_change)
+cfg = pw.persistence.Config(
+    pw.persistence.Backend.filesystem(os.path.join(tmp, "store"))
+)
+pw.run(persistence_config=cfg, monitoring_level=pw.MonitoringLevel.NONE)
+"""
+
+
+def bench_elastic() -> dict:
+    """Elastic-membership headline: one n=2 -> 4 -> 2 scale cycle under live
+    ingestion. Measures the reshard pause (per-rank transition duration, the
+    window the commit loop spends inside MEMBERSHIP_CHANGE), the ingest
+    throughput dip around the transitions, rows handed off per second, and
+    the manifest+tail honesty key: every joiner must catch up from the
+    membership manifest + handoff fragments with a near-empty journal tail
+    (never a full-history replay). CPU-only (localhost cluster) — honest on
+    any host; feed scales down on fallback like the other sections."""
+    import re
+    import shutil
+    import statistics
+    import tempfile
+
+    feed_total_s = 10.0 if DEVICE_SCALE_DOWN else 18.0
+    rows_per_file = 40 if DEVICE_SCALE_DOWN else 80
+    tmp = tempfile.mkdtemp(prefix="pw-bench-elastic-")
+    res: dict = {}
+    proc = None
+    try:
+        os.makedirs(os.path.join(tmp, "in"))
+        prog = os.path.join(tmp, "prog.py")
+        with open(prog, "w") as f:
+            f.write(_ELASTIC_PROG)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.abspath(__file__))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        env["PW_BENCH_TMP"] = tmp
+        env["PATHWAY_HEARTBEAT_INTERVAL_S"] = "0.2"
+        env["PATHWAY_BARRIER_TIMEOUT_S"] = "120"
+        env["PATHWAY_MEMBERSHIP_DEADLINE_S"] = "90"
+        env["PATHWAY_SCALE_PLAN"] = json.dumps(
+            [{"after_commit": 8, "n": 4}, {"after_commit": 30, "n": 2}]
+        )
+        _REJOIN_PORT_SALT[0] += 1
+        first_port = 29200 + (os.getpid() * 16 + _REJOIN_PORT_SALT[0] * 4) % 2600
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "pathway_tpu.cli", "spawn",
+                "-n", "2", "--first-port", str(first_port),
+                "--max-restarts", "2",
+                sys.executable, prog,
+            ],
+            env=env, cwd=tmp, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        )
+
+        def _total() -> int:
+            total = 0
+            for p in range(4):
+                try:
+                    with open(os.path.join(tmp, f"out_{p}.json")) as f:
+                        total += sum(r["total"] for r in json.load(f))
+                except (OSError, ValueError):
+                    pass
+            return total
+
+        # steady feed; sample delivered-output totals on a fixed clock so the
+        # transition windows show up as rate dips in the timeline
+        fed = 0
+        i = 0
+        samples: list = []  # (t, delivered_total)
+        deadline = time.monotonic() + feed_total_s
+        t0 = time.monotonic()
+        while time.monotonic() < deadline:
+            with open(os.path.join(tmp, "in", f"f{i:06d}.csv"), "w") as f:
+                f.write("word\n" + f"w{i % 23}\n" * rows_per_file)
+            fed += rows_per_file
+            i += 1
+            samples.append((time.monotonic() - t0, _total()))
+            time.sleep(0.05)
+        # convergence: everything fed is delivered exactly once
+        conv_deadline = time.monotonic() + 60
+        while time.monotonic() < conv_deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(f"spawn exited early rc={proc.returncode}")
+            if _total() == fed:
+                break
+            time.sleep(0.1)
+        if _total() != fed:
+            raise RuntimeError(f"no convergence: fed {fed}, got {_total()}")
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        _out, err = proc.communicate(timeout=30)
+        proc = None
+        # per-rank transition durations ("reshard pause": the commit loop's
+        # time inside MEMBERSHIP_CHANGE) + rows handed off
+        pauses = [
+            float(m)
+            for m in re.findall(
+                r"membership transition to n=\d+ complete .* in ([0-9.]+)s", err
+            )
+        ]
+        drains = [
+            float(m)
+            for m in re.findall(r"drained for scale-down .* in ([0-9.]+)s", err)
+        ]
+        handed = [
+            int(m) for m in re.findall(r"(\d+) row\(s\) handed off", err)
+        ]
+        tails = [
+            int(m)
+            for m in re.findall(
+                r"membership manifest \+ handoff fragments at commit \d+ "
+                r"\(\+(\d+) journal tail frame\(s\)\)",
+                err,
+            )
+        ]
+        if not pauses:
+            raise RuntimeError(f"no completed transitions in stderr:\n{err[-2000:]}")
+        all_pauses = pauses + drains
+        res["elastic_reshard_pause_p50_s"] = round(
+            statistics.median(all_pauses), 3
+        )
+        res["elastic_reshard_pause_max_s"] = round(max(all_pauses), 3)
+        res["elastic_rows_handed_off"] = int(sum(handed))
+        res["elastic_rows_handed_off_per_s"] = round(
+            sum(handed) / max(1e-9, sum(all_pauses)), 1
+        )
+        # throughput dip: delivered-rows/s in the worst 2 s window vs the
+        # overall steady rate (the transitions are the stalls)
+        rates: list = []
+        for a in range(len(samples)):
+            b = a
+            while b + 1 < len(samples) and samples[b + 1][0] - samples[a][0] < 2.0:
+                b += 1
+            if b > a:
+                dt = samples[b][0] - samples[a][0]
+                rates.append((samples[b][1] - samples[a][1]) / dt)
+        steady = statistics.median(rates) if rates else 0.0
+        worst = min(rates) if rates else 0.0
+        res["elastic_throughput_dip_pct"] = (
+            round(100.0 * (1.0 - worst / steady), 1) if steady > 0 else None
+        )
+        res["elastic_ingest_rows_per_s"] = round(steady, 1)
+        # honesty keys: both transitions completed, joiners caught up from
+        # manifest + fragments with a near-empty tail, and never a restart
+        res["elastic_transitions_complete"] = (
+            "membership change complete: cluster is n=4" in err
+            and "membership change complete: cluster is n=2" in err
+        )
+        res["elastic_join_tail_frames_max"] = max(tails) if tails else None
+        res["elastic_join_no_replay"] = bool(
+            tails
+            and max(tails) <= 2
+            and err.count("no journal replay") >= 2
+            and "restarting the cluster" not in err
+        )
+        res["elastic_exact"] = _total() == fed
+        return res
+    finally:
+        if proc is not None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.communicate()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 SUB_BENCHES: dict = {
     "knn": lambda: bench_knn(),
     "ivfscale": lambda: bench_ivf_scale(),
@@ -2024,6 +2224,7 @@ SUB_BENCHES: dict = {
     "sharded": lambda: bench_sharded(),
     "scale": lambda: bench_scale(),
     "rejoin": lambda: bench_rejoin(),
+    "elastic": lambda: bench_elastic(),
 }
 
 # sections whose numbers require the device; everything else is a CPU-vs-CPU
@@ -2039,12 +2240,14 @@ _DEADLINES_FULL = {
     "encsvc": 600, "window": 300,
     "engine": 600, "fusion": 600, "telemetry": 420, "vectorstore": 600,
     "vsfloor": 300, "sharded": 660, "scale": 1500, "rejoin": 420,
+    "elastic": 300,
 }
 _DEADLINES_SMALL = {
     "knn": 300, "ivfscale": 900, "embedder": 240, "embedpipe": 420,
     "encsvc": 420, "window": 300,
     "engine": 600, "fusion": 420, "telemetry": 420, "vectorstore": 300,
     "vsfloor": 300, "sharded": 660, "scale": 420, "rejoin": 300,
+    "elastic": 240,
 }
 
 

@@ -65,6 +65,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 RUNTIME_MODULES: Tuple[str, ...] = (
     "pathway_tpu/parallel/cluster.py",
     "pathway_tpu/parallel/supervisor.py",
+    "pathway_tpu/parallel/membership.py",
     "pathway_tpu/parallel/threads.py",
     "pathway_tpu/models/embed_pipeline.py",
     "pathway_tpu/models/encoder_service.py",

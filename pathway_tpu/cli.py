@@ -30,7 +30,7 @@ def _plural(n: int, singular: str, plural: str) -> str:
 
 def _spawn_program(
     *, threads, processes, first_port, program, arguments, env_base,
-    max_restarts=0, restart_mode="surgical",
+    max_restarts=0, restart_mode="surgical", scale=None, control_port=None,
 ):
     """Launch the cluster under the supervisor (``parallel/supervisor.py``):
     child exit codes and per-rank heartbeat status are monitored. On a worker
@@ -44,6 +44,11 @@ def _spawn_program(
     processes_str = _plural(processes, "process", "processes")
     workers_str = _plural(processes * threads, "total worker", "total workers")
     click.echo(f"Preparing {processes_str} ({workers_str})", err=True)
+    scale_plan = None
+    if scale:
+        # `--scale N`: an elastic membership change to N once the cluster has
+        # made its first commits (PATHWAY_SCALE_PLAN carries richer schedules)
+        scale_plan = [{"after_commit": 1, "n": scale}]
     supervisor = Supervisor(
         processes=processes,
         threads=threads,
@@ -53,6 +58,8 @@ def _spawn_program(
         env_base=env_base,
         max_restarts=max_restarts,
         restart_mode=restart_mode,
+        scale_plan=scale_plan,
+        control_port=control_port,
     )
     sys.exit(supervisor.run())
 
@@ -89,10 +96,31 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
     "the whole cluster when the rejoin itself fails, and finally to a loud "
     "teardown); 'all' always restarts the whole cluster",
 )
+@click.option(
+    "--scale",
+    type=int,
+    metavar="N",
+    default=None,
+    help="elastically resize the running cluster to N worker processes once "
+    "it is up: the supervisor issues an epoch-fenced MEMBERSHIP_CHANGE — the "
+    "workers quiesce at a commit boundary, reshard key ownership, hand off "
+    "state through the checkpoint store, and admit joiners / drain leavers "
+    "without stopping ingestion (requires persistence; interacts with "
+    "--max-restarts: a crash mid-transition recovers by restart-all at "
+    "whichever topology the membership manifest committed)",
+)
+@click.option(
+    "--control-port",
+    type=int,
+    metavar="PORT",
+    default=None,
+    help="supervisor control endpoint: `echo 'scale N' | nc 127.0.0.1 PORT` "
+    "resizes the live cluster (the autoscaler hook)",
+)
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
 def spawn(threads, processes, first_port, record, record_path, max_restarts,
-          restart_mode, program, arguments):
+          restart_mode, scale, control_port, program, arguments):
     env = os.environ.copy()
     if record:
         env["PATHWAY_REPLAY_STORAGE"] = record_path
@@ -107,6 +135,8 @@ def spawn(threads, processes, first_port, record, record_path, max_restarts,
         env_base=env,
         max_restarts=max_restarts,
         restart_mode=restart_mode.lower(),
+        scale=scale,
+        control_port=control_port,
     )
 
 

@@ -337,6 +337,25 @@ class StateTable:
             columns={name: self._columns[name][slots].copy() for name in self.column_names},
         )
 
+    def reshard_partition(self, owner_of: Any) -> "Dict[int, tuple]":
+        """Elastic membership handoff: partition the live rows by their new
+        owner rank. ``owner_of(keys) -> int64 owners``. Returns
+        ``{dest: (keys, diffs, columns)}`` — complete, disjoint partitions a
+        fresh table rebuilds from via ``apply``."""
+        snap = self.snapshot()
+        if len(snap) == 0:
+            return {}
+        owners = np.asarray(owner_of(snap.keys))
+        out: Dict[int, tuple] = {}
+        for dest in np.unique(owners):
+            sel = owners == dest
+            out[int(dest)] = (
+                snap.keys[sel],
+                snap.diffs[sel],
+                {name: col[sel] for name, col in snap.columns.items()},
+            )
+        return out
+
     def state_blob(self) -> bytes:
         """Compact picklable snapshot (live rows only) for operator checkpoints."""
         import pickle

@@ -186,6 +186,88 @@ class Evaluator:
         for name, blob in state.items():
             self.__dict__[name] = pickle.loads(blob)
 
+    # -- elastic membership handoff (parallel/membership.py) -----------------
+    #
+    # A membership change re-partitions key-owned state across the new
+    # topology. The base protocol covers the common cases generically:
+    # stateless evaluators export nothing, and the non-deterministic-apply
+    # replay memo (``_udf_memo``: token -> {row-key bytes -> value}) is keyed
+    # by row key, so it partitions exactly. Evaluators holding other keyed
+    # state implement their own export/import (GroupbyEvaluator, the
+    # key-presence family); evaluators whose state is NOT key-partitionable
+    # return a reason from ``reshard_check`` and the whole transition is
+    # refused loudly before anything mutates.
+
+    #: state-shaped instance attrs that are really graph config, rebuilt
+    #: identically from the (sig-checked) graph on every rank
+    RESHARD_CONFIG_ATTRS: tuple = ()
+
+    @staticmethod
+    def _reshard_empty(value: Any) -> bool:
+        if value is None or value is False:
+            return True
+        if isinstance(value, np.ndarray):
+            return value.size == 0
+        if isinstance(value, (dict, list, tuple, set, frozenset, str, bytes)):
+            return len(value) == 0
+        if isinstance(value, (int, float)) and value == 0:
+            return True
+        return False
+
+    def _reshard_state_attrs(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, value in self.__dict__.items():
+            if name in self._NON_STATE_ATTRS or name in self.RESHARD_CONFIG_ATTRS:
+                continue
+            if self._reshard_empty(value):
+                continue
+            out[name] = value
+        return out
+
+    def reshard_check(self) -> "str | None":
+        """None when this evaluator's live state can ride the membership
+        handoff; else a human-readable refusal reason."""
+        extra = [n for n in self._reshard_state_attrs() if n != "_udf_memo"]
+        if extra:
+            return (
+                f"{type(self).__name__} holds state ({', '.join(sorted(extra))}) "
+                "this build cannot re-partition by key"
+            )
+        return None
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        """Partition this evaluator's keyed state by new owner rank. The
+        export is COMPLETE (includes the keys this rank keeps): fragments
+        double as the new topology's checkpoint, so the importer always
+        starts from a fresh instance."""
+        reason = self.reshard_check()
+        if reason is not None:
+            # defense in depth: the preflight vote refuses such graphs before
+            # anything mutates — reaching here means the plan and the export
+            # disagree, which must fail loudly, never silently drop state
+            from pathway_tpu.parallel.membership import MembershipUnsupportedError
+
+            raise MembershipUnsupportedError(reason)
+        memo = self.__dict__.get("_udf_memo") or {}
+        if not memo:
+            return {}
+        from pathway_tpu.internals.keys import KEY_DTYPE
+
+        out: Dict[int, Any] = {}
+        for tok, store in memo.items():
+            for kb, val in store.items():
+                keys = np.frombuffer(kb, dtype=KEY_DTYPE)
+                dest = int(np.asarray(owner_of(keys))[0])
+                out.setdefault(dest, {}).setdefault("_udf_memo", {}).setdefault(
+                    tok, {}
+                )[kb] = _to_host(val)
+        return out
+
+    def reshard_import(self, payload: Any) -> None:
+        memo = self.__dict__.setdefault("_udf_memo", {})
+        for tok, store in (payload or {}).get("_udf_memo", {}).items():
+            memo.setdefault(tok, {}).update(store)
+
     # -- helpers ------------------------------------------------------------
 
     def _resolver_for(self, table: Any, delta: Delta) -> Callable[[expr.ColumnReference], np.ndarray]:
@@ -285,6 +367,9 @@ class RowwiseEvaluator(Evaluator):
     re-derives downstream rows when the referenced arrangement changes): when a
     referenced table emits a delta this commit, the affected rows of THIS table
     re-evaluate and re-emit even though the primary input saw no delta."""
+
+    # cross-ref node list is derived from the graph config, not run state
+    RESHARD_CONFIG_ATTRS = ("_cross_nodes",)
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
@@ -554,6 +639,85 @@ class GroupbyEvaluator(Evaluator):
                 "checkpoint was written by an incompatible (pre-columnar) build; "
                 "clear the persistence directory and re-run"
             )
+
+    # -- elastic membership handoff ------------------------------------------
+    #
+    # Group state is columnar keyed by the group key (= output row key), so
+    # the reshard is exactly an array redistribution: gather the moved
+    # groups' slots (counts, grouping values, last-emitted rows, every
+    # reducer leaf's accumulator columns), ship per new owner, scatter into
+    # freshly upserted slots on the importer.
+
+    def reshard_check(self) -> "str | None":
+        # columnar group state partitions exactly by group key — but the
+        # non-deterministic-UDF replay memo is keyed by INPUT row key while
+        # future retractions route by GROUP key, so a populated memo cannot
+        # be re-partitioned (the row->group mapping is not recoverable from
+        # the memo): refuse loudly rather than silently drop replay values
+        if self.__dict__.get("_udf_memo"):
+            return (
+                "GroupbyEvaluator holds non-deterministic-UDF replay state "
+                "(_udf_memo) that cannot be re-partitioned by group key"
+            )
+        return None
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        reason = self.reshard_check()
+        if reason is not None:
+            from pathway_tpu.parallel.membership import MembershipUnsupportedError
+
+            raise MembershipUnsupportedError(reason)
+        gkeys, slots = self.gindex.items()
+        if len(gkeys) == 0:
+            return {}
+        owners = np.asarray(owner_of(gkeys))
+        out: Dict[int, Any] = {}
+        for dest in np.unique(owners):
+            sel = owners == dest
+            dslots = slots[sel]
+            out[int(dest)] = {
+                "gkeys": gkeys[sel].copy(),
+                "counts": self.counts[dslots].copy(),
+                "last_valid": self.last_valid[dslots].copy(),
+                "gvals": {n: a[dslots].copy() for n, a in self.gvals.items()},
+                "last_cols": {
+                    n: a[dslots].copy() for n, a in self.last_cols.items()
+                },
+                "leaves": [st.reshard_take(dslots) for st in self.leaf_states],
+                "seq": int(self.seq),
+            }
+        return out
+
+    def reshard_import(self, payload: Any) -> None:
+        from pathway_tpu.engine.columnar import set_cells
+
+        gkeys = payload["gkeys"]
+        if len(gkeys) == 0:
+            return
+        slots, is_new = self.gindex.upsert(gkeys)
+        if not is_new.all():
+            raise RuntimeError(
+                "membership handoff fragment re-imported a group key that is "
+                "already present — fragments must be disjoint; the store is "
+                "inconsistent"
+            )
+        self._ensure_capacity()
+        self.gkeys[slots] = gkeys
+        self.counts[slots] = payload["counts"]
+        self.last_valid[slots] = payload["last_valid"]
+        for name in self.gvals:
+            self.gvals[name] = set_cells(
+                self.gvals[name], slots, payload["gvals"][name]
+            )
+        for name in self.last_cols:
+            self.last_cols[name] = set_cells(
+                self.last_cols[name], slots, payload["last_cols"][name]
+            )
+        for st, blob in zip(self.leaf_states, payload["leaves"]):
+            st.reshard_put(slots, blob)
+        # seq continues past every donor's counter: the sequence reducer's
+        # per-rank monotonicity survives the move
+        self.seq = max(self.seq, int(payload.get("seq", 0)))
 
     def _collect_reducers(self, out_exprs: Dict[str, expr.ColumnExpression]) -> None:
         seen: set[int] = set()
@@ -1454,6 +1618,44 @@ class _KeyPresenceMixin(Evaluator):
         super().__init__(node, runner)
         self.base = StateTable(self.output_columns)
         self.presence: List[set[bytes]] = [set() for _ in node.inputs[1:]]
+
+    # -- elastic membership handoff: base rows and presence sets are both
+    # keyed by the row key (every input is rowkey-exchanged), so they
+    # partition exactly
+
+    def reshard_check(self) -> "str | None":
+        return None
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        out: Dict[int, Any] = {}
+
+        def bucket(dest: int) -> dict:
+            return out.setdefault(
+                dest,
+                {"base": None, "presence": [set() for _ in self.presence]},
+            )
+
+        for dest, part in self.base.reshard_partition(owner_of).items():
+            bucket(dest)["base"] = part
+        for idx, pres in enumerate(self.presence):
+            for kb in pres:
+                keys = np.frombuffer(kb, dtype=KEY_DTYPE)
+                dest = int(np.asarray(owner_of(keys))[0])
+                bucket(dest)["presence"][idx].add(kb)
+        memo = Evaluator.reshard_export(self, owner_of, new_n)
+        for dest, payload in memo.items():
+            bucket(dest)["_udf_memo"] = payload["_udf_memo"]
+        return out
+
+    def reshard_import(self, payload: Any) -> None:
+        part = payload.get("base")
+        if part is not None:
+            keys, diffs, columns = part
+            self.base.apply(Delta(keys, diffs, columns))
+        for idx, pres in enumerate(payload.get("presence", ())):
+            if idx < len(self.presence):
+                self.presence[idx] |= set(pres)
+        Evaluator.reshard_import(self, payload)
 
     def _emit_row(self, kb: bytes, key: np.void, diff: int, row: dict, out: list) -> None:
         out.append((key, diff, row))

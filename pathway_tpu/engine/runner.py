@@ -92,6 +92,21 @@ class GraphRunner:
         self._undo_commit_ewma = 0.0
         self._undo_armed_commits = 0
         self._rewind_safe = True  # graph has no drain-sensitive operators
+        # elastic mesh membership (parallel/membership.py): grow/shrink the
+        # cluster under traffic via an epoch-fenced MEMBERSHIP_CHANGE
+        # transition at a quiesced commit boundary
+        self._membership_state = "stable"  # stable|joining|draining|resharding
+        self._target_workers: "int | None" = None
+        self._member_pending: Any = None  # agreed directive awaiting readiness
+        self._member_all_ready = False
+        self._member_done_gen = -1  # newest applied/refused/failed generation
+        self._member_refused: "tuple | None" = None  # (gen, reason)
+        self._member_committed_gen: "int | None" = None  # rank-0 manifest marker
+        self._member_attempts = 0  # transient-abort retries of the pending gen
+        self._member_in_flight = False  # transition running (no surgical rejoin)
+        self._membership_left = False  # this rank drained away (leaver)
+        self._member_join_gen: "int | None" = None  # joiner: generation joined
+        self._mismatch_workers: "int | None" = None  # store-vs-run worker count
 
     def state_of(self, node: pg.Node) -> StateTable:
         if node.id not in self._materialized:
@@ -429,14 +444,38 @@ class GraphRunner:
                 )
                 self._snapshot_interval_s = 0.0  # the single-process path stays off
                 checkpoint = None
-                manifest = self._persistence.load_cluster_manifest(sig)
+                joiner = _os.environ.get("PATHWAY_MEMBERSHIP_JOIN") == "1"
+                if joiner:
+                    # a grow-transition joiner: its catch-up basis is the
+                    # membership manifest + handoff fragments + journal tail
+                    # (never a full-history replay) — wait for the members to
+                    # commit it
+                    self._membership_state = "joining"
+                    self._target_workers = self._cluster.n
+                    manifest = self._await_membership_manifest(sig)
+                else:
+                    manifest = self._persistence.load_cluster_manifest(sig)
+                # (a joiner's manifest comes from _await_membership_manifest,
+                # which returns only membership manifests or raises typed —
+                # the never-committed case is reported there)
                 if manifest is not None:
                     base = int(manifest["commit_id"])
                     self._manifest_commit = base
-                    checkpoint = (
-                        base,
-                        self._persistence.load_cluster_snapshot(sig, base),
-                    )
+                    membership = manifest.get("membership")
+                    if joiner:
+                        self._member_join_info = membership
+                    if membership:
+                        # membership manifest: the per-rank "snapshot" is the
+                        # set of handoff fragments addressed to this rank
+                        frags = self._persistence.load_reshard_fragments(
+                            sig, base, self._rank, int(membership["from_n"])
+                        )
+                        checkpoint = (base, ("fragments", frags, membership))
+                    else:
+                        checkpoint = (
+                            base,
+                            self._persistence.load_cluster_snapshot(sig, base),
+                        )
                     ckpt_floor = base + 1
             else:
                 checkpoint = self._persistence.load_checkpoint(sig)
@@ -463,7 +502,37 @@ class GraphRunner:
             restore_frames = list(replay_frames)
             if checkpoint is not None:
                 base_commit, blob = checkpoint
-                self._load_checkpoint_state(blob)
+                if isinstance(blob, tuple) and blob[0] == "fragments":
+                    # membership-manifest restore: merge the handoff
+                    # fragments addressed to this rank (they are complete,
+                    # disjoint partitions — together they ARE this rank's
+                    # snapshot at the transition commit)
+                    from pathway_tpu.parallel.membership import (
+                        import_fragments,
+                        merge_fragment_sources,
+                    )
+
+                    _frags = blob[1]
+                    import_fragments(self, _frags)
+                    self._deliver_sink_snapshots()
+                    src_offsets, src_deltas = merge_fragment_sources(_frags)
+                    park = self._persistence.load_source_park(sig)
+                    if park:
+                        # a drained leaver's rank-local source continuation:
+                        # this joiner reuses its rank id and must not
+                        # re-ingest what the old incarnation contributed
+                        for nid, offs in park.get("offsets", {}).items():
+                            src_offsets.setdefault(int(nid), {}).update(offs)
+                    blob_sources = {
+                        "source_offsets": src_offsets,
+                        "source_deltas": src_deltas,
+                    }
+                else:
+                    self._load_checkpoint_state(blob)
+                    blob_sources = {
+                        "source_offsets": blob["source_offsets"],
+                        "source_deltas": blob["source_deltas"],
+                    }
                 self._commit = base_commit + 1
                 # frames ≤ the checkpointed commit are subsumed by it (compaction may
                 # have crashed before truncating the journal)
@@ -474,23 +543,28 @@ class GraphRunner:
                     # the bounded-recovery claim made observable: a replacement
                     # rank names its base manifest + the tail it still replays
                     logging.getLogger("pathway_tpu").warning(
-                        "rank %d: cold-starting from cluster checkpoint manifest "
+                        "rank %d: cold-starting from %s "
                         "at commit %d (+%d journal tail frame(s))",
-                        self._rank, base_commit, len(replay_frames),
+                        self._rank,
+                        "membership manifest + handoff fragments"
+                        if isinstance(blob, tuple)
+                        else "cluster checkpoint manifest",
+                        base_commit, len(replay_frames),
                     )
                 synthetic = (
                     base_commit,
                     {},
                     {
                         nid: {
-                            **blob["source_offsets"].get(nid, {}),
+                            **blob_sources["source_offsets"].get(nid, {}),
                             **(
-                                {"state_deltas": blob["source_deltas"][nid]}
-                                if blob["source_deltas"].get(nid)
+                                {"state_deltas": blob_sources["source_deltas"][nid]}
+                                if blob_sources["source_deltas"].get(nid)
                                 else {}
                             ),
                         }
-                        for nid in set(blob["source_offsets"]) | set(blob["source_deltas"])
+                        for nid in set(blob_sources["source_offsets"])
+                        | set(blob_sources["source_deltas"])
                     },
                 )
                 restore_frames = [synthetic, *replay_frames]
@@ -507,7 +581,27 @@ class GraphRunner:
         from pathway_tpu.internals.config import get_pathway_config
 
         if self._cluster is not None and self._persistence is not None:
-            self._cluster_replay(replay_frames, floor=ckpt_floor)
+            join_info = getattr(self, "_member_join_info", None)
+            if join_info is not None:
+                # joiner: no replay (the fragments ARE the state at the
+                # transition commit) — synchronize with the members' install
+                # barrier and enter the lockstep loop at commit C+1
+                gen = int(join_info.get("generation", 0))
+                self._cluster.allgather(f"member:install:{gen}".encode(), None)
+                self._membership_state = "stable"
+                self._member_done_gen = gen
+                self._target_workers = self._cluster.n
+                import logging
+
+                logging.getLogger("pathway_tpu").warning(
+                    "rank %d: joined the cluster at epoch %d (n=%d, "
+                    "generation %d) from the membership manifest — no "
+                    "journal replay",
+                    self._rank, getattr(self._cluster, "epoch", 0),
+                    self._cluster.n, gen,
+                )
+            else:
+                self._cluster_replay(replay_frames, floor=ckpt_floor)
         else:
             if replay_frames and get_pathway_config().persistence_mode == "batch":
                 # replay the whole recording as ONE commit (reference PersistenceMode::Batch)
@@ -620,13 +714,52 @@ class GraphRunner:
             evaluator = self.evaluators.get(nid)
             if evaluator is not None:
                 evaluator.load_state_dict(estate)
-        if self.replay_outputs:
-            for node in self._nodes:
-                evaluator = self.evaluators[node.id]
-                if isinstance(evaluator, OutputEvaluator):
-                    snapshot = self.states[node.inputs[0]._node.id].snapshot()
-                    if len(snapshot):
-                        evaluator.process([snapshot])
+        self._deliver_sink_snapshots()
+
+    def _deliver_sink_snapshots(self) -> None:
+        """Live sinks receive the restored/imported state as one snapshot
+        delivery (they cannot re-hear compacted history; after a membership
+        import this also hands a rank its newly-gained rows)."""
+        from pathway_tpu.engine.evaluators import OutputEvaluator
+
+        if not self.replay_outputs:
+            return
+        for node in self._nodes:
+            evaluator = self.evaluators[node.id]
+            if isinstance(evaluator, OutputEvaluator):
+                snapshot = self.states[node.inputs[0]._node.id].snapshot()
+                if len(snapshot):
+                    evaluator.process([snapshot])
+
+    def _await_membership_manifest(self, sig: str) -> dict:
+        """Joiner-side wait for the members to commit the membership
+        manifest (bounded by the fence timeout; a refused/aborted transition
+        leaves the joiner to die typed and the supervisor cleans up).
+        Worker-count mismatches against OLDER manifests are expected while
+        the transition is still in flight — keep polling."""
+        from pathway_tpu.internals.config import env_float as _env_float
+        from pathway_tpu.parallel.cluster import PeerTimeoutError
+        from pathway_tpu.parallel.membership import MembershipMismatchError
+
+        deadline = time_mod.monotonic() + _env_float(
+            "PATHWAY_MEMBERSHIP_DEADLINE_S",
+            _env_float("PATHWAY_FENCE_TIMEOUT_S", 180.0),
+        )
+        while True:
+            try:
+                manifest = self._persistence.load_cluster_manifest(sig)
+            except MembershipMismatchError:
+                manifest = None  # pre-transition manifest still newest
+            if manifest is not None and manifest.get("membership"):
+                return manifest
+            if time_mod.monotonic() > deadline:
+                raise PeerTimeoutError(
+                    f"joiner rank {self._rank}: no membership manifest "
+                    "appeared within the deadline — the transition aborted "
+                    "or never started"
+                )
+            self._publish_status(force=True)
+            time_mod.sleep(0.25)
 
     def _snapshot_blob(self) -> "tuple[dict | None, str]":
         """Build the full engine snapshot (operator + state-table + source
@@ -762,6 +895,10 @@ class GraphRunner:
         if self._ckpt_compact:
             tail_frames = self._persistence.compact_journal(self._graph_sig)
         self._persistence.cleanup_cluster_checkpoints(self._commit)
+        # a parked leaver source continuation (restored if this rank rejoined
+        # after a scale-down) is superseded once a durable snapshot carries
+        # the live offsets
+        self._persistence.clear_source_park()
         cluster.prune_commit_log(self._commit)
         self._manifest_commit = self._commit
         self._last_checkpoint = time_mod.monotonic()
@@ -949,18 +1086,24 @@ class GraphRunner:
             # coordinated-checkpoint marker RIDES this same barrier: barriers are
             # already lockstep, so every rank learns at the same commit id that a
             # checkpoint is due — aligned Chandy–Lamport for free.
+            member_vote = self._membership_vote() if self._inject is None else None
             want_ckpt = (
                 self._inject is None
                 and self._ckpt_interval_s > 0
                 and self._persistence is not None
                 and time_mod.monotonic() - self._last_checkpoint
                 >= self._ckpt_interval_s
+                # a pending membership change writes its OWN manifest at the
+                # transition commit; a racing checkpoint would be redundant
+                and self._member_pending is None
             )
             votes = self._cluster.allgather(
-                f"neu:{self._commit}".encode(), (neu, want_ckpt)
+                f"neu:{self._commit}".encode(), (neu, want_ckpt, member_vote)
             )
             neu = any(v[0] for v in votes)
             ckpt_due = any(v[1] for v in votes)
+            if self._inject is None:
+                self._membership_votes_seen([v[2] for v in votes])
         if neu:
             self.current_time = self._commit * 2 + 1
             any_output = self._substep(neu=True) or any_output
@@ -1054,6 +1197,10 @@ class GraphRunner:
             # helper thread) so staleness means the commit loop stopped turning
             self._publish_status()
         self._commit += 1
+        if self._member_all_ready and self._inject is None:
+            # every rank voted ready for the same generation at THIS commit:
+            # the cluster is quiesced — run the epoch-fenced transition
+            self._run_membership_transition()
         return any_output
 
     def _publish_status(self, force: bool = False) -> None:
@@ -1081,6 +1228,17 @@ class GraphRunner:
             last_rejoin_s=health["last_rejoin_s"],
             checkpoint_commit=health["checkpoint_commit"],
             journal_tail_frames=health["journal_tail_frames"],
+            extra={
+                k: health[k]
+                for k in (
+                    "membership_state",
+                    "current_workers",
+                    "target_workers",
+                    "membership_committed",
+                    "membership_refused",
+                    "manifest_workers",
+                )
+            },
         )
         self._last_status_write = now
 
@@ -1334,7 +1492,437 @@ class GraphRunner:
                 if self._persistence is not None
                 else None
             ),
+            # elastic-membership observability: where the topology is and
+            # where it is going (stable|joining|draining|resharding|drained)
+            "membership_state": self._membership_state,
+            "current_workers": (
+                getattr(self._cluster, "n", None)
+                if self._cluster is not None
+                else 1
+            ),
+            "target_workers": (
+                self._member_pending.target_n
+                if self._member_pending is not None
+                else self._target_workers
+            ),
+            "membership_committed": self._member_committed_gen,
+            "membership_refused": self._member_refused,
+            "manifest_workers": self._mismatch_workers,
         }
+
+    # -- elastic mesh membership (MEMBERSHIP_CHANGE; parallel/membership.py) ---
+
+    def _membership_vote(self) -> "tuple | None":
+        """Per-commit membership vote riding the neu allgather: the directive
+        this rank has seen (so peers that have not read the file yet learn it
+        FROM the vote) plus this rank's quiesce readiness."""
+        cluster = self._cluster
+        if (
+            cluster is None
+            or not getattr(cluster, "supports_rejoin", False)
+            or self._supervise_dir is None
+            or self._persistence is None
+            or not self._persistence.supports_cluster_checkpoints
+        ):
+            return None
+        now = time_mod.monotonic()
+        if now - getattr(self, "_member_poll_at", 0.0) >= 0.25:
+            self._member_poll_at = now
+            from pathway_tpu.parallel.membership import read_directive
+
+            d = read_directive(self._supervise_dir)
+            if (
+                d is not None
+                and d.generation > self._member_done_gen
+                and d.target_n != cluster.n
+                and (
+                    self._member_pending is None
+                    or d.generation > self._member_pending.generation
+                )
+            ):
+                self._member_pending = d
+                self._member_attempts = 0
+        if self._member_pending is None:
+            return None
+        return (self._member_pending.as_tuple(), self._membership_ready())
+
+    def _membership_ready(self) -> bool:
+        """Quiesce check: every reshardable live source paused at a scan
+        boundary with nothing buffered and no segment in flight. Rank-local
+        sources keep flowing — their rows stay where they are ingested."""
+        self._membership_state = (
+            "draining"
+            if self._member_pending is not None
+            and self._rank >= self._member_pending.target_n
+            else "resharding"
+        )
+        ready = True
+        for node, _ev in self._sources:
+            source = node.config["source"]
+            if source.is_finished():
+                continue
+            subject = getattr(source, "subject", None)
+            if getattr(subject, "reshard_exports", None) is None:
+                continue
+            subject.reshard_pause()
+            if not subject.reshard_idle(0.05):
+                ready = False
+                continue
+            if not source.reshard_ready():
+                ready = False
+        return ready
+
+    def _membership_unpause(self) -> None:
+        for node, _ev in self._sources:
+            subject = getattr(node.config["source"], "subject", None)
+            resume = getattr(subject, "reshard_resume", None)
+            if resume is not None:
+                resume()
+
+    def _membership_votes_seen(self, mvotes: "List[tuple | None]") -> None:
+        """Fold the allgathered membership votes: adopt the newest directive
+        and arm the transition when every rank is ready for the same
+        generation."""
+        from pathway_tpu.parallel.membership import MembershipDirective
+
+        self._member_all_ready = False
+        best: "tuple | None" = None
+        for mv in mvotes:
+            if mv is not None and (best is None or mv[0][0] > best[0]):
+                best = mv[0]
+        if best is None:
+            return
+        gen = int(best[0])
+        if gen > self._member_done_gen and (
+            self._member_pending is None
+            or self._member_pending.generation < gen
+        ):
+            self._member_pending = MembershipDirective.from_tuple(best)
+            self._member_attempts = 0
+        if (
+            self._member_pending is not None
+            and self._member_pending.generation == gen
+            and all(mv is not None and mv[0][0] == gen and mv[1] for mv in mvotes)
+        ):
+            self._member_all_ready = True
+
+    def _membership_abort(
+        self, directive: Any, reason: str, *, permanent: bool
+    ) -> None:
+        import logging
+
+        from pathway_tpu.engine import telemetry
+        from pathway_tpu.internals.config import env_float as _env_float
+
+        telemetry.stage_add("cluster.reshard_aborts")
+        log = logging.getLogger("pathway_tpu")
+        if permanent or self._member_attempts >= max(
+            1,
+            int(_env_float("PATHWAY_MEMBERSHIP_MAX_ATTEMPTS", 3)),
+        ):
+            log.error(
+                "rank %d: membership change to n=%d REFUSED (generation %d): %s",
+                self._rank, directive.target_n, directive.generation, reason,
+            )
+            self._member_refused = (directive.generation, reason)
+            self._member_done_gen = directive.generation
+            self._member_pending = None
+        else:
+            log.warning(
+                "rank %d: membership attempt %d to n=%d aborted (%s); will retry",
+                self._rank, self._member_attempts, directive.target_n, reason,
+            )
+        self._membership_state = "stable"
+        self._membership_unpause()
+        self._publish_status(force=True)
+
+    def _run_membership_transition(self) -> None:
+        """The MEMBERSHIP_CHANGE state machine at a fully quiesced commit
+        boundary (modeled first as ``membership_model`` in
+        ``internals/protocol_models.py`` — the phases and their order follow
+        the model exactly): preflight capability vote → handoff fragments
+        (read-back verified) → durability-ack barrier → rank 0 commits the
+        membership manifest (the atomic commit point) → journal compaction →
+        final old-topology barrier → leavers release / members rewire +
+        reset + import → install barrier with the joiners. A crash at ANY
+        point either aborts cleanly (pre-manifest: the previous topology
+        stands) or completes via restart-all at the new topology (the
+        supervisor adapts -n off the typed mismatch reports)."""
+        import logging
+
+        from pathway_tpu.engine import telemetry
+        from pathway_tpu.engine.profile import histogram
+        from pathway_tpu.parallel import membership as ms
+
+        directive = self._member_pending
+        self._member_all_ready = False
+        if directive is None:
+            return
+        cluster = self._cluster
+        log = logging.getLogger("pathway_tpu")
+        commit = self._commit - 1  # the just-completed, fully journaled commit
+        gen = directive.generation
+        old_n, new_n = cluster.n, directive.target_n
+        leaving = self._rank >= new_n
+        t0 = time_mod.monotonic()
+        self._member_attempts += 1
+        self._member_in_flight = True
+        self._membership_state = "draining" if leaving else "resharding"
+        telemetry.stage_add("cluster.reshard_attempts")
+        if self._recorder is not None:
+            self._recorder.record_event(
+                "membership",
+                phase="begin",
+                generation=gen,
+                from_n=old_n,
+                to_n=new_n,
+                commit=commit,
+                epoch=getattr(cluster, "epoch", 0),
+            )
+        self._publish_status(force=True)
+        if self._chaos is not None:
+            self._chaos.begin_scale_attempt()
+            # a donor/leaver killed after the quiesce vote, before its
+            # fragments are durable — the headline mid-handoff crash
+            self._chaos.maybe_scale_kill(
+                self._rank, "scale_drain_kill", generation=gen, commit=commit
+            )
+        try:
+            # 1. preflight capability vote: can every rank re-partition all
+            #    of its state? Any refusal aborts BEFORE anything mutates.
+            plan = ms.compute_reshard_plan(self)
+            refusals = list(plan.refusals)
+            refusals.extend(ms.preflight_sources(self, new_n, self._rank))
+            ok_votes = cluster.allgather(
+                f"member:ready:{gen}:{commit}".encode(),
+                refusals[0] if refusals else None,
+            )
+            bad = [r for r in ok_votes if r is not None]
+            if bad:
+                self._membership_abort(directive, bad[0], permanent=True)
+                return
+            # 2. handoff fragments: the reshard as an array redistribution —
+            #    every keyed state array gathered by shard_of(key, new_n)
+            #    and written per new owner, read-back verified
+            status = "ok"
+            stats: Dict[str, int] = {"rows_handed_off": 0}
+            frag_bytes = 0
+            try:
+                fragments, stats = ms.build_fragments(
+                    self, plan, new_n, commit, gen
+                )
+                frag_bytes = self._persistence.dump_reshard_fragments(
+                    self._graph_sig, commit, fragments
+                )
+            except (ConnectionError, OSError, ValueError) as exc:
+                status = f"transient: {exc}"
+            acks = cluster.allgather(f"member:ack:{gen}".encode(), status)
+            if any(a != "ok" for a in acks):
+                self._membership_abort(
+                    directive,
+                    next(a for a in acks if a != "ok"),
+                    permanent=False,
+                )
+                return
+            # 3. the atomic commit point: rank 0 commits the membership
+            #    manifest (workers = new_n), read-back verified
+            ok0 = True
+            if self._rank == 0:
+                ok0 = self._persistence.commit_membership_manifest(
+                    self._graph_sig,
+                    commit,
+                    epoch=directive.epoch,
+                    from_n=old_n,
+                    to_n=new_n,
+                    generation=gen,
+                )
+                if ok0:
+                    # supervisor-visible commit marker: a crash from here on
+                    # recovers at the NEW topology
+                    self._member_committed_gen = gen
+                    self._publish_status(force=True)
+            oks = cluster.allgather(f"member:done:{gen}".encode(), bool(ok0))
+            if not all(oks):
+                self._membership_abort(
+                    directive, "membership manifest commit failed (torn write)",
+                    permanent=False,
+                )
+                return
+            # 4. committed: adopt the new worker count for every later
+            #    journal header/snapshot/manifest, and compact this shard
+            #    (frames <= C are subsumed by the fragments; compaction is
+            #    FORCED — the manifest+tail handoff contract depends on it)
+            self._manifest_commit = commit
+            self._member_committed_gen = gen
+            self._persistence.set_workers(new_n)
+            self._persistence.compact_journal(self._graph_sig)
+            self._persistence.cleanup_cluster_checkpoints(commit)
+            # any previously restored park is superseded by the fragments
+            # (leavers write their NEW park after this point, at release)
+            self._persistence.clear_source_park()
+            cluster.prune_commit_log(commit)
+            self._undo_current = None
+            self._last_checkpoint = time_mod.monotonic()
+            # 5. final old-topology barrier: nobody tears down or rewires
+            #    until every old rank is past the commit point
+            cluster.allgather(f"member:cut:{gen}".encode(), None)
+            rows_out = int(stats.get("rows_handed_off", 0))
+            telemetry.stage_add_many({
+                "cluster.reshard_rows_handed_off": float(rows_out),
+                "cluster.reshard_fragment_bytes": float(frag_bytes),
+            })
+            if leaving:
+                # 6L. leaver release: fragments durable + manifest committed
+                #     (the model's release-after-drain invariant). Park the
+                #     rank-local source continuation for a future joiner
+                #     reusing this rank id, retract delivered rows from the
+                #     live sinks, and leave the mesh.
+                park = {
+                    nid: {
+                        k: v
+                        for k, v in offs.items()
+                        if k != "state_deltas"
+                    }
+                    for nid, offs in (
+                        (node.id, node.config["source"].offset_state())
+                        for node, _ev in self._sources
+                    )
+                }
+                self._persistence.dump_source_park(
+                    self._graph_sig, commit, {"offsets": park}
+                )
+                self._deliver_sink_retractions()
+                self._membership_state = "drained"
+                self._membership_left = True
+                self._publish_status(force=True)
+                cluster.leave_membership()
+                duration = time_mod.monotonic() - t0
+                telemetry.stage_add("cluster.reshard_drained")
+                log.warning(
+                    "rank %d: drained for scale-down to n=%d (generation %d) "
+                    "in %.2fs — %d row(s) handed off",
+                    self._rank, new_n, gen, duration, rows_out,
+                )
+                if self._recorder is not None:
+                    self._recorder.record_event(
+                        "membership", phase="drained", generation=gen,
+                        to_n=new_n, duration_s=round(duration, 3),
+                    )
+                return
+            # 6S. survivor: retract EVERYTHING previously delivered while the
+            #     old state is still present — step 9 re-delivers the full
+            #     imported snapshot, so sinks see one clean retract/re-add
+            #     cycle (diff-folding consumers net exactly; retracting only
+            #     the moved rows would double-deliver the kept ones)
+            self._deliver_sink_retractions()
+            # 7. rewire the mesh: install joiner links / cut leaver links,
+            #    adopt the new epoch (stale frames purge; future-epoch frames
+            #    from faster members deliver — the model's install step)
+            cluster.apply_membership(
+                new_n,
+                directive.epoch,
+                on_wait=lambda: self._publish_status(force=True),
+            )
+            # 8. flip the process-wide topology: connectors and late
+            #    PersistenceManager readers see the new count
+            os.environ["PATHWAY_PROCESSES"] = str(new_n)
+            # 9. sources adopt the new shard map (moved scan state dropped
+            #    WITHOUT retractions, gained scan state absorbed), then
+            #    evaluator/state-table state resets and re-imports this
+            #    rank's fragments — the live path and the crash-recovery
+            #    path share one loader
+            my_frags = self._persistence.load_reshard_fragments(
+                self._graph_sig, commit, self._rank, old_n
+            )
+            _offs, gained = ms.merge_fragment_sources(my_frags)
+            for node, _ev in self._sources:
+                source = node.config["source"]
+                subject = getattr(source, "subject", None)
+                if getattr(subject, "reshard_apply", None) is not None:
+                    subject.reshard_apply(new_n, self._rank)
+                    source.reshard_scrub(new_n, self._rank)
+                deltas = gained.get(node.id)
+                if deltas:
+                    source.reshard_absorb(deltas)
+            self._reset_operator_state()
+            ms.import_fragments(self, my_frags)
+            self._deliver_sink_snapshots()
+            self._membership_unpause()
+            # 10. install barrier with the joiners (their setup blocks on it)
+            cluster.allgather(f"member:install:{gen}".encode(), None)
+            self._commit = commit + 1
+            self._member_done_gen = gen
+            self._member_pending = None
+            self._membership_state = "stable"
+            self._target_workers = new_n
+            # loop realignment: this transition ran INSIDE step(C); a joiner's
+            # first action is a full step(C+1), so this member must go
+            # straight to step(C+1) too — the run loop skips its done-vote
+            # for this iteration
+            self._member_resumed = True
+            duration = time_mod.monotonic() - t0
+            histogram("pathway_reshard_duration_seconds").observe(duration)
+            telemetry.stage_add("cluster.reshard_applied")
+            if self._recorder is not None:
+                self._recorder.record_event(
+                    "membership",
+                    phase="applied",
+                    generation=gen,
+                    from_n=old_n,
+                    to_n=new_n,
+                    epoch=getattr(cluster, "epoch", 0),
+                    duration_s=round(duration, 3),
+                    rows_handed_off=rows_out,
+                )
+            log.warning(
+                "rank %d: membership transition to n=%d complete (generation "
+                "%d, epoch %d) in %.2fs — %d row(s) handed off, %d fragment "
+                "byte(s)",
+                self._rank, new_n, gen, getattr(cluster, "epoch", 0),
+                duration, rows_out, frag_bytes,
+            )
+            self._publish_status(force=True)
+        finally:
+            import sys as _sys
+
+            if _sys.exc_info()[0] is None:
+                self._member_in_flight = False
+            else:
+                # an exception is unwinding: LEAVE the in-flight flag set so
+                # _surgical_rejoin declines (a mid-transition peer death must
+                # reach the supervisor typed — it restarts all at whichever
+                # topology committed), and leave a visible trace first
+                self._publish_status(force=True)
+
+    def _deliver_sink_retractions(self) -> None:
+        """Feed each live sink a retraction of EVERY row it was delivered
+        (its input's full pre-transition state). Paired with the
+        post-import snapshot delivery this gives sinks one clean
+        retract/re-add cycle across the reshard: diff-folding consumers net
+        exactly, rows that moved re-appear at their new owner, and rows
+        that stayed are re-asserted — the same contract restored
+        checkpoints already give sinks."""
+        from pathway_tpu.engine.evaluators import OutputEvaluator
+
+        if not self.replay_outputs:
+            return
+        for node in self._nodes:
+            evaluator = self.evaluators.get(node.id)
+            if not isinstance(evaluator, OutputEvaluator):
+                continue
+            inp = node.inputs[0]._node
+            state = self.states.get(inp.id)
+            if state is None or inp.id not in self._materialized:
+                continue
+            snap = state.snapshot()
+            if not len(snap):
+                continue
+            retraction = Delta(
+                snap.keys,
+                -np.ones(len(snap), dtype=np.int64),
+                dict(snap.columns),
+            )
+            evaluator.process([retraction])
 
     # -- surgical single-rank restart (epoch fence; parallel/cluster.py) -------
 
@@ -1362,6 +1950,11 @@ class GraphRunner:
             or self._supervise_dir is None
             or self._persistence is None
             or self._inject is not None
+            # a peer death INSIDE a membership transition cannot be healed by
+            # a single-rank rejoin (the topology itself is in flight): die
+            # typed, the supervisor restarts all at whichever topology the
+            # membership manifest committed
+            or self._member_in_flight
         ):
             return False
         import logging
@@ -1507,9 +2100,25 @@ class GraphRunner:
             self._undo_current = None
             self._reset_operator_state()
             if base is not None:
-                self._load_checkpoint_state(
-                    self._persistence.load_cluster_snapshot(self._graph_sig, base)
-                )
+                if manifest.get("membership"):
+                    # the newest checkpoint is a membership manifest: this
+                    # rank's snapshot is its handoff-fragment set
+                    from pathway_tpu.parallel.membership import import_fragments
+
+                    import_fragments(
+                        self,
+                        self._persistence.load_reshard_fragments(
+                            self._graph_sig, base, self._rank,
+                            int(manifest["membership"]["from_n"]),
+                        ),
+                    )
+                    self._deliver_sink_snapshots()
+                else:
+                    self._load_checkpoint_state(
+                        self._persistence.load_cluster_snapshot(
+                            self._graph_sig, base
+                        )
+                    )
                 self._commit = base + 1
             was_ready, self._ready = self._ready, False  # replay parity with setup
             try:
@@ -1924,7 +2533,15 @@ class GraphRunner:
             if not self._ready:
                 with span("graph_runner.build", nodes=len(self.graph.nodes)):
                     self.setup(monitoring_level, persistence_config=persistence_config)
-        except BaseException:
+        except BaseException as exc:
+            from pathway_tpu.parallel.membership import MembershipMismatchError
+
+            if isinstance(exc, MembershipMismatchError):
+                # the store committed a membership transition this launch does
+                # not match: publish manifest_n so the supervisor adapts -n
+                self._mismatch_workers = exc.manifest_n
+                self._membership_state = "membership_mismatch"
+                self._publish_status(force=True)
             # a failed build must not leak the just-bound monitoring listener:
             # the caller may fix the config and rerun in this same process
             if self._http_server is not None:
@@ -2003,7 +2620,20 @@ class GraphRunner:
                         if self._surgical_rejoin(exc):
                             continue
                         raise
+                    if self._membership_left:
+                        # this rank drained away in a scale-down: its handoff
+                        # is durable, its journal shard compacted empty — a
+                        # clean exit the supervisor expects
+                        break
                     commits += 1
+                    if getattr(self, "_member_resumed", False):
+                        # a membership transition completed inside that step:
+                        # joiners enter the lockstep loop with a full step at
+                        # C+1, so skip this iteration's done-vote and step
+                        # again immediately — every member's barrier tag
+                        # sequence realigns at commit C+1
+                        self._member_resumed = False
+                        continue
                     if max_commits is not None and commits >= max_commits:
                         break
                     if (
@@ -2044,7 +2674,15 @@ class GraphRunner:
             # that hand state to OTHER graphs (ExportedTable._fail) — finish()
             # in the finally block fires their on_end either way
             from pathway_tpu.engine.evaluators import OutputEvaluator
+            from pathway_tpu.parallel.membership import MembershipMismatchError
 
+            if isinstance(exc, MembershipMismatchError):
+                # report the store's worker count through the status file so
+                # the supervisor can ADAPT -n (a membership transition
+                # committed before a crash) instead of tearing down
+                self._mismatch_workers = exc.manifest_n
+                self._membership_state = "membership_mismatch"
+                self._publish_status(force=True)
             if self._recorder is not None:
                 self._recorder.dump(f"crash: {type(exc).__name__}")
             for evaluator in self.evaluators.values():

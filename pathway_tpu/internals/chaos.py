@@ -33,6 +33,7 @@ Environment contract::
          "rejoin": [{"rank": 0, "run": 1}],
          "backend": {"put_error_prob": 0.5, "max_errors": 4},
          "checkpoint": [{"op": "post_snapshot_kill", "rank": 0, "run": 0, "at": 1}],
+         "scale": [{"op": "scale_join_kill", "rank": 2, "run": 0, "at": 0}],
          "sched": {"seed": 7}}
 
 ``sched`` pins the deterministic model-check scheduler's seed
@@ -103,12 +104,18 @@ class Chaos:
         self._checkpoint: List[Dict[str, Any]] = [
             dict(e) for e in (plan.get("checkpoint") or [])
         ]
+        self._scale: List[Dict[str, Any]] = [
+            dict(e) for e in (plan.get("scale") or [])
+        ]
         self._streams: Dict[str, random.Random] = {}
         self._backend_errors_left = int(self._backend.get("max_errors", 3))
         # coordinated-checkpoint attempt counter: bumped by the runner at the
         # START of every attempt, so `at` in a checkpoint entry deterministically
         # names the Nth attempt of this process incarnation (0-based)
         self.checkpoint_attempt = -1
+        # elastic-membership attempt counter, same discipline: `at` in a
+        # scale entry names the Nth transition attempt of this incarnation
+        self.scale_attempt = -1
         # observability for tests: what actually fired
         self.stats: Dict[str, int] = {
             "kills": 0,
@@ -118,6 +125,7 @@ class Chaos:
             "rejoins_dropped": 0,
             "backend_errors": 0,
             "checkpoint_faults": 0,
+            "scale_faults": 0,
         }
 
     # -- streams -------------------------------------------------------------
@@ -214,6 +222,71 @@ class Chaos:
                 attempt=self.checkpoint_attempt,
             )
             recorder.dump("chaos_checkpoint_kill")
+        except Exception:
+            pass  # the kill must fire regardless
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- elastic-membership faults ---------------------------------------------
+
+    def begin_scale_attempt(self) -> int:
+        """Called by the runner at the start of every membership-transition
+        attempt; returns the 0-based attempt index ``at`` gates on."""
+        self.scale_attempt += 1
+        return self.scale_attempt
+
+    def scale_fault(self, op: str, rank: int) -> bool:
+        """True when the plan schedules membership fault ``op`` for this rank
+        at the CURRENT scale attempt (and restart count). Ops:
+
+        - ``scale_join_kill``   — SIGKILL a joiner before it installs;
+        - ``scale_drain_kill``  — SIGKILL a donor/leaver mid-handoff (after
+          the quiesce vote, before its fragments are acked durable);
+        - ``handoff_torn``     — tear a handoff-fragment write (the read-back
+          verification must fail the attempt's ack barrier, previous state
+          stands, the transition retries);
+        - ``dropped_scale_handshake`` — drop a joiner's membership hello so
+          its wiring fails typed and the supervisor escalates.
+
+        ``at`` defaults to every attempt; ``run`` defaults to every
+        incarnation (joiner relaunches bump PATHWAY_RESTART_COUNT, the
+        cross-attempt key — same contract as ``rejoin`` entries). Joiner-side
+        ops fire in a fresh process where ``begin_scale_attempt`` never ran:
+        that counts as attempt 0, so ``at: 0`` gates them too."""
+        current_attempt = max(0, self.scale_attempt)
+        for entry in self._scale:
+            if entry.get("op") != op:
+                continue
+            if int(entry.get("rank", -1)) != rank:
+                continue
+            want_run = entry.get("run")
+            if want_run is not None and int(want_run) != self.run_count:
+                continue
+            want_at = entry.get("at")
+            if want_at is not None and int(want_at) != current_attempt:
+                continue
+            self.stats["scale_faults"] += 1
+            self._record_injection(
+                f"chaos_{op}", rank=rank, attempt=self.scale_attempt,
+                run=self.run_count,
+            )
+            return True
+        return False
+
+    def maybe_scale_kill(self, rank: int, op: str, **details: Any) -> None:
+        """SIGKILL this rank when a membership fault entry matches (the
+        ``scale_join_kill`` / ``scale_drain_kill`` ops)."""
+        if not self.scale_fault(op, rank):
+            return
+        self.stats["kills"] += 1
+        try:
+            from pathway_tpu.engine.profile import get_flight_recorder
+
+            recorder = get_flight_recorder()
+            recorder.record_event(
+                f"chaos_{op}_kill", rank=rank, attempt=self.scale_attempt,
+                **details,
+            )
+            recorder.dump(f"chaos_{op}")
         except Exception:
             pass  # the kill must fire regardless
         os.kill(os.getpid(), signal.SIGKILL)

@@ -598,6 +598,370 @@ def encoder_service_model(
 
 
 # ---------------------------------------------------------------------------
+# elastic membership change: quiesce -> handoff -> manifest -> install
+# ---------------------------------------------------------------------------
+
+
+class _ModelMember:
+    """One cluster member in the membership-change model: an epoch-checked
+    mailbox (stale frames dropped, future frames parked — the
+    ``ClusterExchange._reader`` discipline) plus a slot-ownership map that
+    must only change at install time."""
+
+    def __init__(self, sched: DeterministicScheduler, rank: int, owned: "set[int]"):
+        self.rank = rank
+        self.cv = sched.condition(name=f"m{rank}.cv")
+        self.epoch = 0
+        self.owned = set(owned)  # slots this member serves rows for
+        self.tokens: Dict[int, "set[str]"] = {}  # slot -> row tokens held here
+        self.inbox: List[tuple] = []  # (frame_epoch, slot, token)
+        self.parked: List[tuple] = []  # future-epoch frames
+        self.delivered: List[tuple] = []  # (frame_epoch, epoch_at_delivery, slot)
+        self.bad_rows: List[tuple] = []  # rows delivered for a slot not owned
+        self.stale_dropped = 0
+        self.released = False  # leaver gave up its process
+
+    def on_frame(self, frame_epoch: int, slot: int, token: str) -> None:
+        with self.cv:
+            if frame_epoch < self.epoch:
+                self.stale_dropped += 1
+                return
+            if frame_epoch > self.epoch:
+                self.parked.append((frame_epoch, slot, token))
+                self.cv.notify_all()
+                return
+            self.inbox.append((frame_epoch, slot, token))
+            self.cv.notify_all()
+
+
+def membership_model(
+    old_n: int = 2,
+    new_n: int = 3,
+    *,
+    n_slots: int = 6,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The epoch-fenced elastic membership transition (``MEMBERSHIP_CHANGE``):
+    ``old_n`` live members quiesce at a commit boundary, partition their
+    per-slot state into handoff fragments addressed by the NEW ownership map
+    (slot -> rank = slot % new_n), ack durability, rank 0 commits the single
+    membership manifest (check-and-write under one lock), and only then does
+    every member of the new topology install — adopting the new epoch, the
+    new ownership map, and the imported fragments atomically — while leavers
+    release only after their fragments are durable and the manifest
+    committed. Joiners import their fragments and join post-install traffic;
+    every member then routes one row per moved slot to its owner under the
+    new map (epoch-stamped frames park at not-yet-installed receivers, the
+    real mesh's future-epoch discipline).
+
+    Invariants over every interleaving: every slot owned by exactly one live
+    member at the final epoch (and by the mapped owner); the row-token set is
+    preserved across the handoff (no row lost or duplicated) and resides with
+    the slot's owner; no stale-epoch delivery and no row delivered to a
+    non-owner; leavers fully drained (fragments durable) before release; no
+    deadlock.
+
+    Planted bugs (each must be CAUGHT with a replayable schedule):
+    ``"double_owner"`` — a donor keeps serving slots it handed off (two
+    owners at the new epoch, rows duplicated); ``"orphan_range"`` — one moved
+    slot's fragment is dropped (a key range with no surviving rows);
+    ``"release_before_drain"`` — a leaver releases before writing its
+    fragments (its rows are lost); ``"epoch_before_install"`` — the epoch is
+    bumped and traffic resumes before the ownership map installs, so rows
+    route to ranks that no longer own the slot."""
+
+    grow = new_n >= old_n
+    members_after = list(range(new_n))
+    joiners = list(range(old_n, new_n)) if grow else []
+    leavers = list(range(new_n, old_n)) if not grow else []
+    new_epoch = 1
+
+    def old_owner(slot: int) -> int:
+        return slot % old_n
+
+    def new_owner(slot: int) -> int:
+        return slot % new_n
+
+    moved = {s for s in range(n_slots) if new_owner(s) != old_owner(s)}
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("store")
+        cv = sched.condition(lock, name="store.cv")
+        store: Dict[str, Any] = {
+            "ready": set(),
+            "fragments": {},  # (donor, dest) -> {slot: tokens}; durable once written
+            "acks": set(),
+            "manifests": [],
+            "misrouted": [],  # rows routed to a released leaver (lost)
+            "traffic_done": 0,  # new-topology members done sending
+        }
+        init_owned = {
+            m: {s for s in range(n_slots) if old_owner(s) == m}
+            for m in range(old_n)
+        }
+        members: Dict[int, _ModelMember] = {
+            m: _ModelMember(sched, m, init_owned[m]) for m in range(old_n)
+        }
+        for j in joiners:
+            members[j] = _ModelMember(sched, j, set())
+        for m in range(old_n):
+            for s in init_owned[m]:
+                members[m].tokens[s] = {f"row{s}a", f"row{s}b"}
+
+        def notify_everyone() -> None:
+            for mm in members.values():
+                with mm.cv:
+                    mm.cv.notify_all()
+
+        def write_fragments(m: int) -> None:
+            me = members[m]
+            skipped = False
+            with cv:
+                for slot in sorted(me.owned):
+                    dest = new_owner(slot)
+                    if dest == m:
+                        continue  # kept slots stay in place
+                    if bug == "orphan_range" and m == 0 and slot in moved and not skipped:
+                        skipped = True  # this key range's fragment never lands
+                        continue
+                    frag = store["fragments"].setdefault((m, dest), {})
+                    frag[slot] = set(me.tokens.get(slot, set()))
+                cv.notify_all()
+
+        def install(m: int) -> None:
+            """Adopt epoch + ownership map + imported fragments atomically
+            (purging parked future frames into the live inbox)."""
+            me = members[m]
+            target = {s for s in range(n_slots) if new_owner(s) == m}
+            with cv:
+                imports = {
+                    slot: set(toks)
+                    for (donor, dest), frag in store["fragments"].items()
+                    if dest == m
+                    for slot, toks in frag.items()
+                }
+            with me.cv:
+                me.epoch = new_epoch
+                if bug == "epoch_before_install" and m == 0:
+                    # the planted regression: the epoch (and traffic) move
+                    # while the ownership map still reflects the OLD topology
+                    pass
+                elif bug == "double_owner" and m == 0:
+                    me.owned = me.owned | target  # never releases donated slots
+                    for slot, toks in imports.items():
+                        me.tokens.setdefault(slot, set()).update(toks)
+                else:
+                    for slot in list(me.owned - target):
+                        me.owned.discard(slot)
+                        me.tokens.pop(slot, None)
+                    me.owned = set(target)
+                    for slot, toks in imports.items():
+                        me.tokens.setdefault(slot, set()).update(toks)
+                keep = [(e, s, t) for (e, s, t) in me.parked if e == new_epoch]
+                me.stale_dropped += len(me.parked) - len(keep)
+                me.inbox.extend(keep)
+                me.parked = []
+                me.cv.notify_all()
+
+        def late_map_fix(m: int) -> None:
+            """epoch_before_install only: the map catches up after traffic
+            already ran at the new epoch."""
+            me = members[m]
+            target = {s for s in range(n_slots) if new_owner(s) == m}
+            with cv:
+                imports = {
+                    slot: set(toks)
+                    for (donor, dest), frag in store["fragments"].items()
+                    if dest == m
+                    for slot, toks in frag.items()
+                }
+            with me.cv:
+                for slot in list(me.owned - target):
+                    me.owned.discard(slot)
+                    me.tokens.pop(slot, None)
+                me.owned = set(target)
+                for slot, toks in imports.items():
+                    me.tokens.setdefault(slot, set()).update(toks)
+                me.cv.notify_all()
+
+        def traffic(m: int) -> None:
+            """Post-install: route one row per moved slot to its owner under
+            MY current map, stamped with MY epoch."""
+            me = members[m]
+            with me.cv:
+                epoch = me.epoch
+                stale_map = (
+                    bug == "epoch_before_install" and m == 0
+                    and me.owned == init_owned.get(0, set())
+                )
+            for slot in sorted(moved):
+                dest = old_owner(slot) if stale_map else new_owner(slot)
+                if dest == m:
+                    continue
+                target = members[dest]
+                if target.released:
+                    with cv:
+                        store["misrouted"].append((slot, dest))
+                        cv.notify_all()
+                    continue
+                target.on_frame(epoch, slot, f"routed{slot}from{m}")
+            with cv:
+                store["traffic_done"] += 1
+                cv.notify_all()
+            notify_everyone()
+
+        def drain(m: int) -> None:
+            """Deliver inbox rows until every new member finished sending and
+            nothing is left queued here."""
+            me = members[m]
+            while True:
+                with me.cv:
+                    while me.inbox:
+                        frame_epoch, slot, token = me.inbox.pop(0)
+                        me.delivered.append((frame_epoch, me.epoch, slot))
+                        if slot not in me.owned:
+                            me.bad_rows.append((slot, token))
+                        else:
+                            me.tokens.setdefault(slot, set()).add(token)
+                    with cv:
+                        done = store["traffic_done"] >= len(members_after)
+                    if done and not me.inbox:
+                        return
+                    me.cv.wait()
+
+        def old_member_body(m: int) -> None:
+            me = members[m]
+            # 1. quiesce: every old member votes ready at the commit boundary
+            with cv:
+                store["ready"].add(m)
+                cv.notify_all()
+                while len(store["ready"]) < old_n:
+                    cv.wait()
+            # 2. handoff fragments (per-slot state partitioned by NEW owner)
+            if bug == "release_before_drain" and m in leavers:
+                # the planted regression: the leaver tears down before its
+                # fragments are durable — its slots' rows are simply gone
+                # (it still acks, hiding the loss until the check)
+                with me.cv:
+                    me.released = True
+                    me.owned.clear()
+                    me.tokens.clear()
+                with cv:
+                    store["acks"].add(m)
+                    cv.notify_all()
+                return
+            write_fragments(m)
+            sched.yield_point("fragments-durable")
+            # 3. durability-ack barrier
+            with cv:
+                store["acks"].add(m)
+                cv.notify_all()
+                while len(store["acks"]) < old_n:
+                    cv.wait()
+            # 4. rank 0 commits the single membership manifest (check-and-
+            #    write under one lock; at-most-one by construction)
+            if m == 0:
+                with lock:
+                    if not any(x[0] == "member" for x in store["manifests"]):
+                        store["manifests"].append(("member", old_n, new_n))
+                with cv:
+                    cv.notify_all()
+            with cv:
+                while not store["manifests"]:
+                    cv.wait()
+            # 5. leavers release only now: fragments durable AND manifest
+            #    committed (their journal shard is drained by construction)
+            if m in leavers:
+                with me.cv:
+                    me.released = True
+                    me.owned.clear()
+                    me.tokens.clear()
+                notify_everyone()
+                return
+            # 6. survivors install (epoch + map + imports, atomically), then
+            #    run post-install traffic and drain
+            install(m)
+            traffic(m)
+            if bug == "epoch_before_install" and m == 0:
+                late_map_fix(m)
+            drain(m)
+
+        def joiner_body(j: int) -> None:
+            me = members[j]
+            # joiners wait for the committed manifest (their catch-up is the
+            # manifest + fragments, never a history replay), then install
+            with cv:
+                while not store["manifests"]:
+                    cv.wait()
+            install(j)
+            traffic(j)
+            drain(j)
+
+        for m in range(old_n):
+            sched.spawn(old_member_body, m, name=f"member{m}")
+        for j in joiners:
+            sched.spawn(joiner_body, j, name=f"joiner{j}")
+
+        def check() -> None:
+            # every slot owned by exactly one live member, and by the mapped one
+            for slot in range(n_slots):
+                owners = [
+                    mm.rank for mm in members.values()
+                    if slot in mm.owned and not mm.released
+                ]
+                assert len(owners) == 1, (
+                    f"slot {slot} owned by {owners} (expected exactly one "
+                    "owner at the final epoch)"
+                )
+                assert owners[0] == new_owner(slot), (
+                    f"slot {slot} owned by rank {owners[0]}, expected "
+                    f"{new_owner(slot)}"
+                )
+            # no row lost or duplicated across the handoff
+            for slot in range(n_slots):
+                want = {f"row{slot}a", f"row{slot}b"}
+                held: "set[str]" = set()
+                for mm in members.values():
+                    if mm.released:
+                        continue
+                    base = {
+                        t for t in mm.tokens.get(slot, set())
+                        if not t.startswith("routed")
+                    }
+                    assert not (held & base), (
+                        f"slot {slot} rows duplicated across ranks: {held & base}"
+                    )
+                    held |= base
+                assert held == want, (
+                    f"slot {slot} rows lost across the handoff: have "
+                    f"{sorted(held)}, want {sorted(want)}"
+                )
+            assert not store["misrouted"], (
+                f"rows routed to released leavers: {store['misrouted']}"
+            )
+            for m in members_after:
+                mm = members[m]
+                assert mm.epoch == new_epoch, f"rank {m} never adopted the epoch"
+                assert not mm.parked, f"rank {m} stranded parked frames"
+                for frame_epoch, at_epoch, slot in mm.delivered:
+                    assert frame_epoch == at_epoch, (
+                        f"stale-epoch delivery on rank {m} (slot {slot})"
+                    )
+                assert not mm.bad_rows, (
+                    f"rows delivered to a non-owner on rank {m}: {mm.bad_rows}"
+                )
+            for lv in leavers:
+                assert members[lv].released, f"leaver {lv} never released"
+            assert (
+                len([x for x in store["manifests"] if x[0] == "member"]) == 1
+            ), "membership manifest committed more than once (or never)"
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
 # planted lock-order inversion (the PWA101 <-> model-check bridge)
 # ---------------------------------------------------------------------------
 

@@ -57,6 +57,48 @@ class ColumnarState:
         """Recycled slots start fresh (a new group reused a dead group's slot)."""
         raise NotImplementedError
 
+    # -- elastic membership handoff (parallel/membership.py) -----------------
+    #
+    # Every ColumnarState is slot-parallel arrays plus plain scalars, so the
+    # per-group handoff is generic: gather the moved groups' slots on the
+    # donor, scatter them into freshly upserted slots on the new owner.
+
+    def reshard_take(self, slots: np.ndarray) -> dict:
+        """Gather the given group slots' accumulator state (donor side)."""
+        arrays: dict = {}
+        scalars: dict = {}
+        for name, value in vars(self).items():
+            if isinstance(value, np.ndarray):
+                arrays[name] = value[slots] if len(value) else value[:0]
+            elif isinstance(value, (bool, int, float, str, type(None))):
+                scalars[name] = value
+            # anything else (e.g. _ObjectState.reducer) is graph config,
+            # reconstructed identically on the importing rank
+        return {"arrays": arrays, "scalars": scalars}
+
+    def reshard_put(self, slots: np.ndarray, blob: dict) -> None:
+        """Scatter taken accumulator state into this state's slots (importer
+        side; the slots were freshly upserted for the moved group keys)."""
+        if len(slots):
+            self.ensure(int(slots.max()) + 1)
+        for name, vals in blob["arrays"].items():
+            cur = getattr(self, name, None)
+            if cur is None or not len(slots) or len(vals) != len(slots):
+                continue
+            if cur.dtype != vals.dtype:
+                # adopt the donor's dtype (a fresh _SumState starts int64
+                # until its first insert locks the real dtype)
+                if cur.dtype == object or vals.dtype == object:
+                    cur = cur.astype(object)
+                else:
+                    cur = cur.astype(np.promote_types(cur.dtype, vals.dtype))
+                setattr(self, name, cur)
+            cur[slots] = vals
+        for name, v in blob["scalars"].items():
+            # scalar flags merge sticky (dtype_locked: locked on either side
+            # stays locked); config scalars are equal on both sides anyway
+            setattr(self, name, getattr(self, name, None) or v)
+
     def update(
         self,
         slots: np.ndarray,

@@ -226,6 +226,15 @@ class _FsSubject:
         self.csv_settings = csv_settings
         self.seen: Dict[str, float] = {}
         self.emitted: Dict[str, List[dict]] = {}
+        # elastic membership: file ownership is hash(path) mod n, so a
+        # grow/shrink re-partitions the scan. The engine freezes the scanner
+        # at a file boundary, exports/removes moved entries under the lock,
+        # and bumps the generation so an interrupted pass abandons its stale
+        # ownership filter instead of re-ingesting or retracting moved files.
+        self._reshard_lock = threading.Lock()
+        self._reshard_gen = 0
+        self._freeze = threading.Event()
+        self._idle = threading.Event()
 
     # -- persistence: the scanner's seen/emitted maps are the analogue of the
     # reference's cached_object_storage (replay without re-reading unchanged files).
@@ -286,12 +295,88 @@ class _FsSubject:
         self.emitted.pop(filepath, None)
         source.push_state({"file": filepath, "deleted": True})
 
+    # -- elastic membership (reshard protocol; see parallel/membership.py) ---
+
+    def _freeze_point(self) -> None:
+        """Scanner-side park at a file boundary while the engine reshards."""
+        if not self._freeze.is_set():
+            return
+        self._idle.set()
+        while self._freeze.is_set():
+            time.sleep(0.05)
+        self._idle.clear()
+
+    def reshard_pause(self) -> None:
+        self._freeze.set()
+
+    def reshard_resume(self) -> None:
+        self._freeze.clear()
+
+    def reshard_idle(self, timeout: float) -> bool:
+        """True once the scanner parked at a file boundary (engine side)."""
+        return self._idle.wait(timeout)
+
+    def reshard_exports(self, new_n: int) -> Dict[int, List[dict]]:
+        """Complete partition of the live scan state by NEW file owner —
+        {rank: [per-file state deltas]} (including this rank's keepers: the
+        fragments double as the new topology's checkpoint)."""
+        out: Dict[int, List[dict]] = {}
+        with self._reshard_lock:
+            for f in sorted(self.emitted):
+                dest = int(pointer_from(f).lo % new_n)
+                out.setdefault(dest, []).append(
+                    {
+                        "file": f,
+                        "mtime": self.seen.get(f),
+                        "rows": list(self.emitted[f]),
+                    }
+                )
+        return out
+
+    def reshard_key_owners(self, new_n: int) -> List[tuple]:
+        """(row-key bytes, new owner) for every emitted row — drives the
+        partition of ingest-placed downstream state tables (row keys are
+        content-addressed (file, index), derivable from the scan state)."""
+        from pathway_tpu.internals.keys import pointers_to_keys
+
+        out: List[tuple] = []
+        with self._reshard_lock:
+            for f, rows in self.emitted.items():
+                if not rows:
+                    continue
+                dest = int(pointer_from(f).lo % new_n)
+                keys = pointers_to_keys(
+                    [pointer_from(f, i, "fs") for i in range(len(rows))]
+                )
+                out.extend((keys[i].tobytes(), dest) for i in range(len(keys)))
+        return out
+
+    def reshard_apply(self, new_n: int, me: int) -> None:
+        """Adopt the new topology: drop entries whose files now belong to
+        another rank (WITHOUT retracting — the new owner carries them on) and
+        invalidate any in-flight scan pass."""
+        with self._reshard_lock:
+            for f in [
+                f for f in list(self.seen)
+                if int(pointer_from(f).lo % new_n) != me
+            ]:
+                self.seen.pop(f, None)
+                self.emitted.pop(f, None)
+            self._reshard_gen += 1
+
+    def reshard_keeps(self, delta: dict, new_n: int, me: int) -> bool:
+        """Does this journal/checkpoint state delta still belong here?"""
+        f = delta.get("file")
+        return f is None or int(pointer_from(f).lo % new_n) == me
+
     def run(self, source: StreamingDataSource) -> None:
         from pathway_tpu.internals.config import get_pathway_config
 
-        cfg = get_pathway_config()
         stop = False
         while not stop:
+            self._freeze_point()
+            gen = self._reshard_gen
+            cfg = get_pathway_config()  # re-read: membership changes flip it
             present = _iter_files(self.path, self.object_pattern)
             if cfg.processes > 1:
                 # partitioned parallel read (reference parallel_readers,
@@ -301,18 +386,29 @@ class _FsSubject:
                     for f in present
                     if pointer_from(f).lo % cfg.processes == cfg.process_id
                 ]
+            aborted = False
             for filepath in present:
+                self._freeze_point()
+                if self._reshard_gen != gen:
+                    # ownership changed mid-pass: this pass's file list was
+                    # filtered with the OLD topology — abandon it (the next
+                    # pass re-lists under the new one)
+                    aborted = True
+                    break
                 try:
                     if self.seen.get(filepath) == os.stat(filepath).st_mtime:
                         continue
-                    self._process_file(source, filepath)
+                    with self._reshard_lock:
+                        self._process_file(source, filepath)
                 except FileNotFoundError:
                     # deleted between listing and read; the next pass retracts it
                     continue
-            for gone in sorted(set(self.seen) - set(present)):
-                self._process_deletion(source, gone)
-            # one full pass done: a crash-straddled file absent from this pass is gone
-            source.push_barrier()
+            if not aborted and self._reshard_gen == gen:
+                for gone in sorted(set(self.seen) - set(present)):
+                    self._process_deletion(source, gone)
+                # one full pass done: a crash-straddled file absent from this
+                # pass is gone
+                source.push_barrier()
             if self.mode in ("static", "batch"):
                 stop = True
             else:

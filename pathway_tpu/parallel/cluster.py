@@ -48,6 +48,13 @@ FENCE_TAG = b"\x00fence"
 # rejoin hello, sent by a relaunched rank dialing back into a live cluster:
 # magic + rank(4, little) + epoch(4, little)
 _REJOIN_MAGIC = b"PWRJ"
+# membership hello, sent by a JOINER of an elastic grow transition dialing
+# the existing members (and lower-ranked fellow joiners):
+# magic + rank(4, little) + epoch(4, little) + target_n(4, little)
+_MEMBER_MAGIC = b"PWMB"
+# sanity bound on hello ranks: parked dial-ins are validated again at
+# install, but a garbage rank must not grow the pending map unboundedly
+_MAX_RANK = 4096
 
 
 class ClusterExchange:
@@ -113,6 +120,16 @@ class ClusterExchange:
         self._stop = threading.Event()
         self.epoch = max(0, int(_env_float("PATHWAY_CLUSTER_EPOCH", 0)))
         self._rejoin_mode = os.environ.get("PATHWAY_CLUSTER_REJOIN") == "1"
+        # elastic membership: a JOINER process of a grow transition
+        # (PATHWAY_MEMBERSHIP_JOIN=1, PATHWAY_MEMBERSHIP_FROM=<old n>) wires
+        # into the live mesh and waits for the members' install; existing
+        # members park joiner hellos (which may arrive before their engines
+        # have even read the directive) until apply_membership installs them
+        self._membership_join = os.environ.get("PATHWAY_MEMBERSHIP_JOIN") == "1"
+        self._membership_from = max(
+            0, int(_env_float("PATHWAY_MEMBERSHIP_FROM", 0))
+        )
+        self._membership_target: Optional[tuple] = None  # (target_n, epoch)
         self._pending_rejoin: Dict[int, tuple] = {}  # rank -> (socket, epoch)
         self._fence_dead: "set[int]" = set()  # ranks peers told us died
         self._fence_pending = False
@@ -146,7 +163,9 @@ class ClusterExchange:
         from pathway_tpu.internals.chaos import get_chaos
 
         self._chaos = get_chaos()
-        if self._rejoin_mode and self.n > 1:
+        if self._membership_join and self.n > 1:
+            self._connect_membership()
+        elif self._rejoin_mode and self.n > 1:
             self._connect_rejoin()
         else:
             self._connect_all()
@@ -350,6 +369,109 @@ class ClusterExchange:
             self._tune_socket(conn)
             self._send_locks[peer] = threading.Lock()
 
+    def _membership_hello(self) -> bytes:
+        return (
+            _MEMBER_MAGIC
+            + self.me.to_bytes(4, "little")
+            + (self.epoch & 0xFFFFFFFF).to_bytes(4, "little")
+            + self.n.to_bytes(4, "little")
+        )
+
+    def _connect_membership(self) -> None:
+        """Joiner wiring for an elastic grow transition: ``self.n`` is the
+        TARGET topology and ``self.epoch`` the transition's epoch. The joiner
+        dials every existing member (ranks < PATHWAY_MEMBERSHIP_FROM) and
+        every lower-ranked fellow joiner, and accepts dial-ins from
+        higher-ranked joiners — members park our hello until their engines
+        reach the membership quiesce point and install (``apply_membership``).
+        """
+        self._membership_target = (self.n, self.epoch)
+        if self._chaos is not None:
+            # deterministic fault injection: a joiner killed before it ever
+            # installs — the headline join-side crash of the transition
+            self._chaos.maybe_scale_kill(
+                self.me, "scale_join_kill", epoch=self.epoch
+            )
+        if self._chaos is not None and self._chaos.scale_fault(
+            "dropped_scale_handshake", self.me
+        ):
+            # deterministic fault injection: the joiner's hello is "lost" —
+            # failing the wiring loudly exercises the supervisor's
+            # joiner-relaunch / restart-all escalation
+            raise PeerTimeoutError(
+                f"chaos: membership handshake of joiner rank {self.me} "
+                f"(epoch {self.epoch}) dropped by plan"
+            )
+        from_n = self._membership_from
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", self.first_port + self.me))
+        listener.listen(self.n)
+        self._listener = listener
+        connect_budget = _env_float("PATHWAY_CONNECT_TIMEOUT_S", 60.0)
+        higher_joiners = self.n - 1 - self.me
+        accepted: Dict[int, socket.socket] = {}
+        accept_errors: List[BaseException] = []
+
+        def accept_loop() -> None:
+            try:
+                while len(accepted) < higher_joiners:
+                    conn, _addr = listener.accept()
+                    conn.settimeout(10.0)
+                    hello = self._recv_exact(conn, len(_MEMBER_MAGIC) + 12)
+                    conn.settimeout(None)
+                    if not hello.startswith(_MEMBER_MAGIC):
+                        conn.close()
+                        continue
+                    peer = int.from_bytes(hello[4:8], "little")
+                    if not (self.me < peer < self.n):
+                        conn.close()
+                        continue
+                    accepted[peer] = conn
+            except BaseException as exc:  # surfaced after join
+                accept_errors.append(exc)
+
+        acceptor = threading.Thread(target=accept_loop, daemon=True)
+        acceptor.start()
+        hello = self._membership_hello()
+        rng = random.Random((self.me << 16) ^ self.first_port ^ self.epoch)
+        try:
+            # every existing member (< from_n) and every lower-ranked joiner
+            for peer in range(self.me):
+                s = self._dial_peer(peer, connect_budget, rng)
+                s.sendall(hello)
+                self._conns[peer] = s
+            if higher_joiners:
+                acceptor.join(timeout=connect_budget)
+                if acceptor.is_alive():
+                    raise PeerTimeoutError(
+                        f"joiner rank {self.me} timed out waiting for "
+                        f"{higher_joiners} higher-ranked joiner dial-in(s) "
+                        f"(got {sorted(accepted)})"
+                    )
+                if accept_errors:
+                    raise ConnectionError(
+                        f"joiner rank {self.me} failed accepting fellow "
+                        "joiners"
+                    ) from accept_errors[0]
+        except BaseException:
+            for s in list(self._conns.values()) + list(accepted.values()):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            try:
+                listener.close()
+            except OSError:
+                pass
+            self._listener = None
+            raise
+        self._conns.update(accepted)
+        for peer, conn in self._conns.items():
+            self._tune_socket(conn)
+            self._send_locks[peer] = threading.Lock()
+
     def _rejoin_acceptor(self) -> None:
         """Post-wiring accept loop: park dial-ins from relaunched ranks until
         the engine's fence path installs them (``await_rejoin``). Runs for the
@@ -363,6 +485,8 @@ class ClusterExchange:
             try:
                 conn.settimeout(10.0)
                 hello = self._recv_exact(conn, len(_REJOIN_MAGIC) + 8)
+                if hello.startswith(_MEMBER_MAGIC):
+                    hello += self._recv_exact(conn, 4)  # + target_n
                 conn.settimeout(None)
             except (ConnectionError, OSError):
                 try:
@@ -374,15 +498,27 @@ class ClusterExchange:
             epoch = int.from_bytes(hello[8:12], "little")
             stale_conn: Optional[socket.socket] = None
             with self._cv:
-                ok = (
-                    not self._closed
-                    and hello.startswith(_REJOIN_MAGIC)
-                    and 0 <= rank < self.n
-                    and rank != self.me
-                    # stale-epoch rejoins (a zombie replacement from an
-                    # abandoned attempt) are refused, not installed
-                    and epoch > self.epoch
-                )
+                if hello.startswith(_MEMBER_MAGIC):
+                    # joiner hello of an elastic grow: the rank may exceed the
+                    # CURRENT n (that is the point) and may arrive before this
+                    # member's engine has even read the directive — park it;
+                    # apply_membership validates against the real target
+                    ok = (
+                        not self._closed
+                        and 0 <= rank < _MAX_RANK
+                        and rank != self.me
+                        and epoch > self.epoch
+                    )
+                else:
+                    ok = (
+                        not self._closed
+                        and hello.startswith(_REJOIN_MAGIC)
+                        and 0 <= rank < self.n
+                        and rank != self.me
+                        # stale-epoch rejoins (a zombie replacement from an
+                        # abandoned attempt) are refused, not installed
+                        and epoch > self.epoch
+                    )
                 if ok:
                     old = self._pending_rejoin.pop(rank, None)
                     if old is not None:
@@ -488,7 +624,14 @@ class ClusterExchange:
                 self._cv.notify_all()
 
     def _send(self, peer: int, tag: bytes, payload: bytes) -> None:
-        conn = self._conns[peer]
+        conn = self._conns.get(peer)
+        if conn is None:
+            # link removed by a membership shrink: a stale heartbeat thread
+            # racing the install must simply stop, not KeyError
+            return
+        lock = self._send_locks.get(peer)
+        if lock is None:
+            return
         frame = (
             self._HDR.pack(len(tag), len(payload), self.epoch & 0xFFFFFFFF)
             + tag
@@ -502,7 +645,7 @@ class ClusterExchange:
                 time.sleep(action.delay_s)
             elif action.kind == "truncate":
                 # torn write + dead link, as a crash mid-send would leave it
-                with self._send_locks[peer]:
+                with lock:
                     try:
                         conn.sendall(frame[: max(1, len(frame) // 2)])
                         conn.shutdown(socket.SHUT_RDWR)
@@ -513,7 +656,7 @@ class ClusterExchange:
                     self._cv.notify_all()
                 return
         try:
-            with self._send_locks[peer]:
+            with lock:
                 conn.sendall(frame)
             if tag != HEARTBEAT_TAG:
                 # per-peer traffic accounting (heartbeats excluded — 1 Hz
@@ -685,12 +828,18 @@ class ClusterExchange:
             installed: Dict[int, tuple] = {}
             old_conns: List[socket.socket] = []
             with self._cv:
+                # parked MEMBERSHIP hellos (rank >= n, a pending grow) are
+                # not replacements: they stay parked for apply_membership
+                replacements = {
+                    r: v for r, v in self._pending_rejoin.items() if r < self.n
+                }
                 waiting = (set(self._dead) | self._fence_dead) - set(
-                    self._pending_rejoin
+                    replacements
                 )
-                if not waiting and self._pending_rejoin:
-                    installed = self._pending_rejoin
-                    self._pending_rejoin = {}
+                if not waiting and replacements:
+                    installed = replacements
+                    for r in replacements:
+                        self._pending_rejoin.pop(r, None)
                     new_epoch = max(e for (_c, e) in installed.values())
                     for rank, (conn, _e) in installed.items():
                         old = self._conns.get(rank)
@@ -759,6 +908,132 @@ class ClusterExchange:
                 return self.epoch
             if on_wait is not None:
                 on_wait()
+
+    # -- elastic membership (grow/shrink the live mesh) ------------------------
+
+    def apply_membership(
+        self,
+        new_n: int,
+        new_epoch: int,
+        timeout: Optional[float] = None,
+        on_wait: "Optional[Callable[[], None]]" = None,
+    ) -> int:
+        """Install the new topology on an EXISTING member at the membership
+        quiesce point: wait for every joiner's parked dial-in (grow), or cut
+        the draining ranks' links (shrink), then atomically adopt ``new_n``
+        and ``new_epoch`` — purging the old epoch's inbox and delivering
+        frames peers already sent at the new epoch (members that applied
+        first race ahead exactly like staggered rejoin installs).
+
+        Returns the new epoch. Raises :class:`PeerTimeoutError` when a
+        joiner never dials in (killed/dropped handshake — the caller dies
+        typed and the supervisor escalates)."""
+        if timeout is None:
+            timeout = self.fence_timeout_s
+        deadline = time.monotonic() + timeout
+        joiner_ranks = {r for r in range(new_n) if r >= self.n}
+        while True:
+            installed: Dict[int, tuple] = {}
+            removed_conns: List[socket.socket] = []
+            with self._cv:
+                ready = {
+                    r
+                    for r, (_c, ep) in self._pending_rejoin.items()
+                    if r in joiner_ranks and ep == new_epoch
+                }
+                if ready >= joiner_ranks:
+                    for rank in sorted(joiner_ranks):
+                        conn, _ep = self._pending_rejoin.pop(rank)
+                        installed[rank] = (conn, new_epoch)
+                        self._conns[rank] = conn
+                        self._conn_gen[rank] = self._conn_gen.get(rank, 0) + 1
+                        self._send_locks.setdefault(rank, threading.Lock())
+                        self._last_heard[rank] = time.monotonic()
+                        self._inbox_count.setdefault(rank, 0)
+                    # shrink: cut the draining ranks' links (their readers see
+                    # the conn replaced/absent and never mark them dead)
+                    for rank in [r for r in self._conns if r >= new_n]:
+                        removed_conns.append(self._conns.pop(rank))
+                        self._conn_gen[rank] = self._conn_gen.get(rank, 0) + 1
+                        self._send_locks.pop(rank, None)
+                        self._last_heard.pop(rank, None)
+                        self._inbox_count.pop(rank, None)
+                        self._dead.pop(rank, None)
+                        self._fence_dead.discard(rank)
+                    # zombie hellos of abandoned attempts: refuse, never keep
+                    for rank in [
+                        r
+                        for r, (_c, ep) in self._pending_rejoin.items()
+                        if ep <= new_epoch
+                    ]:
+                        removed_conns.append(self._pending_rejoin.pop(rank)[0])
+                    # the old epoch's frames must never meet the new
+                    # topology's barriers (same discipline as a rejoin
+                    # install): purge, then deliver parked new-epoch frames
+                    self.stale_frames_dropped += len(self._inbox)
+                    self._inbox.clear()
+                    for p in self._inbox_count:
+                        self._inbox_count[p] = 0
+                    future, self._future_inbox = self._future_inbox, {}
+                    for (peer, tag), (payload, ep) in future.items():
+                        if ep == new_epoch and peer in self._conns:
+                            self._inbox[(peer, tag)] = payload
+                            self._inbox_count[peer] = (
+                                self._inbox_count.get(peer, 0) + 1
+                            )
+                        else:
+                            self.stale_frames_dropped += 1
+                    self.n = new_n
+                    self.epoch = new_epoch
+                    self._membership_target = None
+                    self._cv.notify_all()
+                elif self._closed:
+                    raise PeerShutdownError(
+                        f"cluster exchange closed while process {self.me} "
+                        "waited to apply the membership change"
+                    )
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise PeerTimeoutError(
+                            f"process {self.me} waited {timeout:.0f}s for "
+                            f"joiner rank(s) {sorted(joiner_ranks - ready)} "
+                            f"to dial in at epoch {new_epoch} — membership "
+                            "change cannot complete"
+                        )
+                    self._cv.wait(timeout=min(remaining, 0.25))
+            if installed or not joiner_ranks:
+                for conn in removed_conns:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                for rank, (conn, _e) in installed.items():
+                    self._tune_socket(conn)
+                    self._start_reader(rank, conn)
+                    if self.heartbeat_interval_s > 0:
+                        self._start_heartbeat(rank)
+                _stage_add("cluster.membership_applied")
+                _flight_recorder().record_event(
+                    "membership_applied",
+                    n=self.n,
+                    epoch=self.epoch,
+                    joined=sorted(installed),
+                )
+                return self.epoch
+            if on_wait is not None:
+                on_wait()
+
+    def leave_membership(self) -> None:
+        """A draining leaver's mesh teardown (after the final old-topology
+        barrier): just the idempotent close — survivors have already stopped
+        addressing this rank, and their readers ignore links no longer in
+        ``_conns``."""
+        _stage_add("cluster.membership_left")
+        _flight_recorder().record_event(
+            "membership_left", rank=self.me, epoch=self.epoch
+        )
+        self.close()
 
     # -- incremental-rewind serve log -----------------------------------------
 

@@ -1,0 +1,492 @@
+"""Elastic mesh membership: epoch-fenced grow/shrink of a spawn cluster.
+
+ROADMAP item 1: a ``MEMBERSHIP_CHANGE(target_n)`` transition that rides the
+existing fence/quiesce machinery. The supervisor publishes a *directive*
+(generation, target worker count, next epoch) into the supervise dir; the
+workers agree on it through the per-commit neu allgather, quiesce at one
+commit boundary, partition their state into per-new-owner *handoff fragments*
+(the reshard treated as an array redistribution — every keyed state array is
+gathered by ``shard_of(key, new_n)`` and scattered to its new owner, the
+DrJAX MapReduce-primitives view of the reshard), commit a *membership
+manifest* through the PR-6 checkpoint machinery, and only then rewire the
+mesh: joiners install, leavers drain and release. A joiner's catch-up is the
+manifest + fragments + journal tail — never a full-history replay.
+
+The state machine was modeled FIRST (``membership_model`` in
+``internals/protocol_models.py``) and explored under ``internals/sched.py``;
+the invariants proven there (single owner per key range at every epoch, no
+row lost or duplicated across the handoff, leavers drained before release,
+no stale-epoch delivery, no deadlock) are the contract this module
+implements against real sockets and stores.
+
+This module owns the pieces that are neither mesh (``parallel/cluster.py``)
+nor engine (``engine/runner.py``): the typed errors, the directive file
+protocol between supervisor and workers, the per-node reshard-policy
+analysis, and the fragment build/import helpers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# directive file written atomically by the supervisor into the supervise dir;
+# workers poll it at commit boundaries and agree on it via the neu allgather
+DIRECTIVE_FILE = "membership.json"
+
+
+class MembershipMismatchError(ValueError):
+    """A persisted store (journal header, store meta, checkpoint manifest)
+    names a different worker count than this run. Carries enough to triage:
+    was the cluster scaled with ``--scale`` (relaunch with ``-n manifest_n``,
+    or let the supervisor adapt) or is the store from another deployment?
+
+    Subclasses ``ValueError`` so pre-elastic ``except ValueError`` refusal
+    handling keeps working."""
+
+    def __init__(
+        self,
+        what: str,
+        *,
+        manifest_n: "int | None",
+        current_n: int,
+        epoch: int = 0,
+    ):
+        self.manifest_n = manifest_n
+        self.current_n = current_n
+        self.epoch = epoch
+        super().__init__(
+            f"persisted {what} was written by a run with {manifest_n} worker "
+            f"process(es) but this run uses {current_n} (store epoch "
+            f"{epoch}): the journal and checkpoints are sharded per worker. "
+            f"If the cluster was resized with `spawn --scale`, relaunch with "
+            f"-n {manifest_n} (the supervisor does this automatically when "
+            "adapting after a mid-transition crash); if you never scaled, "
+            "the store belongs to a different deployment — clear the "
+            "persistence directory to start fresh"
+        )
+
+
+class MembershipUnsupportedError(RuntimeError):
+    """The running graph (or its sources) holds state this build cannot
+    re-partition across a membership change. The scale request is REFUSED —
+    loudly, with the reason — and the cluster keeps running at its current
+    size."""
+
+
+@dataclass(frozen=True)
+class MembershipDirective:
+    """One requested membership change, written by the supervisor."""
+
+    generation: int  # monotonically increasing per supervise dir
+    target_n: int
+    epoch: int  # the epoch the new topology will run at
+    from_n: int  # worker count when the directive was issued
+
+    def as_tuple(self) -> tuple:
+        return (self.generation, self.target_n, self.epoch, self.from_n)
+
+    @classmethod
+    def from_tuple(cls, t: "tuple | list | None") -> "Optional[MembershipDirective]":
+        if not t:
+            return None
+        g, n, e, f = t
+        return cls(int(g), int(n), int(e), int(f))
+
+
+def directive_path(supervise_dir: str) -> str:
+    return os.path.join(supervise_dir, DIRECTIVE_FILE)
+
+
+def write_directive(supervise_dir: str, directive: MembershipDirective) -> None:
+    path = directive_path(supervise_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "generation": directive.generation,
+                "target_n": directive.target_n,
+                "epoch": directive.epoch,
+                "from_n": directive.from_n,
+            },
+            f,
+        )
+    os.replace(tmp, path)
+
+
+def read_directive(supervise_dir: "str | None") -> "Optional[MembershipDirective]":
+    if not supervise_dir:
+        return None
+    try:
+        with open(directive_path(supervise_dir)) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    try:
+        return MembershipDirective(
+            int(raw["generation"]), int(raw["target_n"]),
+            int(raw["epoch"]), int(raw["from_n"]),
+        )
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def clear_directive(supervise_dir: "str | None") -> None:
+    if not supervise_dir:
+        return
+    try:
+        os.unlink(directive_path(supervise_dir))
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# reshard-policy analysis
+# ---------------------------------------------------------------------------
+#
+# Which new rank owns each piece of a node's state after the transition?
+#
+#   "bykey"   — rows live at their row/group key's owner (outputs of row-key
+#               and group-key exchanges through key-preserving chains):
+#               partition every keyed state array by shard_of(key, new_n).
+#   "source"  — never exchanged: rows sit where they were ingested, so they
+#               move exactly when their *source shard* moves (fs file
+#               ownership is hash-of-path mod n). Partitioned by the key ->
+#               new-owner map the reshardable sources export; keys outside
+#               the map (rank-local sources) stay on a surviving donor and
+#               fall back to shard_of on a leaver (their streams are final —
+#               the preflight refuses live rank-local streams on leavers).
+#   "root"    — centralized on rank 0 (sort, temporal behaviors, iterate,
+#               row transformers): rank 0 survives every transition, so the
+#               full state ships to rank 0 (a no-op move for live rank 0).
+#
+# Everything else — join arrangements (keyed by a non-output join key),
+# key-changing operators over exchanged rows, dedup instances, operators
+# outside the snapshot protocol — is REFUSED in this build: the preflight
+# vote aborts the transition loudly and the cluster keeps running at its
+# current size. (Join-state handoff is the named follow-on in ROADMAP.)
+
+# key-preserving kinds (mirror of GraphRunner.setup's placement analysis):
+# output row keys equal input row keys, so ownership flows through unchanged
+_KEY_PRESERVING = {
+    "rowwise", "filter", "update_rows", "update_cells", "intersect",
+    "difference", "restrict", "having", "with_universe_of",
+    "remove_errors", "concat", "output", "asof_now_update",
+}
+
+_NESTED_KINDS = {
+    "iterate", "iterate_result", "row_transformer", "row_transformer_result",
+}
+
+
+@dataclass
+class ReshardPlan:
+    """Per-node reshard policies, or the reasons the transition is refused."""
+
+    policies: Dict[int, str]
+    refusals: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.refusals
+
+
+def compute_reshard_plan(runner: Any) -> ReshardPlan:
+    """Classify every node of the running graph for the handoff. Pure
+    analysis — no state is touched. Conservative: anything the fragment
+    builder cannot partition exactly is a refusal, never a silent guess."""
+    from pathway_tpu.engine.evaluators import InputEvaluator, OutputEvaluator
+    from pathway_tpu.internals import parse_graph as pg
+
+    policies: Dict[int, str] = {}
+    refusals: List[str] = []
+    memo: Dict[int, str] = {}
+
+    def placement(node: Any) -> str:
+        got = memo.get(node.id)
+        if got is not None:
+            return got
+        memo[node.id] = "refuse"  # cycle guard (loop-back chains)
+        if isinstance(node, pg.InputNode):
+            p = "source"
+        elif node.kind in _NESTED_KINDS:
+            p = "root"
+        else:
+            ev = runner.evaluators.get(node.id)
+            pol = tuple(getattr(ev, "_cluster_policies", ()) or ())
+            if "root" in pol:
+                p = "root"
+            elif node.kind == "groupby":
+                p = "bykey"  # routed by group key == output row key
+            elif node.kind == "join" or "custom" in pol:
+                p = "refuse"  # state keyed by a non-output exchange key
+            elif "rowkey" in pol:
+                p = "bykey"
+            else:
+                contrib = [
+                    placement(inp._node)
+                    for i, inp in enumerate(node.inputs)
+                    if not (i < len(pol) and pol[i] == "broadcast")
+                ] or [placement(inp._node) for inp in node.inputs]
+                if not contrib:
+                    p = "source"
+                elif all(c == contrib[0] for c in contrib):
+                    p = contrib[0]
+                else:
+                    p = "refuse"
+                if p in ("bykey", "source") and node.kind not in _KEY_PRESERVING:
+                    # key-changing op: output keys are neither the exchange
+                    # key nor the preserved source key — not partitionable
+                    p = "refuse"
+        memo[node.id] = p
+        return p
+
+    for node in runner._nodes:
+        ev = runner.evaluators.get(node.id)
+        if isinstance(ev, (InputEvaluator, OutputEvaluator)):
+            # sources hand off through the source-state path; sinks are
+            # rank-local delivery bookkeeping (retraction/snapshot replay
+            # handles them at the transition)
+            continue
+        p = placement(node)
+        if p == "refuse":
+            refusals.append(
+                f"node {node.id} ({node.kind}): state is keyed by a "
+                "non-output exchange key or a key-changing derivation — "
+                "this build cannot re-partition it across a membership "
+                "change (join/dedup handoff is the ROADMAP follow-on)"
+            )
+            continue
+        if not getattr(ev, "SNAPSHOT_CAPTURE", True):
+            refusals.append(
+                f"node {node.id} ({node.kind}): state lives outside the "
+                "snapshot protocol (device-resident) and cannot ride the "
+                "handoff fragments"
+            )
+            continue
+        if p == "bykey":
+            reason = ev.reshard_check() if ev is not None else None
+            if reason is not None:
+                refusals.append(f"node {node.id} ({node.kind}): {reason}")
+                continue
+        policies[node.id] = p
+    return ReshardPlan(policies, refusals)
+
+
+def preflight_sources(runner: Any, new_n: int, me: int) -> List[str]:
+    """Source-side capability check. A leaver's live streams must be
+    transferable (fs scans reshard; finished streams and loopbacks are
+    inert); a rank-local live stream on a leaver would silently stop
+    ingesting — refuse instead."""
+    refusals: List[str] = []
+    leaving = me >= new_n
+    for node, _ev in runner._sources:
+        source = node.config["source"]
+        subject = getattr(source, "subject", None)
+        reshardable = subject is not None and hasattr(subject, "reshard_exports")
+        if reshardable:
+            continue
+        if getattr(source, "loopback", False):
+            continue
+        if leaving and not source.is_finished():
+            refusals.append(
+                f"source node {node.id}: rank {me} is draining but this "
+                "live stream is rank-local (no reshard support) — its "
+                "future rows would be lost; finish or reshard the source "
+                "before scaling this rank away"
+            )
+    return refusals
+
+
+# ---------------------------------------------------------------------------
+# handoff fragments
+# ---------------------------------------------------------------------------
+
+
+def _owner_fn_bykey(new_n: int) -> Callable[[Any], Any]:
+    from pathway_tpu.internals.keys import shard_of
+
+    def owner_of(keys: Any) -> Any:
+        return shard_of(keys, new_n)
+
+    return owner_of
+
+
+def _owner_fn_source(
+    key_owner_map: Dict[bytes, int], default_owner: "int | None", new_n: int
+) -> Callable[[Any], Any]:
+    """Per-row owners for ingest-placed state: the source-exported key map
+    decides; unmapped keys stay on the donor (survivor) or hash out
+    (leaver — their streams are final by preflight)."""
+    import numpy as np
+
+    from pathway_tpu.internals.keys import shard_of
+
+    def owner_of(keys: Any) -> Any:
+        fallback = (
+            shard_of(keys, new_n)
+            if default_owner is None
+            else np.full(len(keys), default_owner, dtype=np.int64)
+        )
+        out = fallback.copy()
+        for i in range(len(keys)):
+            got = key_owner_map.get(keys[i].tobytes())
+            if got is not None:
+                out[i] = got
+        return out
+
+    return owner_of
+
+
+def build_source_exports(
+    runner: Any, new_n: int
+) -> Tuple[Dict[int, Dict[int, list]], Dict[bytes, int]]:
+    """Ask every reshardable source to partition its durable scan state by
+    new owner. Returns ``(per_dest {rank: {node_id: [state deltas]}},
+    key_owner_map {row-key bytes -> new owner})`` — the map also drives the
+    "source"-policy state-table partition. Pure read: nothing is removed
+    from the live sources until the transition commits."""
+    per_dest: Dict[int, Dict[int, list]] = {}
+    key_map: Dict[bytes, int] = {}
+    for node, _ev in runner._sources:
+        source = node.config["source"]
+        subject = getattr(source, "subject", None)
+        exports = getattr(subject, "reshard_exports", None)
+        if exports is None:
+            continue
+        by_owner = exports(new_n)
+        for dest, deltas in by_owner.items():
+            if not deltas:
+                continue
+            per_dest.setdefault(dest, {}).setdefault(node.id, []).extend(deltas)
+        key_owners = getattr(subject, "reshard_key_owners", None)
+        if key_owners is not None:
+            for kb, owner in key_owners(new_n):
+                key_map[kb] = owner
+    return per_dest, key_map
+
+
+def build_fragments(
+    runner: Any,
+    plan: ReshardPlan,
+    new_n: int,
+    commit: int,
+    generation: int,
+    source_state: "Tuple[dict, dict] | None" = None,
+) -> Tuple[Dict[int, dict], Dict[str, int]]:
+    """Partition this rank's entire engine state into one fragment per new
+    rank (including one addressed to itself — crash recovery reloads the
+    full set, so fragments must be complete, not deltas against live
+    state). ``source_state`` is a precomputed :func:`build_source_exports`
+    result (the caller reuses it for the sink retractions — rebuilding it
+    copies every emitted row again). Returns ``(fragments, stats)``."""
+    import numpy as np  # noqa: F401  (vectorized owners)
+
+    from pathway_tpu.internals.config import get_pathway_config
+
+    me = get_pathway_config().process_id
+    leaving = me >= new_n
+    source_exports, key_map = (
+        source_state
+        if source_state is not None
+        else build_source_exports(runner, new_n)
+    )
+    bykey = _owner_fn_bykey(new_n)
+    bysource = _owner_fn_source(key_map, None if leaving else me, new_n)
+
+    fragments: Dict[int, dict] = {
+        dest: {
+            "format": 1,
+            "from_rank": me,
+            "commit": commit,
+            "generation": generation,
+            "states": {},
+            "evals": {},
+            "evals_full": {},
+            "source_offsets": {},
+            "source_deltas": {},
+        }
+        for dest in range(new_n)
+    }
+    rows_moved = 0
+    for nid, policy in plan.policies.items():
+        ev = runner.evaluators[nid]
+        state = runner.states.get(nid)
+        if policy == "root":
+            # centralized state lives at rank 0 ONLY — rank 0's copy is
+            # authoritative, and a non-root rank's empty mirror must never
+            # clobber it at import
+            if me == 0:
+                fragments[0]["evals_full"][nid] = ev.state_dict()
+                if state is not None and nid in runner._materialized:
+                    snap = state.snapshot()
+                    if len(snap):
+                        fragments[0]["states"][nid] = (
+                            snap.keys, snap.diffs, dict(snap.columns)
+                        )
+            continue
+        owner_of = bykey if policy == "bykey" else bysource
+        payloads = ev.reshard_export(owner_of, new_n)
+        for dest, payload in payloads.items():
+            fragments[dest]["evals"][nid] = payload
+        if state is not None and nid in runner._materialized:
+            for dest, part in state.reshard_partition(owner_of).items():
+                fragments[dest]["states"][nid] = part
+                if dest != me:
+                    rows_moved += len(part[0])
+    # source continuation offsets ride the self-addressed fragment (a crash
+    # recovery of THIS rank resumes its own counters); moved scan state is
+    # addressed to its new owner
+    if not leaving:
+        for node, _ev in runner._sources:
+            offsets = node.config["source"].offset_state()
+            offsets.pop("state_deltas", None)
+            fragments[me]["source_offsets"][node.id] = offsets
+    for dest, by_node in source_exports.items():
+        if dest >= new_n:
+            continue
+        for nid, deltas in by_node.items():
+            fragments[dest]["source_deltas"].setdefault(nid, []).extend(deltas)
+    stats = {"rows_handed_off": rows_moved}
+    return fragments, stats
+
+
+def import_fragments(runner: Any, frags: List[dict]) -> Dict[str, int]:
+    """Merge handoff fragments addressed to this rank into FRESH evaluator /
+    state-table instances (the caller reset them). Order-independent: key
+    partitions are disjoint by construction; root/full states appear in
+    exactly one fragment."""
+    from pathway_tpu.engine.columnar import Delta
+
+    rows = 0
+    for frag in frags:
+        for nid, (keys, diffs, columns) in frag.get("states", {}).items():
+            nid = int(nid)
+            state = runner.states.get(nid)
+            if state is not None and len(keys):
+                state.apply(Delta(keys, diffs, columns))
+                rows += len(keys)
+        for nid, payload in frag.get("evals", {}).items():
+            ev = runner.evaluators.get(int(nid))
+            if ev is not None:
+                ev.reshard_import(payload)
+        for nid, blobs in frag.get("evals_full", {}).items():
+            ev = runner.evaluators.get(int(nid))
+            if ev is not None:
+                ev.load_state_dict(blobs)
+    return {"rows_imported": rows}
+
+
+def merge_fragment_sources(frags: List[dict]) -> Tuple[Dict[int, dict], Dict[int, list]]:
+    """Collect the source continuation offsets + scan-state deltas addressed
+    to this rank across all fragments (cold-start restore path)."""
+    offsets: Dict[int, dict] = {}
+    deltas: Dict[int, list] = {}
+    for frag in frags:
+        for nid, offs in frag.get("source_offsets", {}).items():
+            offsets[int(nid)] = offs
+        for nid, entries in frag.get("source_deltas", {}).items():
+            deltas.setdefault(int(nid), []).extend(entries)
+    return offsets, deltas

@@ -60,9 +60,11 @@ import json
 import os
 import shutil
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -110,6 +112,7 @@ def write_status(
     last_rejoin_s: "float | None" = None,
     checkpoint_commit: "int | None" = None,
     journal_tail_frames: "int | None" = None,
+    extra: "Dict[str, Any] | None" = None,
 ) -> None:
     """Atomically publish one worker's liveness record. Called from the commit
     loop (throttled there), so recency == the loop is actually turning; a
@@ -130,6 +133,9 @@ def write_status(
         # would cost — its checkpoint base and the journal tail past it
         "checkpoint_commit": checkpoint_commit,
         "journal_tail_frames": journal_tail_frames,
+        # elastic-membership fields (membership_state, current/target worker
+        # counts, commit/refusal markers, mismatch reports) ride here
+        **(extra or {}),
         "ts": time.time(),
     }
     path = status_path(supervise_dir, rank)
@@ -185,6 +191,8 @@ class Supervisor:
         restart_mode: str = "surgical",
         stale_after_s: "float | None" = None,
         poll_interval_s: float = 0.2,
+        scale_plan: "List[dict] | None" = None,
+        control_port: "int | None" = None,
     ):
         if restart_mode not in ("surgical", "all"):
             raise ValueError(
@@ -229,6 +237,31 @@ class Supervisor:
         self._killed_for_staleness: "set[int]" = set()
         self._clean_exit_at: Dict[int, float] = {}  # rank -> first seen rc==0
         self._supervise_dir: Optional[str] = None
+        # elastic membership (parallel/membership.py): scale requests arrive
+        # from --scale / PATHWAY_SCALE_PLAN entries or the control endpoint,
+        # become a DIRECTIVE file the workers agree on at a commit boundary,
+        # and (for a grow) joiner processes launched into the live mesh
+        if scale_plan is None:
+            raw = os.environ.get("PATHWAY_SCALE_PLAN")
+            try:
+                scale_plan = list(json.loads(raw)) if raw else []
+            except ValueError:
+                self._log(f"ignoring malformed PATHWAY_SCALE_PLAN: {raw!r}")
+                scale_plan = []
+        self.scale_plan = [dict(e) for e in scale_plan]
+        self._scale_generation = 0
+        #: (directive, started_at) while a membership transition is in flight
+        self._transition: "Optional[tuple]" = None
+        self._drained_ranks: "set[int]" = set()  # leavers that exited cleanly
+        self.membership_deadline_s = _env_float(
+            "PATHWAY_MEMBERSHIP_DEADLINE_S",
+            _env_float("PATHWAY_FENCE_TIMEOUT_S", 180.0) + 60.0,
+        )
+        self.last_reshard_s: "float | None" = None
+        self._control_port = control_port
+        self._control_listener: "Optional[socket.socket]" = None
+        self._scale_requests: List[int] = []
+        self._scale_lock = threading.Lock()
 
     def _surgical_enabled(self) -> bool:
         # n == 1 has no survivors to keep alive — surgical degenerates to
@@ -269,6 +302,7 @@ class Supervisor:
         self._terminated_by_us = set()
         self._killed_for_staleness = set()
         self._clean_exit_at = {}
+        self._drained_ranks = set()
         self._launched_at = time.monotonic()
         for process_id in range(self.n):
             self.handles.append(
@@ -298,6 +332,221 @@ class Supervisor:
         self.handles[rank] = subprocess.Popen(
             [self.program, *self.arguments], env=env
         )
+
+    # -- elastic membership ----------------------------------------------------
+
+    def _start_control_endpoint(self) -> None:
+        """Tiny line-protocol control endpoint (``scale N\\n`` -> ``ok\\n``):
+        operators (or an autoscaler) resize the running cluster without
+        restarting it."""
+        if self._control_port is None:
+            return
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", self._control_port))
+        listener.listen(4)
+        self._control_listener = listener
+
+        def serve() -> None:
+            while True:
+                try:
+                    conn, _addr = listener.accept()
+                except OSError:
+                    return  # listener closed (teardown)
+                try:
+                    conn.settimeout(5.0)
+                    line = b""
+                    while not line.endswith(b"\n") and len(line) < 64:
+                        chunk = conn.recv(64)
+                        if not chunk:
+                            break
+                        line += chunk
+                    parts = line.decode("utf-8", "replace").split()
+                    if len(parts) == 2 and parts[0] == "scale":
+                        with self._scale_lock:
+                            self._scale_requests.append(int(parts[1]))
+                        conn.sendall(b"ok\n")
+                    else:
+                        conn.sendall(b"err unknown command\n")
+                except (OSError, ValueError):
+                    pass
+                finally:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
+        threading.Thread(
+            target=serve, daemon=True, name="pathway:supervisor-control"
+        ).start()
+
+    def request_scale(self, target_n: int) -> bool:
+        """Issue a MEMBERSHIP_CHANGE directive (and launch joiners for a
+        grow). Returns False when the request is invalid or one is already
+        in flight."""
+        from pathway_tpu.parallel.membership import (
+            MembershipDirective,
+            write_directive,
+        )
+
+        if self._transition is not None:
+            self._log(
+                f"scale request to n={target_n} ignored: a membership "
+                "transition is already in flight"
+            )
+            return False
+        if self._rejoining is not None:
+            self._log(
+                f"scale request to n={target_n} deferred: a surgical rejoin "
+                "is in flight (re-request once the cluster is stable)"
+            )
+            return False
+        if target_n == self.n:
+            return False
+        if target_n < 2 or self.n < 2:
+            self._log(
+                f"scale request to n={target_n} refused: elastic membership "
+                "needs a live mesh on both sides (n >= 2)"
+            )
+            return False
+        assert self._supervise_dir is not None
+        self._scale_generation += 1
+        self.cluster_epoch += 1
+        directive = MembershipDirective(
+            self._scale_generation, target_n, self.cluster_epoch, self.n
+        )
+        write_directive(self._supervise_dir, directive)
+        self._transition = (directive, time.monotonic())
+        self._drained_ranks = set()
+        self._log(
+            f"membership change requested: n={self.n} -> n={target_n} "
+            f"(generation {directive.generation}, epoch {directive.epoch})"
+        )
+        if target_n > self.n:
+            for rank in range(self.n, target_n):
+                self.handles.append(self._launch_joiner(rank, directive))
+        return True
+
+    def _launch_joiner(self, rank: int, directive: Any) -> subprocess.Popen:
+        env = self._child_env(rank)
+        env["PATHWAY_PROCESSES"] = str(directive.target_n)
+        env["PATHWAY_CLUSTER_EPOCH"] = str(directive.epoch)
+        env["PATHWAY_MEMBERSHIP_JOIN"] = "1"
+        env["PATHWAY_MEMBERSHIP_FROM"] = str(directive.from_n)
+        # a reused joiner rank index must not inherit a previous incarnation's
+        # kill attribution (a refused transition terminated it by design)
+        self._terminated_by_us.discard(rank)
+        self._killed_for_staleness.discard(rank)
+        self._clean_exit_at.pop(rank, None)
+        self._drained_ranks.discard(rank)
+        try:
+            os.unlink(status_path(self._supervise_dir, rank))
+        except OSError:
+            pass
+        self._log(f"launching joiner rank {rank} (target n={directive.target_n})")
+        return subprocess.Popen([self.program, *self.arguments], env=env)
+
+    def _poll_scale_requests(self, statuses: Dict[int, dict]) -> None:
+        """Feed pending control-endpoint requests and due scale-plan entries
+        into :meth:`request_scale`. Plan entries are only consumed when the
+        request was actually issued (a rejoin-in-flight defers them)."""
+        if self._rejoining is None:
+            with self._scale_lock:
+                requests, self._scale_requests = self._scale_requests, []
+            for target in requests:
+                self.request_scale(target)
+        if (
+            self._transition is not None
+            or self._rejoining is not None
+            or not self.scale_plan
+        ):
+            return
+        max_commit = max(
+            (int(s.get("commit", 0) or 0) for s in statuses.values()), default=0
+        )
+        entry = self.scale_plan[0]
+        if max_commit >= int(entry.get("after_commit", 0)):
+            self.scale_plan.pop(0)
+            self.request_scale(int(entry["n"]))
+
+    def _watch_transition(self, statuses: Dict[int, dict]) -> "Optional[tuple]":
+        """Track an in-flight membership transition: adopt the new topology
+        on convergence, unwind a refusal, or shoot a wedged transition past
+        the deadline. Returns a failure tuple only for the wedged case."""
+        from pathway_tpu.parallel.membership import clear_directive
+
+        if self._transition is None:
+            return None
+        directive, started_at = self._transition
+        # a REFUSED transition (non-reshardable graph/sources) is not a
+        # failure: unwind and keep the cluster running at its current size
+        for rank, s in statuses.items():
+            refused = s.get("membership_refused")
+            if refused and int(refused[0]) == directive.generation:
+                self._log(
+                    f"membership change to n={directive.target_n} refused by "
+                    f"rank {rank}: {refused[1]}"
+                )
+                for jr in range(directive.from_n, len(self.handles)):
+                    handle = self.handles[jr]
+                    if handle.poll() is None:
+                        self._terminated_by_us.add(jr)
+                        try:
+                            handle.terminate()
+                        except OSError:
+                            pass
+                        try:
+                            handle.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            handle.kill()
+                            handle.wait()
+                del self.handles[directive.from_n:]
+                clear_directive(self._supervise_dir)
+                self._transition = None
+                return None
+        # convergence: every member of the NEW topology is stable at the
+        # directive's epoch, and every leaver exited cleanly (drained)
+        members_done = all(
+            rank in statuses
+            and int(statuses[rank].get("epoch", 0) or 0) >= directive.epoch
+            and statuses[rank].get("membership_state") == "stable"
+            and int(statuses[rank].get("current_workers", 0) or 0)
+            == directive.target_n
+            for rank in range(directive.target_n)
+        )
+        leavers_done = all(
+            self.handles[rank].poll() == 0
+            for rank in range(directive.target_n, len(self.handles))
+        )
+        if members_done and leavers_done:
+            self.last_reshard_s = time.monotonic() - started_at
+            for rank in range(directive.target_n, len(self.handles)):
+                self._drained_ranks.discard(rank)
+                self._clean_exit_at.pop(rank, None)
+                try:
+                    os.unlink(status_path(self._supervise_dir, rank))
+                except OSError:
+                    pass
+            del self.handles[directive.target_n:]
+            self.n = directive.target_n
+            clear_directive(self._supervise_dir)
+            self._transition = None
+            self._log(
+                f"membership change complete: cluster is n={self.n} at epoch "
+                f"{directive.epoch} ({self.last_reshard_s:.1f}s)"
+            )
+            return None
+        if (
+            self.membership_deadline_s > 0
+            and time.monotonic() - started_at > self.membership_deadline_s
+        ):
+            return (
+                0,
+                f"membership transition to n={directive.target_n} did not "
+                f"converge within {self.membership_deadline_s:.0f}s "
+                "(PATHWAY_MEMBERSHIP_DEADLINE_S)",
+            )
+        return None
 
     def _drain(self) -> None:
         """Briefly wait for survivors to exit on their own typed errors."""
@@ -337,8 +586,17 @@ class Supervisor:
         assert self._supervise_dir is not None
         while True:
             any_alive = False
-            statuses = read_statuses(self._supervise_dir, self.n)
+            statuses = read_statuses(self._supervise_dir, len(self.handles))
             up_for = time.monotonic() - self._launched_at
+            self._poll_scale_requests(statuses)
+            wedged_transition = self._watch_transition(statuses)
+            if wedged_transition is not None:
+                # a membership transition that will not converge: shoot the
+                # whole cluster and let run() recover down the ladder
+                for rank, handle in enumerate(self.handles):
+                    if handle.poll() is None:
+                        self._kill_wedged(rank, handle)
+                return wedged_transition
             if self._rejoining is not None:
                 rejoin_rank, started_at, target_epoch = self._rejoining
                 if len(statuses) == self.n and all(
@@ -394,6 +652,16 @@ class Supervisor:
                         )
                 elif rc != 0:
                     return (rank, describe_exit(rc))
+                elif self._is_expected_drain(rank, statuses):
+                    # a scale-down leaver exiting 0 after its handoff is the
+                    # PLANNED outcome, not a cluster failure
+                    if rank not in self._drained_ranks:
+                        self._drained_ranks.add(rank)
+                        self._log(
+                            f"rank {rank} drained for scale-down (handoff "
+                            "durable, journal shard compacted) and exited "
+                            "cleanly"
+                        )
                 else:
                     self._clean_exit_at.setdefault(rank, time.monotonic())
             if not any_alive:
@@ -406,6 +674,8 @@ class Supervisor:
             # window absorbs the normal millisecond exit stagger.
             grace = _env_float("PATHWAY_SUPERVISOR_DRAIN_S", DEFAULT_DRAIN_S)
             for rank, first_seen in self._clean_exit_at.items():
+                if self._is_expected_drain(rank, statuses):
+                    continue  # scale-down leaver: planned exit
                 if time.monotonic() - first_seen > grace:
                     return (
                         rank,
@@ -413,6 +683,19 @@ class Supervisor:
                         "incomplete",
                     )
             time.sleep(self.poll_interval_s)
+
+    def _is_expected_drain(self, rank: int, statuses: Dict[int, dict]) -> bool:
+        """Clean exit of a rank >= the in-flight shrink target, or a rank
+        whose last status reports it drained: planned, not a failure."""
+        if rank in self._drained_ranks:
+            return True
+        status = statuses.get(rank, {})
+        if status.get("membership_state") == "drained":
+            return True
+        if self._transition is not None:
+            directive = self._transition[0]
+            return directive.target_n < directive.from_n and rank >= directive.target_n
+        return False
 
     def _kill_wedged(self, rank: int, handle: subprocess.Popen) -> None:
         """Stall-kill: SIGTERM first with a short grace so the worker's
@@ -437,6 +720,46 @@ class Supervisor:
         except OSError:
             pass
         handle.wait()
+
+    def _adapt_topology_after_failure(self, statuses: Dict[int, dict]) -> None:
+        """Pick the worker count the next restart-all must use. The
+        membership manifest is a transition's atomic commit point: once any
+        rank reported it committed (or a relaunched rank hit the store's
+        typed :class:`MembershipMismatchError` and published the manifest's
+        count), recovery MUST run at the new topology — the old ranks'
+        checkpoints were superseded by the handoff fragments."""
+        from pathway_tpu.parallel.membership import clear_directive
+
+        adopted: "Optional[int]" = None
+        if self._transition is not None:
+            directive, _started = self._transition
+            if any(
+                s.get("membership_committed") == directive.generation
+                for s in statuses.values()
+            ):
+                adopted = directive.target_n
+            self._transition = None
+            clear_directive(self._supervise_dir)
+            self._log(
+                "in-flight membership transition aborted by the failure; "
+                + (
+                    f"its manifest committed — recovering at n={adopted}"
+                    if adopted is not None
+                    else f"its manifest never committed — recovering at n={self.n}"
+                )
+            )
+        for s in statuses.values():
+            mw = s.get("manifest_workers")
+            if mw:
+                # a relaunched child refused the store typed: the manifest
+                # names the authoritative count
+                adopted = int(mw)
+        if adopted is not None and adopted != self.n:
+            self._log(
+                f"adapting to the committed membership topology: n={self.n} "
+                f"-> n={adopted}"
+            )
+            self.n = adopted
 
     # -- reporting -------------------------------------------------------------
 
@@ -479,7 +802,12 @@ class Supervisor:
             parts = [describe_exit(rc)]
             # attribute the kill: operators triaging a post-mortem need to know
             # whether the supervisor shot this rank or something external
-            # (chaos plan, OOM killer, an operator's kill -9) got it first
+            # (chaos plan, OOM killer, an operator's kill -9) got it first —
+            # and a scale-down leaver's clean exit is PLANNED, not a crash
+            if rank in self._drained_ranks or (
+                status is not None and status.get("membership_state") == "drained"
+            ):
+                parts.append("drained for scale-down (planned exit, handoff durable)")
             if rank in self._killed_for_staleness:
                 parts.append("killed by supervisor for staleness")
             elif rank in self._terminated_by_us:
@@ -520,13 +848,14 @@ class Supervisor:
         """Supervise until clean completion (0) or final failure (nonzero)."""
         self._supervise_dir = tempfile.mkdtemp(prefix="pathway-supervise-")
         try:
+            self._start_control_endpoint()
             self._launch()
             while True:
                 failure = self._watch()
                 if failure is None:
                     return 0
                 failed_rank = failure[0]
-                statuses = read_statuses(self._supervise_dir, self.n)
+                statuses = read_statuses(self._supervise_dir, len(self.handles))
                 # restart only when the journal can actually restore the work:
                 # every reporting rank ran with persistence on (a rank that died
                 # before its first commit simply has no report and no journal
@@ -543,6 +872,11 @@ class Supervisor:
                     # means surgical recovery is not converging: fall through
                     # to restart-all
                     and self._rejoining is None
+                    # a death DURING a membership transition cannot be healed
+                    # rank-surgically — the topology itself is in flight:
+                    # restart-all at whichever topology the membership
+                    # manifest committed (adapted below)
+                    and self._transition is None
                     and self.handles[failed_rank].poll() is not None
                 ):
                     self.restarts_used += 1
@@ -562,11 +896,12 @@ class Supervisor:
                     self._relaunch_rank(failed_rank)
                     continue
                 self._drain()
-                statuses = read_statuses(self._supervise_dir, self.n)
+                statuses = read_statuses(self._supervise_dir, len(self.handles))
                 persistence_on = bool(statuses) and all(
                     s.get("persistence") for s in statuses.values()
                 )
                 self._terminate_all()
+                self._adapt_topology_after_failure(statuses)
                 if not persistence_on:
                     self._post_mortem(
                         failure,
@@ -604,6 +939,15 @@ class Supervisor:
                 self._launch()
         finally:
             self._terminate_all()
+            if self._control_listener is not None:
+                try:
+                    self._control_listener.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    self._control_listener.close()
+                except OSError:
+                    pass
             if self._supervise_dir is not None:
                 shutil.rmtree(self._supervise_dir, ignore_errors=True)
 

@@ -22,6 +22,7 @@ import io
 import json
 import os
 import pickle
+import shutil
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -197,51 +198,99 @@ class PersistenceManager:
             )
         workers = meta.get("workers")
         if workers != self._workers:
-            raise ValueError(
-                f"persisted {what} was written by a run with {workers} worker "
-                f"process(es) but this run uses {self._workers}: the journal is "
-                "sharded per worker, so resuming under a different count would "
-                "silently start from a different shard layout — rerun with the "
-                "original worker count or clear the persistence directory"
+            # typed (membership-aware): the supervisor reads manifest_n off
+            # this error's status report to adapt -n after a mid-transition
+            # crash, and operators get the --scale-vs-corrupt-store triage
+            from pathway_tpu.parallel.membership import MembershipMismatchError
+
+            raise MembershipMismatchError(
+                what,
+                manifest_n=workers,
+                current_n=self._workers,
+                epoch=int(meta.get("epoch", 0) or 0),
             )
+
+    def _write_store_meta(self) -> None:
+        payload = json.dumps(
+            {"workers": self._workers, "key_derivation": KEY_DERIVATION_VERSION},
+            sort_keys=True,
+        ).encode()
+        if self._object_store is not None:
+            self._object_store.put(_STORE_META, payload)
+            return
+        if self._memory or self._base_root is None:
+            return
+        os.makedirs(str(self._base_root), exist_ok=True)
+        path = os.path.join(str(self._base_root), _STORE_META)
+        # pid-unique temp: spawned replicas race to create the meta file
+        # concurrently; both write identical content, either rename may win
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def _check_store_meta(self) -> None:
         """Store-WIDE guard at the unsharded root: a run with a different worker
         count reads different ``process-{id}/`` shards (possibly none), so the
-        per-shard headers alone cannot catch the mismatch."""
+        per-shard headers alone cannot catch the mismatch.
+
+        Elastic-membership self-heal: the membership manifest is the COMMIT
+        POINT of a scale transition and the meta file is updated after it, so
+        a crash in between leaves meta naming the OLD count. When the newest
+        manifest agrees with THIS run's count, the meta write is simply
+        replayed; a genuine mismatch still refuses typed."""
         if self._object_store is not None:
             blob = self._object_store.get(_STORE_META)
             if blob is None:
-                self._object_store.put(
-                    _STORE_META,
-                    json.dumps(
-                        {"workers": self._workers, "key_derivation": KEY_DERIVATION_VERSION},
-                        sort_keys=True,
-                    ).encode(),
-                )
+                self._write_store_meta()
                 return
-            self._check_meta(json.loads(blob), "store")
-            return
-        if self._memory or self._base_root is None:
+            meta = json.loads(blob)
+        elif self._memory or self._base_root is None:
             return  # in-memory stores cannot be reopened by another run
-        path = os.path.join(str(self._base_root), _STORE_META)
-        if not os.path.exists(path):
-            os.makedirs(str(self._base_root), exist_ok=True)
-            # pid-unique temp: spawned replicas race to create the meta file
-            # concurrently; both write identical content, either rename may win
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(
-                    {"workers": self._workers, "key_derivation": KEY_DERIVATION_VERSION},
-                    f,
-                    sort_keys=True,
-                )
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-            return
-        with open(path) as f:
-            self._check_meta(json.load(f), "store")
+        else:
+            path = os.path.join(str(self._base_root), _STORE_META)
+            if not os.path.exists(path):
+                self._write_store_meta()
+                return
+            with open(path) as f:
+                meta = json.load(f)
+        from pathway_tpu.parallel.membership import MembershipMismatchError
+
+        try:
+            self._check_meta(meta, "store")
+        except MembershipMismatchError:
+            if self._newest_manifest_workers() == self._workers:
+                self._write_store_meta()
+                return
+            raise
+
+    def _newest_manifest_workers(self) -> "int | None":
+        """Worker count named by the newest parseable cluster manifest (the
+        authoritative topology record), or None when no manifest exists."""
+        best: "tuple | None" = None
+        for commit_id, raw in self._manifest_candidates():
+            if best is not None and commit_id <= best[0]:
+                continue
+            try:
+                meta = json.loads(raw)
+            except ValueError:
+                continue
+            if meta.get("commit_id") != commit_id:
+                continue
+            workers = meta.get("workers")
+            if workers is not None:
+                best = (commit_id, int(workers))
+        return best[1] if best is not None else None
+
+    def set_workers(self, workers: int) -> None:
+        """Adopt a new cluster worker count mid-run (the membership
+        transition, after its manifest committed): later snapshots, journal
+        headers, and manifests are stamped with it, and the store-wide meta
+        is brought up to date."""
+        self._workers = int(workers)
+        self._write_store_meta()
 
     def _validate_header_line(
         self, line: bytes, graph_sig: str, prefix_hint: str = "directory"
@@ -252,7 +301,19 @@ class PersistenceManager:
                 "persisted journal was written by a different dataflow graph; "
                 f"clear the persistence {prefix_hint} or keep the program unchanged"
             )
-        self._check_meta(meta, "journal")
+        from pathway_tpu.parallel.membership import MembershipMismatchError
+
+        try:
+            self._check_meta(meta, "journal")
+        except MembershipMismatchError:
+            # membership-transition crash window: the manifest (the commit
+            # point) already names THIS count but the shard crashed before
+            # compaction rewrote its header. Every frame <= the manifest
+            # commit is subsumed by it, so the stale header is harmless —
+            # the next compaction rewrites it. A header disagreeing with the
+            # manifest too is a genuine mismatch and still refuses.
+            if self._newest_manifest_workers() != self._workers:
+                raise
 
     def open_for_append(self, graph_sig: str) -> None:
         self._check_store_meta()
@@ -586,6 +647,258 @@ class PersistenceManager:
             return False
         return loaded is not None and loaded["commit_id"] == int(commit_id)
 
+    # -- elastic membership: handoff fragments + membership manifest ----------
+    #
+    # A membership transition (parallel/membership.py, driven by
+    # GraphRunner._membership_transition) reshards the cluster at one
+    # quiesced commit id C:
+    #   1. every OLD rank writes one handoff fragment per NEW rank under its
+    #      own shard (``process-r/reshard-C/frag-j.pkl``), read-back verified
+    #      — fragments are complete partitions, so the set of fragments
+    #      addressed to rank j IS rank j's full checkpoint at C;
+    #   2. rank 0 commits a MEMBERSHIP manifest: a cluster manifest whose
+    #      ``workers`` is the NEW count and whose snapshots are the fragment
+    #      sets — the atomic commit point of the transition (then the
+    #      store-wide meta is brought up to date, self-healed on crash);
+    #   3. every rank compacts its journal (frames <= C are subsumed).
+    # A joiner (or any rank recovering after the transition) cold-starts
+    # from the membership manifest + its fragments + the journal tail — the
+    # same bounded-recovery contract as a PR-6 replacement, never a
+    # full-history replay.
+
+    def _reshard_dir(self, commit_id: int) -> str:
+        return f"reshard-{commit_id:010d}"
+
+    def _fragment_name(self, commit_id: int, dest: int) -> str:
+        return f"{self._reshard_dir(commit_id)}/frag-{dest:05d}.pkl"
+
+    def dump_reshard_fragments(
+        self, graph_sig: str, commit_id: int, fragments: Dict[int, dict]
+    ) -> int:
+        """Write this rank's handoff fragments (one per new rank) under its
+        own shard, then READ EACH BACK and verify it unpickles — a torn
+        fragment must fail the transition's ack barrier, not poison a later
+        import. Returns total bytes written. Raises ``ConnectionError``/
+        ``OSError``/``ValueError`` on failure (incl. injected chaos faults);
+        the caller acks "transient" and the transition aborts cleanly."""
+        from pathway_tpu.internals.chaos import get_chaos
+
+        chaos = get_chaos()
+        total = 0
+        for dest, frag in sorted(fragments.items()):
+            payload = pickle.dumps(
+                {"sig": graph_sig, **frag}, protocol=pickle.HIGHEST_PROTOCOL
+            )
+            if chaos is not None and chaos.scale_fault(
+                "handoff_torn", self._rank_id()
+            ):
+                payload = payload[: max(1, len(payload) // 2)]  # torn write
+            name = self._fragment_name(commit_id, dest)
+            if self._object_store is not None:
+                key = f"{self._object_prefix}{name}"
+                self._object_store.put(key, payload)
+                back = self._object_store.get(key)
+            elif self._memory:
+                raise OSError(
+                    "membership handoff needs a durable persistence backend"
+                )
+            else:
+                path = os.path.join(self.root, name)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = path + f".tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                with open(path, "rb") as f:
+                    back = f.read()
+            try:
+                got = pickle.loads(back)
+            except Exception as exc:
+                raise ValueError(
+                    f"handoff fragment {name!r} failed read-back verification "
+                    "(torn write) — aborting this membership attempt"
+                ) from exc
+            if got.get("sig") != graph_sig or got.get("from_rank") != frag.get(
+                "from_rank"
+            ):
+                raise ValueError(
+                    f"handoff fragment {name!r} read back inconsistent — "
+                    "aborting this membership attempt"
+                )
+            total += len(payload)
+        return total
+
+    def load_reshard_fragments(
+        self, graph_sig: str, commit_id: int, dest: int, from_n: int
+    ) -> List[dict]:
+        """Every donor rank's fragment addressed to ``dest`` for the
+        transition at ``commit_id``. Loud on a missing or unreadable
+        fragment: the membership manifest promised the complete set."""
+        out: List[dict] = []
+        for donor in range(from_n):
+            name = self._fragment_name(commit_id, dest)
+            if self._object_store is not None:
+                payload = self._object_store.get(f"process-{donor}/{name}")
+            elif self._memory or self._base_root is None:
+                payload = None
+            else:
+                # membership transitions only exist for sharded stores
+                # (spawn -n >= 2), so donor shards are always process-<r>/
+                shard = os.path.join(str(self._base_root), f"process-{donor}")
+                try:
+                    with open(os.path.join(shard, name), "rb") as f:
+                        payload = f.read()
+                except OSError:
+                    payload = None
+            if payload is None:
+                raise ValueError(
+                    f"handoff fragment from rank {donor} for rank {dest} at "
+                    f"commit {commit_id} is missing; the membership manifest "
+                    "promised it — restore the store or clear the "
+                    "persistence directory"
+                )
+            try:
+                frag = pickle.loads(payload)
+            except Exception as exc:
+                raise ValueError(
+                    f"handoff fragment from rank {donor} for rank {dest} at "
+                    f"commit {commit_id} is unreadable"
+                ) from exc
+            if frag.get("sig") != graph_sig:
+                raise ValueError(
+                    "handoff fragment was written by a different dataflow "
+                    "graph; clear the persistence directory"
+                )
+            out.append(frag)
+        return out
+
+    def commit_membership_manifest(
+        self,
+        graph_sig: str,
+        commit_id: int,
+        *,
+        epoch: int,
+        from_n: int,
+        to_n: int,
+        generation: int,
+    ) -> bool:
+        """Rank 0 only: durably commit the MEMBERSHIP manifest — a cluster
+        manifest whose ``workers`` is the NEW count and whose per-rank
+        snapshot entries name the fragment sets. Read-back verified under
+        the NEW count; on success the store-wide meta adopts the new count
+        too. This is the transition's atomic commit point."""
+        meta = {
+            "format": 1,
+            "sig": graph_sig,
+            "commit_id": int(commit_id),
+            "epoch": int(epoch),
+            "workers": int(to_n),
+            "key_derivation": KEY_DERIVATION_VERSION,
+            "membership": {
+                "from_n": int(from_n),
+                "to_n": int(to_n),
+                "generation": int(generation),
+            },
+            "snapshots": {
+                str(rank): [
+                    f"process-{donor}/{self._fragment_name(commit_id, rank)}"
+                    for donor in range(from_n)
+                ]
+                for rank in range(to_n)
+            },
+        }
+        payload = json.dumps(meta, sort_keys=True).encode()
+        name = self._manifest_name(commit_id)
+        if self._object_store is not None:
+            self._object_store.put(name, payload)
+        else:
+            assert self._base_root is not None
+            os.makedirs(str(self._base_root), exist_ok=True)
+            tmp = os.path.join(str(self._base_root), name + f".tmp.{os.getpid()}")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(str(self._base_root), name))
+        # verification must read as the NEW topology reads
+        old_workers = self._workers
+        self._workers = int(to_n)
+        try:
+            loaded = self.load_cluster_manifest(graph_sig)
+        except ValueError:
+            self._workers = old_workers
+            return False
+        if loaded is None or loaded["commit_id"] != int(commit_id):
+            self._workers = old_workers
+            return False
+        self._workers = old_workers
+        return True
+
+    # -- leaver source park: a drained rank's source continuation -------------
+
+    def _park_name(self) -> str:
+        return "source-park.pkl"
+
+    def dump_source_park(self, graph_sig: str, commit_id: int, payload: dict) -> None:
+        """A draining leaver parks its rank-local source continuation
+        (offsets, consumed counters) in its own shard: a future joiner
+        reusing this rank id restores it and never re-ingests rows the rank
+        already contributed before it drained."""
+        blob = pickle.dumps(
+            {"sig": graph_sig, "commit_id": commit_id, "state": payload},
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        if self._object_store is not None:
+            self._object_store.put(f"{self._object_prefix}{self._park_name()}", blob)
+            return
+        if self._memory:
+            return
+        path = os.path.join(self.root, self._park_name())
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def load_source_park(self, graph_sig: str) -> "Optional[dict]":
+        """This rank's parked source continuation, if a previous incarnation
+        drained away. Torn/foreign parks are ignored (worst case the rank
+        starts its rank-local sources fresh, exactly like a brand-new rank)."""
+        payload: "bytes | None" = None
+        if self._object_store is not None:
+            payload = self._object_store.get(
+                f"{self._object_prefix}{self._park_name()}"
+            )
+        elif not self._memory:
+            try:
+                with open(os.path.join(self.root, self._park_name()), "rb") as f:
+                    payload = f.read()
+            except OSError:
+                payload = None
+        if payload is None:
+            return None
+        try:
+            data = pickle.loads(payload)
+        except Exception:
+            return None
+        if data.get("sig") != graph_sig:
+            return None
+        return data.get("state")
+
+    def clear_source_park(self) -> None:
+        try:
+            if self._object_store is not None:
+                self._object_store.delete(
+                    f"{self._object_prefix}{self._park_name()}"
+                )
+            elif not self._memory:
+                os.unlink(os.path.join(self.root, self._park_name()))
+        except OSError:
+            pass
+
     def _manifest_candidates(self) -> List[tuple]:
         """(commit_id, raw bytes) of every versioned manifest, unsorted."""
         out: List[tuple] = []
@@ -686,6 +999,13 @@ class PersistenceManager:
                         tail = base[len("checkpoint-"):-len(".pkl")]
                         if tail.isdigit() and int(tail) < keep_commit:
                             self._object_store.delete(key)
+                    elif "/reshard-" in f"/{key}" and base.startswith("frag-"):
+                        # handoff fragments of transitions superseded by a
+                        # newer durable checkpoint
+                        rdir = key.rsplit("/", 2)[-2]
+                        tail = rdir[len("reshard-"):]
+                        if tail.isdigit() and int(tail) < keep_commit:
+                            self._object_store.delete(key)
                 if self._rank_id() == 0:
                     for key in self._object_store.list(_CLUSTER_MANIFEST_PREFIX):
                         tail = key[len(_CLUSTER_MANIFEST_PREFIX):].split(".")[0]
@@ -702,6 +1022,12 @@ class PersistenceManager:
                             os.unlink(os.path.join(self.root, fname))
                         except OSError:
                             pass
+                elif fname.startswith("reshard-"):
+                    tail = fname[len("reshard-"):]
+                    if tail.isdigit() and int(tail) < keep_commit:
+                        shutil.rmtree(
+                            os.path.join(self.root, fname), ignore_errors=True
+                        )
             if self._rank_id() == 0 and self._base_root is not None:
                 for fname in os.listdir(str(self._base_root)):
                     if (

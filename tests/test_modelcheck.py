@@ -276,6 +276,122 @@ def test_encoder_service_lost_close_wakeup_deadlocks():
 
 
 # ---------------------------------------------------------------------------
+# elastic membership change (quiesce -> handoff -> manifest -> install)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.elastic
+def test_membership_grow_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.membership_model(2, 3), max_schedules=N_SCHEDULES, name="member-grow"
+    )
+    _BATTERY_SECONDS["membership"] = time.monotonic() - t0
+    assert result.ok, (
+        f"membership invariant failed on schedule {result.failing_schedule}: "
+        f"{result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.elastic
+def test_membership_shrink_invariants_hold_exhaustive():
+    result = explore(
+        pm.membership_model(3, 2), max_schedules=N_SCHEDULES, name="member-shrink"
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.elastic
+def test_membership_invariants_hold_seeded():
+    result = sweep_seeds(
+        pm.membership_model(2, 3), n_seeds=100, base_seed=41, name="member-seeded"
+    )
+    assert result.ok, f"seed {result.failing_seed}: {result.failure}"
+    assert result.distinct_schedules == 100
+
+
+@pytest.mark.elastic
+def test_membership_double_owner_bug_caught_and_replayable():
+    # a donor that keeps serving handed-off slots: two owners at one epoch
+    result = explore(
+        pm.membership_model(2, 3, bug="double_owner"),
+        max_schedules=300,
+        name="member-double-owner",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the double-owner window went undetected"
+    )
+    assert (
+        "owned by" in str(result.failure) or "duplicated" in str(result.failure)
+    )
+    with pytest.raises(InvariantViolation):
+        run_once(
+            pm.membership_model(2, 3, bug="double_owner"),
+            choices=result.failing_schedule,
+        )
+
+
+@pytest.mark.elastic
+def test_membership_orphan_range_bug_caught_and_replayable():
+    # one moved key range's fragment never lands: no owner has its rows
+    result = explore(
+        pm.membership_model(2, 3, bug="orphan_range"),
+        max_schedules=300,
+        name="member-orphan",
+    )
+    assert isinstance(result.failure, InvariantViolation)
+    assert "rows lost" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="rows lost"):
+        run_once(
+            pm.membership_model(2, 3, bug="orphan_range"),
+            choices=result.failing_schedule,
+        )
+
+
+@pytest.mark.elastic
+def test_membership_release_before_drain_bug_caught_with_seed():
+    # a leaver tearing down before its handoff is durable loses its rows
+    result = sweep_seeds(
+        pm.membership_model(3, 2, bug="release_before_drain"),
+        n_seeds=200,
+        base_seed=51,
+        name="member-early-release",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the leaver-released-before-drain regression went undetected"
+    )
+    assert "rows lost" in str(result.failure)
+    assert result.failing_seed is not None
+    with pytest.raises(InvariantViolation, match="rows lost"):
+        run_once(
+            pm.membership_model(3, 2, bug="release_before_drain"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.elastic
+def test_membership_epoch_before_install_bug_caught_and_replayable():
+    # the epoch bumps (and traffic resumes) before the ownership map
+    # installs: rows route to ranks that no longer own the slot
+    result = explore(
+        pm.membership_model(2, 3, bug="epoch_before_install"),
+        max_schedules=300,
+        name="member-early-epoch",
+    )
+    assert isinstance(result.failure, InvariantViolation)
+    assert "non-owner" in str(result.failure) or "released leavers" in str(
+        result.failure
+    )
+    with pytest.raises(InvariantViolation):
+        run_once(
+            pm.membership_model(2, 3, bug="epoch_before_install"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
 # PWA101 <-> model check: the same inversion caught both ways
 # ---------------------------------------------------------------------------
 
@@ -332,7 +448,7 @@ def test_model_check_battery_within_budget():
     # the acceptance batteries above recorded their own wall time (no work is
     # redone here); each 200-schedule explore is a few seconds solo, and the
     # documented <60 s budget must hold even under full-suite load
-    if set(_BATTERY_SECONDS) != {"fence", "ckpt", "encsvc"}:
+    if set(_BATTERY_SECONDS) != {"fence", "ckpt", "encsvc", "membership"}:
         pytest.skip("acceptance batteries did not run in this session (-k selection)")
     total = sum(_BATTERY_SECONDS.values())
     assert total < 60, f"model-check acceptance batteries too slow: {_BATTERY_SECONDS}"
