@@ -2209,46 +2209,252 @@ def bench_elastic() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-SUB_BENCHES: dict = {
-    "knn": lambda: bench_knn(),
-    "ivfscale": lambda: bench_ivf_scale(),
-    "embedder": lambda: bench_embedder(),
-    "embedpipe": lambda: bench_embedpipe(),
-    "encsvc": lambda: bench_encsvc(),
-    "window": lambda: bench_streaming_window(),
-    "engine": lambda: bench_engine(),
-    "fusion": lambda: bench_fusion(),
-    "telemetry": lambda: bench_telemetry(),
-    "vectorstore": lambda: bench_vector_store(),
-    "vsfloor": lambda: bench_vs_floor(),
-    "sharded": lambda: bench_sharded(),
-    "scale": lambda: bench_scale(),
-    "rejoin": lambda: bench_rejoin(),
-    "elastic": lambda: bench_elastic(),
-}
+def bench_autoscale() -> dict:
+    """Closed-loop autoscaler headline: a ramping synthetic load at n=2 must
+    scale the cluster to 4 and back to 2 with NO operator input. The load
+    profile is a chaos-plan ``load_spike`` (deterministic; the same op the
+    tests replay), fed as CSV files whose rate follows ``Chaos.load_rate``.
+    Reports time-to-scale (spike start -> cluster stable at n=4, observed
+    through the supervisor control endpoint's ``status`` command), the shed
+    rate the controller saw during the scale window, the reshard pauses, and
+    a NO-FLAP honesty key: exactly one transition per direction, flap lock
+    never engaged, final delivered counts exact. CPU-only (localhost
+    cluster) — honest on any host."""
+    import re
+    import shutil
+    import socket as socket_mod
+    import tempfile
 
+    from pathway_tpu.internals.chaos import Chaos
+
+    base_rate = 80.0 if DEVICE_SCALE_DOWN else 140.0
+    spike_rate = 650.0 if DEVICE_SCALE_DOWN else 1100.0
+    spike_at_s, spike_len_s = 4.0, 9.0
+    feed_total_s = 20.0
+    rows_per_worker = 180.0 if DEVICE_SCALE_DOWN else 300.0
+    load = Chaos(0, {"load": {
+        "op": "load_spike", "at_s": spike_at_s, "duration_s": spike_len_s,
+        "low": base_rate, "high": spike_rate,
+    }})
+    tmp = tempfile.mkdtemp(prefix="pw-bench-autoscale-")
+    res: dict = {}
+    proc = None
+    try:
+        os.makedirs(os.path.join(tmp, "in"))
+        prog = os.path.join(tmp, "prog.py")
+        with open(prog, "w") as f:
+            f.write(_ELASTIC_PROG)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.abspath(__file__))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        env["PW_BENCH_TMP"] = tmp
+        env["PATHWAY_HEARTBEAT_INTERVAL_S"] = "0.2"
+        env["PATHWAY_BARRIER_TIMEOUT_S"] = "120"
+        env["PATHWAY_MEMBERSHIP_DEADLINE_S"] = "90"
+        env["PATHWAY_AUTOSCALE"] = "on"
+        env["PATHWAY_AUTOSCALE_MIN"] = "2"
+        env["PATHWAY_AUTOSCALE_MAX"] = "4"
+        env["PATHWAY_AUTOSCALE_ROWS_PER_WORKER"] = str(rows_per_worker)
+        env["PATHWAY_AUTOSCALE_SAMPLE_S"] = "0.5"
+        env["PATHWAY_AUTOSCALE_UP_SAMPLES"] = "2"
+        env["PATHWAY_AUTOSCALE_DOWN_SAMPLES"] = "4"
+        env["PATHWAY_AUTOSCALE_UP_COOLDOWN_S"] = "2"
+        env["PATHWAY_AUTOSCALE_DOWN_COOLDOWN_S"] = "4"
+        env["PATHWAY_AUTOSCALE_FLAP_WINDOW_S"] = "60"
+        env["PATHWAY_AUTOSCALE_FLAP_REVERSALS"] = "3"
+        _REJOIN_PORT_SALT[0] += 1
+        first_port = 23400 + (os.getpid() * 16 + _REJOIN_PORT_SALT[0] * 4) % 2600
+        control_port = first_port + 1299
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "pathway_tpu.cli", "spawn",
+                "-n", "2", "--first-port", str(first_port),
+                "--max-restarts", "2",
+                "--control-port", str(control_port),
+                sys.executable, prog,
+            ],
+            env=env, cwd=tmp, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        )
+
+        def _control_status() -> dict:
+            try:
+                with socket_mod.create_connection(
+                    ("127.0.0.1", control_port), timeout=2.0
+                ) as conn:
+                    conn.sendall(b"status\n")
+                    buf = b""
+                    while not buf.endswith(b"\n"):
+                        chunk = conn.recv(4096)
+                        if not chunk:
+                            break
+                        buf += chunk
+                return json.loads(buf.decode())
+            except (OSError, ValueError):
+                return {}
+
+        def _total() -> int:
+            total = 0
+            for p in range(4):
+                try:
+                    with open(os.path.join(tmp, f"out_{p}.json")) as f:
+                        total += sum(r["total"] for r in json.load(f))
+                except (OSError, ValueError):
+                    pass
+            return total
+
+        # feed at the chaos-plan load profile; observe topology through the
+        # control endpoint's status command on a fixed clock
+        fed = 0
+        i = 0
+        t0 = time.monotonic()
+        seen_n: list = []  # (elapsed, n, max_shed_rate)
+        carry = 0.0
+        last_tick = 0.0
+        while True:
+            elapsed = time.monotonic() - t0
+            if elapsed >= feed_total_s:
+                break
+            rate = load.load_rate(elapsed)
+            if rate is None:  # 0.0 is a legitimate idle rate, not "no profile"
+                rate = base_rate
+            carry += rate * max(0.0, elapsed - last_tick)
+            last_tick = elapsed
+            rows = int(carry)
+            if rows > 0:
+                carry -= rows
+                with open(os.path.join(tmp, "in", f"f{i:06d}.csv"), "w") as f:
+                    f.write("word\n" + f"w{i % 23}\n" * rows)
+                fed += rows
+                i += 1
+            status = _control_status()
+            if status:
+                ctrl = status.get("autoscaler") or {}
+                signals = ctrl.get("signals") or {}
+                seen_n.append((
+                    elapsed,
+                    int(status.get("n") or 0),
+                    float(signals.get("shed_rate") or 0.0),
+                ))
+            time.sleep(0.1)
+        # convergence: everything fed is delivered exactly once (and the
+        # cluster is back at n=2 — the scale-in under the fading load)
+        conv_deadline = time.monotonic() + 90
+        back_to_2 = None
+        while time.monotonic() < conv_deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(f"spawn exited early rc={proc.returncode}")
+            status = _control_status()
+            n_now = int(status.get("n") or 0) if status else 0
+            if back_to_2 is None and n_now == 2 and any(
+                n == 4 for _t, n, _s in seen_n
+            ):
+                back_to_2 = time.monotonic() - t0
+            if _total() == fed and n_now == 2 and not status.get(
+                "transition_in_flight"
+            ):
+                break
+            time.sleep(0.2)
+        if _total() != fed:
+            raise RuntimeError(f"no convergence: fed {fed}, got {_total()}")
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        _out, err = proc.communicate(timeout=30)
+        proc = None
+        first_at_4 = next((t for t, n, _s in seen_n if n >= 4), None)
+        res["autoscale_time_to_scale_s"] = (
+            round(first_at_4 - spike_at_s, 2) if first_at_4 is not None else None
+        )
+        res["autoscale_scale_in_at_s"] = (
+            round(back_to_2, 2) if back_to_2 is not None else None
+        )
+        res["autoscale_shed_rate_window_max"] = round(
+            max((s for _t, _n, s in seen_n), default=0.0), 2
+        )
+        pauses = [
+            float(m)
+            for m in re.findall(
+                r"membership transition to n=\d+ complete .* in ([0-9.]+)s", err
+            )
+        ]
+        res["autoscale_reshard_pause_max_s"] = (
+            round(max(pauses), 3) if pauses else None
+        )
+        res["autoscale_ingest_rows_per_s"] = round(fed / feed_total_s, 1)
+        requested = re.findall(r"membership change requested: n=\d+ -> n=(\d+)", err)
+        # honesty keys: scaled out AND back with no operator input, exactly
+        # one transition per direction, the flap lock never engaged, counts
+        # exact — an autoscaler that oscillates or loses rows fails loudly
+        res["autoscale_transitions"] = len(requested)
+        res["autoscale_no_flap"] = bool(
+            len(requested) == 2
+            and "FLAP-LOCKED" not in err
+            and "membership change complete: cluster is n=4" in err
+            and "membership change complete: cluster is n=2" in err
+            and "restarting the cluster" not in err
+        )
+        res["autoscale_exact"] = _total() == fed
+        return res
+    finally:
+        if proc is not None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.communicate()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# -- section registry ---------------------------------------------------------
+#
+# One registration per section derives the runner table, the device-bound set,
+# AND both deadline tables — a section can no longer be added without
+# deadlines (a missing entry used to KeyError the orchestrator at run time).
+
+SUB_BENCHES: dict = {}
 # sections whose numbers require the device; everything else is a CPU-vs-CPU
 # comparison that stays honest (and full-scale) on any host. embedpipe's
 # RATIOS (overlap/coalesce/cache speedups) are same-host comparisons that stay
 # honest anywhere, but its absolute docs/s are encoder-bound — it scales down
 # with the embedder section on fallback.
-DEVICE_BOUND = {"knn", "embedder", "embedpipe", "encsvc", "vectorstore", "scale"}
-
+DEVICE_BOUND: set = set()
 # per-sub-bench wall deadlines (seconds): generous on device, tight at toy scale
-_DEADLINES_FULL = {
-    "knn": 600, "ivfscale": 900, "embedder": 420, "embedpipe": 600,
-    "encsvc": 600, "window": 300,
-    "engine": 600, "fusion": 600, "telemetry": 420, "vectorstore": 600,
-    "vsfloor": 300, "sharded": 660, "scale": 1500, "rejoin": 420,
-    "elastic": 300,
-}
-_DEADLINES_SMALL = {
-    "knn": 300, "ivfscale": 900, "embedder": 240, "embedpipe": 420,
-    "encsvc": 420, "window": 300,
-    "engine": 600, "fusion": 420, "telemetry": 420, "vectorstore": 300,
-    "vsfloor": 300, "sharded": 660, "scale": 420, "rejoin": 300,
-    "elastic": 240,
-}
+_DEADLINES_FULL: dict = {}
+_DEADLINES_SMALL: dict = {}
+
+
+def _register_section(
+    name: str, fn, *, full: int = 600, small: int = 300, device_bound: bool = False
+) -> None:
+    SUB_BENCHES[name] = fn
+    _DEADLINES_FULL[name] = full
+    _DEADLINES_SMALL[name] = small
+    if device_bound:
+        DEVICE_BOUND.add(name)
+
+
+_register_section("knn", lambda: bench_knn(), full=600, small=300, device_bound=True)
+_register_section("ivfscale", lambda: bench_ivf_scale(), full=900, small=900)
+_register_section("embedder", lambda: bench_embedder(), full=420, small=240, device_bound=True)
+_register_section("embedpipe", lambda: bench_embedpipe(), full=600, small=420, device_bound=True)
+_register_section("encsvc", lambda: bench_encsvc(), full=600, small=420, device_bound=True)
+_register_section("window", lambda: bench_streaming_window(), full=300, small=300)
+_register_section("engine", lambda: bench_engine(), full=600, small=600)
+_register_section("fusion", lambda: bench_fusion(), full=600, small=420)
+_register_section("telemetry", lambda: bench_telemetry(), full=420, small=420)
+_register_section("vectorstore", lambda: bench_vector_store(), full=600, small=300, device_bound=True)
+_register_section("vsfloor", lambda: bench_vs_floor(), full=300, small=300)
+_register_section("sharded", lambda: bench_sharded(), full=660, small=660)
+_register_section("scale", lambda: bench_scale(), full=1500, small=420, device_bound=True)
+_register_section("rejoin", lambda: bench_rejoin(), full=420, small=300)
+_register_section("elastic", lambda: bench_elastic(), full=300, small=240)
+_register_section("autoscale", lambda: bench_autoscale(), full=360, small=300)
 
 
 def _terminate_gently(proc: subprocess.Popen, grace: float = 15.0) -> None:
@@ -2432,5 +2638,17 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--sub":
         _child_main(sys.argv[2])
+    elif len(sys.argv) == 2 and sys.argv[1] in SUB_BENCHES:
+        # `bench.py NAME` is an alias for `--sub NAME` — it used to silently
+        # ignore the name and run EVERY section
+        _child_main(sys.argv[1])
+    elif len(sys.argv) >= 2:
+        print(
+            f"bench.py: unknown section {sys.argv[1]!r}\n"
+            f"usage: bench.py [NAME | --sub NAME]   (no args = all sections)\n"
+            f"sections: {', '.join(sorted(SUB_BENCHES))}",
+            file=sys.stderr,
+        )
+        sys.exit(2)
     else:
         main()

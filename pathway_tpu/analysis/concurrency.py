@@ -66,7 +66,9 @@ RUNTIME_MODULES: Tuple[str, ...] = (
     "pathway_tpu/parallel/cluster.py",
     "pathway_tpu/parallel/supervisor.py",
     "pathway_tpu/parallel/membership.py",
+    "pathway_tpu/parallel/autoscaler.py",
     "pathway_tpu/parallel/threads.py",
+    "pathway_tpu/engine/brownout.py",
     "pathway_tpu/models/embed_pipeline.py",
     "pathway_tpu/models/encoder_service.py",
     "pathway_tpu/engine/http_server.py",

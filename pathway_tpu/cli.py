@@ -31,6 +31,7 @@ def _plural(n: int, singular: str, plural: str) -> str:
 def _spawn_program(
     *, threads, processes, first_port, program, arguments, env_base,
     max_restarts=0, restart_mode="surgical", scale=None, control_port=None,
+    autoscale=None,
 ):
     """Launch the cluster under the supervisor (``parallel/supervisor.py``):
     child exit codes and per-rank heartbeat status are monitored. On a worker
@@ -60,6 +61,7 @@ def _spawn_program(
         restart_mode=restart_mode,
         scale_plan=scale_plan,
         control_port=control_port,
+        autoscale=autoscale,
     )
     sys.exit(supervisor.run())
 
@@ -115,12 +117,24 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
     metavar="PORT",
     default=None,
     help="supervisor control endpoint: `echo 'scale N' | nc 127.0.0.1 PORT` "
-    "resizes the live cluster (the autoscaler hook)",
+    "resizes the live cluster; `echo status | nc ...` reports topology + "
+    "autoscale-controller state (0 = pick a free port)",
+)
+@click.option(
+    "--autoscale",
+    is_flag=True,
+    default=False,
+    help="closed-loop autoscaler: the supervisor samples the workers' load "
+    "signals (ingest rate, shed counters, barrier waits, brownout rung) and "
+    "resizes the cluster through the elastic-membership path with no "
+    "operator input — damped by hysteresis bands, per-direction cooldowns, "
+    "refusal backoff, and a flap lock (PATHWAY_AUTOSCALE_* env knobs tune; "
+    "PATHWAY_AUTOSCALE=on enables without this flag)",
 )
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
 def spawn(threads, processes, first_port, record, record_path, max_restarts,
-          restart_mode, scale, control_port, program, arguments):
+          restart_mode, scale, control_port, autoscale, program, arguments):
     env = os.environ.copy()
     if record:
         env["PATHWAY_REPLAY_STORAGE"] = record_path
@@ -137,6 +151,7 @@ def spawn(threads, processes, first_port, record, record_path, max_restarts,
         restart_mode=restart_mode.lower(),
         scale=scale,
         control_port=control_port,
+        autoscale=True if autoscale else None,
     )
 
 

@@ -151,6 +151,46 @@ def histograms() -> Dict[str, LogHistogram]:
         return dict(_histograms)
 
 
+def autoscale_signals(input_rows: "int | None" = None) -> Dict[str, float]:
+    """One worker's autoscale-signal sample for its supervisor status file
+    (``parallel/autoscaler.py`` aggregates these across ranks and the
+    controller diffs the cumulative counters between samples):
+
+    - ``input_rows``   — cumulative source rows ingested (the rate signal;
+      the runner passes its ProberStats total);
+    - ``shed``         — cumulative shed requests (embed + REST admission);
+    - ``barrier_wait_s`` — cumulative exchange barrier-wait seconds (the
+      straggler/imbalance signal, attributed per peer on /metrics);
+    - ``commit_p99_s`` — commit-duration p99 (0 while profiling is off);
+    - ``brownout_level`` — the serving plane's engaged degradation rung.
+
+    Cheap by construction: two dict snapshots and one histogram quantile —
+    called at the status-file cadence (~4/s), never per row."""
+    from pathway_tpu.engine import telemetry
+
+    stages = telemetry.stage_snapshot()
+    commit_hist = histograms().get("pathway_commit_duration_seconds")
+    try:
+        from pathway_tpu.engine.brownout import get_brownout
+
+        brownout_level = get_brownout().level()
+    except Exception:
+        brownout_level = 0
+    return {
+        "input_rows": float(input_rows or 0),
+        "shed": float(
+            stages.get("embed.shed", 0.0) + stages.get("rest.shed", 0.0)
+        ),
+        "barrier_wait_s": float(stages.get("exchange.barrier_wait_s", 0.0)),
+        "commit_p99_s": (
+            float(commit_hist.quantile(0.99))
+            if commit_hist is not None and commit_hist.count
+            else 0.0
+        ),
+        "brownout_level": float(brownout_level),
+    }
+
+
 # -- per-commit profiles ------------------------------------------------------
 
 

@@ -17,6 +17,7 @@ import numpy as np
 
 from pathway_tpu.engine.columnar import Delta, StateTable
 from pathway_tpu.engine.profile import CommitProfile
+from pathway_tpu.engine.profile import autoscale_signals as _autoscale_signals
 from pathway_tpu.internals import parse_graph as pg
 
 
@@ -107,6 +108,13 @@ class GraphRunner:
         self._membership_left = False  # this rank drained away (leaver)
         self._member_join_gen: "int | None" = None  # joiner: generation joined
         self._mismatch_workers: "int | None" = None  # store-vs-run worker count
+        # autoscale observability (parallel/autoscaler.py): the supervisor
+        # exports its controller state to the supervise dir; workers mirror it
+        # into /healthz + the flight recorder so flap-locks and decisions are
+        # visible from inside the cluster
+        self._autoscale_state: "Dict[str, Any] | None" = None
+        self._autoscale_seen_gen = -1
+        self._autoscale_last_read = 0.0
 
     def state_of(self, node: pg.Node) -> StateTable:
         if node.id not in self._materialized:
@@ -1215,6 +1223,7 @@ class GraphRunner:
             return
         from pathway_tpu.parallel.supervisor import write_status
 
+        self._mirror_autoscale_state(now)
         health = self.health()
         write_status(
             self._supervise_dir,
@@ -1237,10 +1246,52 @@ class GraphRunner:
                     "membership_committed",
                     "membership_refused",
                     "manifest_workers",
+                    "autoscale",
                 )
             },
         )
         self._last_status_write = now
+
+    def _mirror_autoscale_state(self, now: float) -> None:
+        """Mirror the supervisor's autoscale-controller state file into this
+        worker's observability surfaces (throttled to ~1/s): ``/healthz``
+        shows the controller state + last decision, decision changes bump
+        ``autoscale.decisions``, and a flap-lock engaging lands an
+        ``autoscale`` flight event — post-mortems then carry the controller's
+        story next to the commit timeline."""
+        if self._supervise_dir is None or now - self._autoscale_last_read < 1.0:
+            return
+        self._autoscale_last_read = now
+        from pathway_tpu.engine import telemetry
+        from pathway_tpu.parallel.autoscaler import read_state
+
+        state = read_state(self._supervise_dir)
+        if state is None:
+            return
+        gen = int(state.get("generation", 0) or 0)
+        prev = self._autoscale_state
+        self._autoscale_state = state
+        if gen == self._autoscale_seen_gen:
+            return
+        self._autoscale_seen_gen = gen
+        # the generation bumps on EVERY controller state change (issue,
+        # refusal, completion, recovery re-arm) — count a DECISION only when
+        # the last-decision record itself changed
+        if state.get("last_decision") != (prev or {}).get("last_decision"):
+            telemetry.stage_add("autoscale.decisions")
+        was_locked = bool(prev and prev.get("flap_locked"))
+        if state.get("flap_locked") and not was_locked:
+            telemetry.stage_add("autoscale.flap_locks")
+        if self._recorder is not None:
+            last = state.get("last_decision") or {}
+            self._recorder.record_event(
+                "autoscale",
+                state=state.get("state"),
+                flap_locked=bool(state.get("flap_locked")),
+                decision=last.get("kind"),
+                target_n=last.get("target_n"),
+                reason=str(last.get("reason", ""))[:160],
+            )
 
     def _substep(self, *, neu: bool) -> bool:
         if not neu:
@@ -1508,6 +1559,16 @@ class GraphRunner:
             "membership_committed": self._member_committed_gen,
             "membership_refused": self._member_refused,
             "manifest_workers": self._mismatch_workers,
+            # autoscale observability: this rank's published load signals and
+            # the mirrored controller state (flap-lock visible in /healthz)
+            "autoscale": _autoscale_signals(
+                input_rows=(
+                    self.prober_stats.input_rows
+                    if self.prober_stats is not None
+                    else None
+                )
+            ),
+            "autoscaler": self._autoscale_state,
         }
 
     # -- elastic mesh membership (MEMBERSHIP_CHANGE; parallel/membership.py) ---
@@ -1669,6 +1730,19 @@ class GraphRunner:
         self._member_in_flight = True
         self._membership_state = "draining" if leaving else "resharding"
         telemetry.stage_add("cluster.reshard_attempts")
+        # quiesce window: the commit loop is paused from here until resume —
+        # the REST plane sheds with 429 + the expected remaining pause as an
+        # honest Retry-After instead of letting clients hang on a paused
+        # engine (engine/brownout.py; chaos-tested)
+        from pathway_tpu.engine.brownout import get_brownout
+        from pathway_tpu.engine.profile import histograms as _histograms
+
+        _reshard_hist = _histograms().get("pathway_reshard_duration_seconds")
+        get_brownout().enter_quiesce(
+            _reshard_hist.quantile(0.5)
+            if _reshard_hist is not None and _reshard_hist.count
+            else 1.0
+        )
         if self._recorder is not None:
             self._recorder.record_event(
                 "membership",
@@ -1693,6 +1767,15 @@ class GraphRunner:
             plan = ms.compute_reshard_plan(self)
             refusals = list(plan.refusals)
             refusals.extend(ms.preflight_sources(self, new_n, self._rank))
+            if self._chaos is not None and self._chaos.scale_fault(
+                "scale_refused", self._rank
+            ):
+                # deterministic refusal injection: the autoscaler's typed
+                # refusal-backoff path is exercised without needing a
+                # non-reshardable graph in the test program
+                refusals.append(
+                    "chaos: injected preflight refusal (scale_refused)"
+                )
             ok_votes = cluster.allgather(
                 f"member:ready:{gen}:{commit}".encode(),
                 refusals[0] if refusals else None,
@@ -1885,6 +1968,7 @@ class GraphRunner:
         finally:
             import sys as _sys
 
+            get_brownout().exit_quiesce()
             if _sys.exc_info()[0] is None:
                 self._member_in_flight = False
             else:

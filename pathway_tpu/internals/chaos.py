@@ -34,7 +34,19 @@ Environment contract::
          "backend": {"put_error_prob": 0.5, "max_errors": 4},
          "checkpoint": [{"op": "post_snapshot_kill", "rank": 0, "run": 0, "at": 1}],
          "scale": [{"op": "scale_join_kill", "rank": 2, "run": 0, "at": 0}],
+         "load": {"op": "oscillating_load", "period_s": 4.0,
+                  "low": 50, "high": 400},
          "sched": {"seed": 7}}
+
+``load`` shapes a DETERMINISTIC synthetic offered-load profile for the
+autoscaler/backpressure tests and the ``bench.py autoscale`` section — load
+generators consult :meth:`Chaos.load_rate` the way the engine consults kill
+schedules, so an overload scenario replays exactly. Ops: ``load_spike``
+(``low`` rows/s, stepping to ``high`` at ``at_s`` for ``duration_s``),
+``oscillating_load`` (square wave between ``low``/``high`` every
+``period_s`` — the flap-lock scenario), and ``noisy_neighbor`` (flood
+parameters one REST client applies while the others stay polite:
+``client``/``rps``/``rows``; read via :meth:`Chaos.noisy_neighbor`).
 
 ``sched`` pins the deterministic model-check scheduler's seed
 (``internals/sched.py`` — :meth:`Chaos.sched_seed`): a chaos plan can name the
@@ -107,6 +119,7 @@ class Chaos:
         self._scale: List[Dict[str, Any]] = [
             dict(e) for e in (plan.get("scale") or [])
         ]
+        self._load: Dict[str, Any] = dict(plan.get("load") or {})
         self._streams: Dict[str, random.Random] = {}
         self._backend_errors_left = int(self._backend.get("max_errors", 3))
         # coordinated-checkpoint attempt counter: bumped by the runner at the
@@ -245,7 +258,10 @@ class Chaos:
           verification must fail the attempt's ack barrier, previous state
           stands, the transition retries);
         - ``dropped_scale_handshake`` — drop a joiner's membership hello so
-          its wiring fails typed and the supervisor escalates.
+          its wiring fails typed and the supervisor escalates;
+        - ``scale_refused``    — inject a preflight-vote refusal (the runner
+          appends a synthetic refusal reason), exercising the autoscaler's
+          typed refusal-backoff path without a non-reshardable graph.
 
         ``at`` defaults to every attempt; ``run`` defaults to every
         incarnation (joiner relaunches bump PATHWAY_RESTART_COUNT, the
@@ -290,6 +306,44 @@ class Chaos:
         except Exception:
             pass  # the kill must fire regardless
         os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- synthetic load profiles -----------------------------------------------
+
+    def load_rate(self, elapsed_s: float) -> "Optional[float]":
+        """Offered rows/s at ``elapsed_s`` into the run per the plan's
+        ``load`` op, or None when no load profile is configured. A pure
+        function of the plan and elapsed time — the autoscaler acceptance
+        scenarios (ramp, spike, oscillation) replay exactly.
+
+        - ``load_spike``: ``low`` until ``at_s``, then ``high`` for
+          ``duration_s``, then ``low`` again;
+        - ``oscillating_load``: square wave — ``high`` for the first half of
+          every ``period_s`` window, ``low`` for the second (the scenario the
+          controller's flap lock must survive)."""
+        op = self._load.get("op")
+        if op not in ("load_spike", "oscillating_load"):
+            return None
+        low = float(self._load.get("low", 0.0))
+        high = float(self._load.get("high", low))
+        if op == "load_spike":
+            at_s = float(self._load.get("at_s", 0.0))
+            duration_s = float(self._load.get("duration_s", 1.0))
+            return high if at_s <= elapsed_s < at_s + duration_s else low
+        period_s = max(1e-6, float(self._load.get("period_s", 2.0)))
+        return high if (elapsed_s % period_s) < period_s / 2.0 else low
+
+    def noisy_neighbor(self) -> "Optional[Dict[str, Any]]":
+        """Flood parameters for the noisy-neighbor REST scenario (one client
+        hammers ``/v1/retrieve`` while the others stay polite), or None.
+        Keys: ``client`` (the flooding client id, default "noisy"), ``rps``
+        (its request rate), ``rows`` (texts per request)."""
+        if self._load.get("op") != "noisy_neighbor":
+            return None
+        return {
+            "client": str(self._load.get("client", "noisy")),
+            "rps": float(self._load.get("rps", 100.0)),
+            "rows": int(self._load.get("rows", 4)),
+        }
 
     # -- deterministic schedule seeds ------------------------------------------
 

@@ -962,6 +962,235 @@ def membership_model(
 
 
 # ---------------------------------------------------------------------------
+# closed-loop autoscaler: sample -> decide -> directive -> transition outcome
+# ---------------------------------------------------------------------------
+
+
+def autoscaler_model(
+    *,
+    ticks: int = 10,
+    high_ticks: int = 6,
+    cooldown: int = 3,
+    backoff: int = 4,
+    refuse_up: bool = False,
+    crash_up: bool = False,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The autoscale control loop (``parallel/autoscaler.py``) against the
+    membership-transition executor (the supervisor's ``request_scale`` /
+    ``_watch_transition`` path), modeled BEFORE the real controller was wired
+    (the PR-9 discipline). A controller thread ticks ``ticks`` times over a
+    scripted load profile (overload for the first ``high_ticks`` ticks, idle
+    after), engaging the brownout rung FIRST and only then deciding scale
+    directions; an executor thread consumes issued directives and either
+    completes them, REFUSES the first scale-up (``refuse_up`` — the preflight
+    vote), or dies mid-flight (``crash_up`` — the manifest committed, so the
+    recovery thread brings the cluster back STABLE at the new topology).
+    Model time is the controller's tick counter, so cooldown/backoff windows
+    are exact whatever the interleaving.
+
+    Invariants over every interleaving: never two transitions in flight (a
+    directive is only issued with none active), consecutive directives
+    respect the cooldown window, a refused scale-up is never retried inside
+    its backoff window (at most one retry per window), every overload-driven
+    scale-up is preceded by a brownout engage (shed first, scale second), no
+    directive is issued while the cluster is recovering from the mid-flight
+    crash, and the protocol never deadlocks.
+
+    Planted bugs (each must be CAUGHT with a replayable schedule):
+    ``"double_directive"`` — the controller skips the in-flight check, so a
+    slow transition overlaps a second directive; ``"cooldown_skip"`` — the
+    cooldown gate is dropped, back-to-back directives storm the transition
+    path; ``"refusal_retry"`` — the refusal backoff is ignored, the refused
+    scale-up is hammered every eligible tick; ``"no_shed_first"`` — the
+    controller scales on overload without engaging the brownout rung."""
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("autoscale")
+        cv = sched.condition(lock, name="autoscale.cv")
+        state: Dict[str, Any] = {
+            "n": 1,
+            "cluster": "stable",  # stable | recovering
+            "in_flight": 0,
+            "queue": [],  # (issue_tick, direction, target)
+            "issued": [],  # (issue_tick, direction, target)
+            "completed": [],
+            "refusals": [],  # (issue_tick, target)
+            "refused": None,  # pending feedback for the controller
+            "backoff_until": None,
+            "last_issue_tick": None,
+            "brownout": 0,
+            "events": [],  # ordered: ("brownout"|"issue_up"|"issue_down"|"refusal_backoff", tick)
+            "overlap": 0,  # directives issued while one was in flight
+            "unstable_issue": 0,  # directives issued while recovering
+            "crashed": False,
+            "recover_to": None,
+            "done": False,
+        }
+
+        def controller_body() -> None:
+            for tick in range(ticks):
+                pressure = 2 if tick < high_ticks else 0
+                with cv:
+                    if state["refused"] is not None:
+                        state["refused"] = None
+                        state["backoff_until"] = tick + backoff
+                        state["events"].append(("refusal_backoff", tick))
+                    # shed first: the brownout rung engages before any scale
+                    # decision is even considered
+                    if bug != "no_shed_first":
+                        if pressure >= 2 and state["brownout"] == 0:
+                            state["brownout"] = 1
+                            state["events"].append(("brownout", tick))
+                        elif pressure <= 0:
+                            state["brownout"] = 0
+                    direction = None
+                    if pressure >= 2 and (
+                        state["brownout"] > 0 or bug == "no_shed_first"
+                    ):
+                        direction = "up"
+                    elif pressure <= 0 and state["n"] > 1:
+                        direction = "down"
+                    issue = direction is not None
+                    if issue and state["in_flight"] > 0 and bug != "double_directive":
+                        issue = False
+                    if issue and state["cluster"] != "stable":
+                        issue = False
+                    if (
+                        issue
+                        and bug != "cooldown_skip"
+                        and state["last_issue_tick"] is not None
+                        and tick - state["last_issue_tick"] < cooldown
+                    ):
+                        issue = False
+                    if (
+                        issue
+                        and direction == "up"
+                        and bug != "refusal_retry"
+                        and state["backoff_until"] is not None
+                        and tick < state["backoff_until"]
+                    ):
+                        issue = False
+                    if issue:
+                        if state["in_flight"] > 0:
+                            state["overlap"] += 1
+                        if state["cluster"] != "stable":
+                            state["unstable_issue"] += 1
+                        target = state["n"] + (1 if direction == "up" else -1)
+                        state["in_flight"] += 1
+                        state["last_issue_tick"] = tick
+                        state["queue"].append((tick, direction, target))
+                        state["issued"].append((tick, direction, target))
+                        state["events"].append((f"issue_{direction}", tick))
+                        cv.notify_all()
+                sched.yield_point(f"tick{tick}")
+            with cv:
+                state["done"] = True
+                cv.notify_all()
+
+        def executor_body() -> None:
+            refused_once = False
+            while True:
+                with cv:
+                    while not state["queue"]:
+                        if state["done"]:
+                            return
+                        cv.wait()
+                    issue_tick, direction, target = state["queue"].pop(0)
+                sched.yield_point("transition")
+                with cv:
+                    if refuse_up and direction == "up" and not refused_once:
+                        # the preflight capability vote: typed refusal, the
+                        # cluster keeps running at its current size
+                        refused_once = True
+                        state["refused"] = (target, "non-reshardable state")
+                        state["refusals"].append((issue_tick, target))
+                    elif crash_up and direction == "up" and not state["crashed"]:
+                        # mid-flight death AFTER the manifest committed: the
+                        # recovery ladder owns the cluster until it restarts
+                        # everyone at the committed topology
+                        state["crashed"] = True
+                        state["cluster"] = "recovering"
+                        state["recover_to"] = target
+                    else:
+                        state["n"] = target
+                        state["completed"].append((issue_tick, direction, target))
+                    state["in_flight"] -= 1
+                    cv.notify_all()
+
+        def recovery_body() -> None:
+            with cv:
+                while state["cluster"] != "recovering":
+                    if state["done"] and not state["queue"] and state["in_flight"] == 0:
+                        return
+                    cv.wait()
+            sched.yield_point("recovering")
+            with cv:
+                state["n"] = state["recover_to"]
+                state["cluster"] = "stable"
+                cv.notify_all()
+
+        sched.spawn(controller_body, name="controller")
+        sched.spawn(executor_body, name="executor")
+        if crash_up:
+            sched.spawn(recovery_body, name="recovery")
+
+        def check() -> None:
+            assert state["overlap"] == 0, (
+                f"two membership transitions in flight: {state['overlap']} "
+                f"directive(s) issued while one was active ({state['issued']})"
+            )
+            assert state["unstable_issue"] == 0, (
+                "directive issued while the cluster was recovering from a "
+                "mid-flight crash"
+            )
+            issue_ticks = [t for (t, _d, _n) in state["issued"]]
+            for t1, t2 in zip(issue_ticks, issue_ticks[1:]):
+                assert t2 - t1 >= cooldown, (
+                    f"cooldown violated: directives at ticks {t1} and {t2} "
+                    f"(window {cooldown})"
+                )
+            # refusal backoff: no scale-up inside (observation, observation+backoff)
+            for kind, r_obs in state["events"]:
+                if kind != "refusal_backoff":
+                    continue
+                storm = [
+                    t
+                    for (t, d, _n) in state["issued"]
+                    if d == "up" and r_obs <= t < r_obs + backoff
+                ]
+                assert not storm, (
+                    f"refused scale-up retried inside its backoff window "
+                    f"(refusal observed at tick {r_obs}, retries at {storm})"
+                )
+            # shed before scale: the first overload scale-up must be preceded
+            # by a brownout engage in the event order
+            seq = state["events"]
+            first_up = next(
+                (i for i, (k, _t) in enumerate(seq) if k == "issue_up"), None
+            )
+            if first_up is not None:
+                assert any(k == "brownout" for k, _t in seq[:first_up]), (
+                    "scale-up issued before the brownout rung engaged "
+                    "(shed-first ordering violated)"
+                )
+            if crash_up and state["crashed"]:
+                assert state["cluster"] == "stable", (
+                    "cluster never recovered from the mid-flight crash"
+                )
+                assert state["n"] >= state["recover_to"] or not [
+                    1 for (_t, d, _n) in state["completed"] if d == "down"
+                ], "recovery lost the committed topology"
+            assert 1 <= state["n"] <= 1 + len(
+                [1 for (_t, d, _n) in state["issued"] if d == "up"]
+            ), f"worker count escaped its bounds: n={state['n']}"
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
 # planted lock-order inversion (the PWA101 <-> model-check bridge)
 # ---------------------------------------------------------------------------
 

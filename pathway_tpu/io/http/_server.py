@@ -267,6 +267,14 @@ class RestServerSubject:
         # sheds on downstream queue depth, not just this route's request count
         self._overload_probe = overload_probe
         self.shed_requests = 0
+        # per-client shed attribution (X-Pathway-Client header): a noisy
+        # neighbor's flood shows up HERE, not smeared over everyone. Only the
+        # handler's event-loop thread mutates it. BOUNDED: the header is
+        # attacker-controlled, so only the first _MAX_SHED_CLIENTS distinct
+        # ids get their own counter — later ids fold into "other" (an id
+        # rotation attack must not grow the stage-counter dict or /metrics
+        # cardinality without bound)
+        self.shed_by_client: Dict[str, int] = {}
         self._counter = 0
         self._lock = threading.Lock()
         self._source: StreamingDataSource | None = None
@@ -289,14 +297,43 @@ class RestServerSubject:
                     self.request_validator(payload)
                 except Exception as e:
                     return web.Response(status=400, text=str(e))
+            from pathway_tpu.engine.brownout import get_brownout
+
+            brownout = get_brownout()
+            # quiesce window: a membership transition has the commit loop
+            # paused — an admitted request would HANG until the cluster
+            # resumes at C+1, so shed with the expected remaining pause as an
+            # honest Retry-After instead (chaos-tested)
+            quiesce_s = brownout.quiesce_retry_after()
+            if quiesce_s is not None:
+                from pathway_tpu.engine import telemetry
+
+                telemetry.stage_add("rest.quiesce_shed")
+                return web.Response(
+                    status=429,
+                    headers={"Retry-After": str(max(1, int(round(quiesce_s))))},
+                    text=(
+                        "resharding in progress (cluster quiesced at a commit "
+                        "boundary); retry after the indicated delay"
+                    ),
+                )
             probe_hit = False
             if self._overload_probe is not None:
                 try:
                     probe_hit = bool(self._overload_probe())
                 except Exception:
                     probe_hit = False
+            # brownout rung 1/2: the admission cap TIGHTENS before the
+            # autoscaler spends a reshard pause — cheap degradation first
+            effective_pending = self.max_pending
+            brownout_level = 0
+            if self.max_pending:
+                scale = brownout.admission_scale()
+                if scale < 1.0:
+                    brownout_level = brownout.level()
+                    effective_pending = max(1, int(self.max_pending * scale))
             if probe_hit or (
-                self.max_pending and len(self.futures) >= self.max_pending
+                effective_pending and len(self.futures) >= effective_pending
             ):
                 # shed BEFORE pushing into the engine: an admitted request
                 # costs an engine commit + an embed slot; a shed one costs
@@ -305,6 +342,17 @@ class RestServerSubject:
                 from pathway_tpu.engine import telemetry
 
                 telemetry.stage_add(self.shed_stage)
+                client = _client_id(request)
+                if client is not None:
+                    if (
+                        client not in self.shed_by_client
+                        and len(self.shed_by_client) >= _MAX_SHED_CLIENTS
+                    ):
+                        client = "other"
+                    self.shed_by_client[client] = (
+                        self.shed_by_client.get(client, 0) + 1
+                    )
+                    telemetry.stage_add(f"{self.shed_stage}.client.{client}")
                 retry_s = 1.0
                 if self._retry_after is not None:
                     try:
@@ -316,7 +364,13 @@ class RestServerSubject:
                     if probe_hit
                     else (
                         f"{len(self.futures)} requests in flight "
-                        f"(cap {self.max_pending})"
+                        f"(cap {effective_pending}"
+                        + (
+                            f", tightened by brownout rung {brownout_level}"
+                            if brownout_level
+                            else ""
+                        )
+                        + ")"
                     )
                 )
                 return web.Response(
@@ -438,6 +492,23 @@ def rest_connector(
         subscribe(result_table, on_change)
 
     return queries, response_writer
+
+
+# distinct client ids tracked per route before attribution folds into "other"
+_MAX_SHED_CLIENTS = 32
+
+
+def _client_id(request: Any) -> "str | None":
+    """Sanitized ``X-Pathway-Client`` header value for shed attribution
+    (stage-counter-safe: alnum/dash/underscore, bounded length)."""
+    try:
+        raw = request.headers.get("X-Pathway-Client")
+    except Exception:
+        return None
+    if not raw:
+        return None
+    cleaned = "".join(c for c in str(raw)[:32] if c.isalnum() or c in "-_")
+    return cleaned or None
 
 
 def _jsonable(v: Any) -> Any:

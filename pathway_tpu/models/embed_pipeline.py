@@ -221,11 +221,17 @@ class QueryCoalescer:
     def overloaded(self, extra_rows: int = 0) -> bool:
         """Admission probe: would admitting ``extra_rows`` more rows exceed
         ``max_queue_rows``? Lock-free read — a soft cap with bounded overshoot,
-        same contract as the REST ``max_pending`` check."""
-        return bool(
-            self.max_queue_rows
-            and self._rows_pending() + extra_rows >= self.max_queue_rows
-        )
+        same contract as the REST ``max_pending`` check. Each probe also feeds
+        the brownout ladder (``engine/brownout.py``) one occupancy sample, so
+        the serving plane's degradation rungs engage from the same signal the
+        shed decision uses."""
+        if not self.max_queue_rows:
+            return False
+        pending = self._rows_pending()
+        from pathway_tpu.engine.brownout import get_brownout
+
+        get_brownout().observe_occupancy(pending / self.max_queue_rows)
+        return pending + extra_rows >= self.max_queue_rows
 
     def retry_after_s(self, extra_rows: int = 0) -> float:
         """Honest Retry-After estimate: batches needed to drain the current
@@ -367,8 +373,13 @@ class QueryCoalescer:
             # the window anchors at the OLDEST queued request's arrival — time
             # it already spent waiting behind a busy encoder counts against the
             # deadline, so a request is dispatched no later than max_wait_ms
-            # after submission (plus the in-flight batch, which is unavoidable)
-            deadline = self._queue[0].arrived + self.max_wait_ms / 1000.0
+            # after submission (plus the in-flight batch, which is unavoidable).
+            # Under brownout the window SHRINKS (engine/brownout.py): batching
+            # efficiency is traded for latency while the queue is saturated.
+            from pathway_tpu.engine.brownout import get_brownout
+
+            window_ms = self.max_wait_ms * get_brownout().coalesce_window_scale()
+            deadline = self._queue[0].arrived + window_ms / 1000.0
             while sum(len(r.texts) for r in self._queue) < self.max_batch:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or self._closed:

@@ -544,6 +544,19 @@ class IvfKnnStore(DenseKNNStore):
 
     # -- query paths ---------------------------------------------------------
 
+    def _effective_n_probe(self) -> int:
+        """``n_probe`` after the brownout ladder's degradation shift
+        (``engine/brownout.py``): under rung 2 the serving plane halves the
+        probed clusters — recall degrades honestly instead of the embed/query
+        queue growing without bound. Level 0 (the steady state) returns
+        ``n_probe`` unchanged, so normal serving is bit-identical to the
+        pre-brownout build. On the device path each shift level is one extra
+        jit bucket (``n_probe`` is a static kernel argument) — bounded at the
+        ladder's two rungs."""
+        from pathway_tpu.engine.brownout import get_brownout
+
+        return max(1, self.n_probe >> get_brownout().nprobe_shift())
+
     def _search_numpy(
         self, queries: np.ndarray, k_eff: int
     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -563,7 +576,7 @@ class IvfKnnStore(DenseKNNStore):
         offsets, rows = self._csr_offsets, self._csr_rows
         counts_all = offsets[1:] - offsets[:-1]
         cn = np.sum(cents * cents, axis=1)
-        n_probe = self.n_probe
+        n_probe = self._effective_n_probe()
         nq_total = queries.shape[0]
         out_scores = np.full((nq_total, k_eff), -np.inf, dtype=np.float32)
         out_slots = np.full((nq_total, k_eff), -1, dtype=np.int64)
@@ -632,7 +645,8 @@ class IvfKnnStore(DenseKNNStore):
         else:
             q_dev = jnp.asarray(np.asarray(queries, dtype=np.float32))
         nq = q_dev.shape[0]
-        cand = self.n_probe * self._max_pages * PAGE
+        n_probe = self._effective_n_probe()
+        cand = n_probe * self._max_pages * PAGE
         k_used = min(next_pow2(max(1, k_eff)), cand)
         # chunk the query batch so the streamed tile + the (chunk, cand) score
         # matrix stay within a fixed HBM budget
@@ -644,7 +658,7 @@ class IvfKnnStore(DenseKNNStore):
             parts.append(
                 _ivf_query_fused(
                     self._centroids, first_page, n_pages, packed, pn, pm, rows,
-                    sl, k_used, self.n_probe, self._max_pages, self.metric, impl,
+                    sl, k_used, n_probe, self._max_pages, self.metric, impl,
                 )
             )
         top_scores = jnp.concatenate([p[0] for p in parts])[:nq, :k_eff]

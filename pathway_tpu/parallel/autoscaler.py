@@ -1,0 +1,538 @@
+"""Closed-loop autoscaler: capacity follows load without an operator.
+
+ROADMAP item 1(a): PR 11 made the cluster elastic (``spawn --scale N`` /
+``--control-port scale N``) but a human still had to notice overload and type
+the command. This module closes the loop: a supervisor-resident controller
+samples the signals the workers already publish through their status files
+(ingest rate, shed counters, barrier-wait seconds, commit-duration p99,
+brownout rung), computes a target worker count through a DAMPED policy, and
+drives it through the existing membership-directive path
+(:meth:`~pathway_tpu.parallel.supervisor.Supervisor.request_scale`).
+
+The controller state machine was modeled FIRST (``autoscaler_model`` in
+``internals/protocol_models.py``, the PR-9 discipline) and the invariants
+proven there are the contract this module implements:
+
+- **never two concurrent transitions** — a decision is only issued while no
+  membership transition (and no surgical rejoin) is in flight;
+- **cooldown respected** — the cooldown window is measured from the last
+  issued transition in ANY direction (its length chosen by the new
+  decision's direction), so consecutive transitions can never land closer
+  than the shorter window, however noisy the signals;
+- **refusal never retried within its backoff** — a scale-up the preflight
+  vote REFUSED (non-reshardable graph) is typed, recorded, and retried at
+  most once per ``refusal_backoff_s`` window instead of hammering the
+  transition path;
+- **shed-before-scale** — an overload-driven scale-up only fires after the
+  brownout ladder (``engine/brownout.py``) has been engaged for
+  ``shed_first_s`` (cheap degradation is spent before an expensive reshard
+  pause);
+- **wrong-safe recovery** — a transition that dies mid-flight defers to the
+  PR-2/3/6/11 recovery ladder; the controller resumes only after the cluster
+  reports ``running`` at a committed topology.
+
+A **flap counter** watches decision reversals (up followed by down or vice
+versa within ``flap_window_s``): after ``flap_reversals`` of them the
+controller locks into *hold-and-alert* — no further transitions, a loud log
+line, and the lock visible in ``/healthz`` (the supervisor exports controller
+state to ``autoscaler.json`` in the supervise dir; workers mirror it).
+
+The controller itself is PURE — time and signals are injected, it owns no
+threads or locks — so the model, the unit tests, and the supervisor's poll
+loop all drive the same code.
+
+Env knobs (all prefixed ``PATHWAY_AUTOSCALE``):
+
+====================================  =========  ===============================
+``PATHWAY_AUTOSCALE``                 ``off``    ``on`` enables the loop
+``PATHWAY_AUTOSCALE_MIN``             2          floor worker count
+``PATHWAY_AUTOSCALE_MAX``             8          ceiling worker count
+``PATHWAY_AUTOSCALE_ROWS_PER_WORKER`` 500        target ingest rows/s per worker
+``PATHWAY_AUTOSCALE_SAMPLE_S``        1.0        control-loop sample period
+``PATHWAY_AUTOSCALE_BAND``            0.25       hysteresis band around target
+``PATHWAY_AUTOSCALE_UP_SAMPLES``      3          consecutive samples above band
+``PATHWAY_AUTOSCALE_DOWN_SAMPLES``    6          consecutive samples below band
+``PATHWAY_AUTOSCALE_UP_COOLDOWN_S``   20         min gap between scale-ups
+``PATHWAY_AUTOSCALE_DOWN_COOLDOWN_S`` 45         min gap between scale-ins
+``PATHWAY_AUTOSCALE_REFUSAL_BACKOFF_S`` 120      refused-direction backoff
+``PATHWAY_AUTOSCALE_FLAP_WINDOW_S``   300        reversal-counting window
+``PATHWAY_AUTOSCALE_FLAP_REVERSALS``  3          reversals before flap-lock
+``PATHWAY_AUTOSCALE_SHED_FIRST_S``    3          brownout dwell before
+                                                 overload-driven scale-up
+====================================  =========  ===============================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from pathway_tpu.internals.config import env_float as _env_float
+
+#: controller state file in the supervise dir — workers mirror it into
+#: ``/healthz`` and the flight recorder so flap-locks and decisions are
+#: visible from inside the cluster, not only in the supervisor's log
+STATE_FILE = "autoscaler.json"
+
+
+def autoscale_enabled() -> bool:
+    return os.environ.get("PATHWAY_AUTOSCALE", "off").lower() in (
+        "on", "1", "true", "yes",
+    )
+
+
+class AutoscaleRefusedError(RuntimeError):
+    """A controller-issued scale-up was REFUSED by the cluster's preflight
+    capability vote (non-reshardable graph state). Typed so supervisor
+    post-mortems and tests can triage the refusal without string matching;
+    carries the refused target and the workers' reason."""
+
+    def __init__(self, target_n: int, reason: str):
+        self.target_n = int(target_n)
+        self.reason = reason
+        super().__init__(
+            f"autoscaler scale-up to n={target_n} refused by the preflight "
+            f"vote: {reason} — backing off instead of retrying (the graph "
+            "cannot be resharded; see the membership follow-ons in ROADMAP)"
+        )
+
+
+@dataclass
+class AutoscalePolicy:
+    """Damping parameters of the control loop (see module docstring)."""
+
+    min_workers: int = 2
+    max_workers: int = 8
+    rows_per_worker: float = 500.0
+    sample_period_s: float = 1.0
+    band: float = 0.25
+    up_samples: int = 3
+    down_samples: int = 6
+    up_cooldown_s: float = 20.0
+    down_cooldown_s: float = 45.0
+    refusal_backoff_s: float = 120.0
+    flap_window_s: float = 300.0
+    flap_reversals: int = 3
+    shed_first_s: float = 3.0
+
+    @classmethod
+    def from_env(cls) -> "AutoscalePolicy":
+        return cls(
+            min_workers=int(_env_float("PATHWAY_AUTOSCALE_MIN", 2)),
+            max_workers=int(_env_float("PATHWAY_AUTOSCALE_MAX", 8)),
+            rows_per_worker=_env_float("PATHWAY_AUTOSCALE_ROWS_PER_WORKER", 500.0),
+            sample_period_s=_env_float("PATHWAY_AUTOSCALE_SAMPLE_S", 1.0),
+            band=_env_float("PATHWAY_AUTOSCALE_BAND", 0.25),
+            up_samples=int(_env_float("PATHWAY_AUTOSCALE_UP_SAMPLES", 3)),
+            down_samples=int(_env_float("PATHWAY_AUTOSCALE_DOWN_SAMPLES", 6)),
+            up_cooldown_s=_env_float("PATHWAY_AUTOSCALE_UP_COOLDOWN_S", 20.0),
+            down_cooldown_s=_env_float("PATHWAY_AUTOSCALE_DOWN_COOLDOWN_S", 45.0),
+            refusal_backoff_s=_env_float(
+                "PATHWAY_AUTOSCALE_REFUSAL_BACKOFF_S", 120.0
+            ),
+            flap_window_s=_env_float("PATHWAY_AUTOSCALE_FLAP_WINDOW_S", 300.0),
+            flap_reversals=int(_env_float("PATHWAY_AUTOSCALE_FLAP_REVERSALS", 3)),
+            shed_first_s=_env_float("PATHWAY_AUTOSCALE_SHED_FIRST_S", 3.0),
+        )
+
+
+@dataclass
+class AutoscaleSignals:
+    """One aggregated sample of the cluster's load signals."""
+
+    ingest_rate: float = 0.0  # cluster-wide rows/s over the sample window
+    shed_rate: float = 0.0  # embed.shed + rest.shed increments/s
+    barrier_frac: float = 0.0  # barrier-wait seconds per wall second per rank
+    commit_p99_s: float = 0.0  # worst rank's commit-duration p99
+    brownout_level: int = 0  # deepest engaged brownout rung across ranks
+    stable: bool = True  # every member running/stable at one topology
+    current_n: int = 0  # live worker count per the status files
+
+
+def aggregate_signals(
+    statuses: Dict[int, dict],
+    prev: "Optional[tuple]",
+    now: float,
+    current_n: int,
+) -> "tuple[AutoscaleSignals, tuple]":
+    """Fold per-rank status files into one :class:`AutoscaleSignals` sample.
+
+    Rate signals are deltas of the cumulative counters each worker publishes
+    under its ``autoscale`` status key (``engine/profile.py:
+    autoscale_signals``) against the previous sample's totals — ``prev`` is
+    the opaque carry returned by the last call (None on the first)."""
+    input_rows = 0.0
+    shed = 0.0
+    barrier_s = 0.0
+    commit_p99 = 0.0
+    brownout = 0
+    stable = bool(statuses)
+    for rank in range(current_n):
+        status = statuses.get(rank)
+        if status is None:
+            stable = False
+            continue
+        if status.get("membership_state") not in (None, "stable"):
+            stable = False
+        if status.get("state") not in (None, "running"):
+            stable = False
+        sig = status.get("autoscale") or {}
+        input_rows += float(sig.get("input_rows") or 0.0)
+        shed += float(sig.get("shed") or 0.0)
+        barrier_s += float(sig.get("barrier_wait_s") or 0.0)
+        commit_p99 = max(commit_p99, float(sig.get("commit_p99_s") or 0.0))
+        brownout = max(brownout, int(sig.get("brownout_level") or 0))
+    carry = (now, input_rows, shed, barrier_s)
+    if prev is None:
+        return (
+            AutoscaleSignals(
+                stable=stable, current_n=current_n, brownout_level=brownout,
+                commit_p99_s=commit_p99,
+            ),
+            carry,
+        )
+    prev_now, prev_rows, prev_shed, prev_barrier = prev
+    dt = max(1e-6, now - prev_now)
+    # a restarted/resharded worker resets its counters: clamp deltas at 0 so
+    # one relaunch cannot read as a negative (or absurd) rate
+    return (
+        AutoscaleSignals(
+            ingest_rate=max(0.0, input_rows - prev_rows) / dt,
+            shed_rate=max(0.0, shed - prev_shed) / dt,
+            barrier_frac=max(0.0, barrier_s - prev_barrier)
+            / dt
+            / max(1, current_n),
+            commit_p99_s=commit_p99,
+            brownout_level=brownout,
+            stable=stable,
+            current_n=current_n,
+        ),
+        carry,
+    )
+
+
+@dataclass
+class AutoscaleDecision:
+    """One issued (or refused/locked) controller decision, for the log."""
+
+    at: float
+    kind: str  # "scale_up" | "scale_down" | "flap_lock" | "refusal_backoff"
+    target_n: int
+    reason: str
+
+
+class AutoscaleController:
+    """The damped control loop (pure: time and signals are injected).
+
+    Drive it with :meth:`sample` once per poll; it returns a target worker
+    count exactly when a transition should be issued, else None. Feed the
+    transition's outcome back through :meth:`on_issued` / :meth:`on_refused`
+    / :meth:`on_complete` / :meth:`on_aborted` — the controller will not issue
+    again until the cluster is stable at a committed topology."""
+
+    def __init__(self, policy: AutoscalePolicy, initial_n: int):
+        self.policy = policy
+        self.current_n = int(initial_n)
+        self.state = "watching"  # watching|transition_in_flight|flap_locked
+        self.flap_locked = False
+        self.decisions: List[AutoscaleDecision] = []
+        self.last_refusal: "Optional[AutoscaleRefusedError]" = None
+        self.generation = 0  # bumps on every state/decision change (healthz)
+        self._above_streak = 0
+        self._below_streak = 0
+        # the last issued transition in ANY direction: the cooldown window is
+        # measured from here (its LENGTH is per the new decision's direction),
+        # so two transitions can never land closer than the shorter window —
+        # the exact consecutive-directive invariant autoscaler_model proves
+        self._last_issue_at: "Optional[float]" = None
+        self._refused_until: "Optional[float]" = None
+        self._brownout_since: "Optional[float]" = None
+        self._in_flight_target: "Optional[int]" = None
+        self._await_stable = False
+        self._last_signals: "Optional[AutoscaleSignals]" = None
+
+    # -- the control loop ------------------------------------------------------
+
+    def sample(self, now: float, signals: AutoscaleSignals) -> "Optional[int]":
+        """One control-loop tick. Returns the target worker count to issue a
+        MEMBERSHIP_CHANGE for, or None (hold)."""
+        policy = self.policy
+        self._last_signals = signals
+        if signals.current_n:
+            self.current_n = signals.current_n
+        if self.flap_locked:
+            return None
+        if self._in_flight_target is not None:
+            return None  # max one transition in flight, by construction
+        if self._await_stable or not signals.stable:
+            # a transition died mid-flight (or the cluster is mid-recovery):
+            # the recovery ladder owns the cluster until every member reports
+            # running at one committed topology
+            if signals.stable:
+                self._await_stable = False
+                self._bump()
+            else:
+                return None
+        # track how long the brownout ladder has been engaged (shed-first)
+        if signals.brownout_level > 0 or signals.shed_rate > 0:
+            if self._brownout_since is None:
+                self._brownout_since = now
+        else:
+            self._brownout_since = None
+        # -- desired size from the rate signal (requests-per-replica policy) --
+        capacity = self.current_n * policy.rows_per_worker
+        if signals.ingest_rate > capacity * (1.0 + policy.band):
+            self._above_streak += 1
+            self._below_streak = 0
+        elif signals.ingest_rate < capacity * (1.0 - policy.band):
+            self._below_streak += 1
+            self._above_streak = 0
+        else:
+            self._above_streak = 0
+            self._below_streak = 0
+        overload = (
+            signals.shed_rate > 0
+            and self._brownout_since is not None
+            and now - self._brownout_since >= policy.shed_first_s
+        )
+        target: "Optional[int]" = None
+        direction: "Optional[str]" = None
+        if self._above_streak >= policy.up_samples or overload:
+            desired = self._desired_for_rate(signals.ingest_rate)
+            target = max(desired, self.current_n + 1)
+            direction = "up"
+        elif self._below_streak >= policy.down_samples:
+            desired = self._desired_for_rate(signals.ingest_rate)
+            if desired < self.current_n:
+                target = desired
+                direction = "down"
+        if target is None or direction is None:
+            return None
+        target = max(self.policy.min_workers, min(self.policy.max_workers, target))
+        if target == self.current_n:
+            return None
+        # -- damping: cooldowns, refusal backoff, flap lock -------------------
+        cooldown = (
+            policy.up_cooldown_s if direction == "up" else policy.down_cooldown_s
+        )
+        if (
+            self._last_issue_at is not None
+            and now - self._last_issue_at < cooldown
+        ):
+            return None
+        if (
+            direction == "up"
+            and self._refused_until is not None
+            and now < self._refused_until
+        ):
+            # typed backoff: a refused scale-up retries at most once per
+            # backoff window, never in a storm against the preflight vote
+            return None
+        if self._flap_check(now, direction):
+            return None
+        kind = "scale_up" if direction == "up" else "scale_down"
+        reason = (
+            f"overload (shed_rate={signals.shed_rate:.1f}/s, brownout rung "
+            f"{signals.brownout_level})"
+            if direction == "up" and overload and self._above_streak < policy.up_samples
+            else (
+                f"ingest {signals.ingest_rate:.0f} rows/s vs capacity "
+                f"{capacity:.0f} ({self.current_n} x "
+                f"{policy.rows_per_worker:.0f})"
+            )
+        )
+        self.decisions.append(AutoscaleDecision(now, kind, target, reason))
+        self._above_streak = 0
+        self._below_streak = 0
+        return target
+
+    def _desired_for_rate(self, rate: float) -> int:
+        import math
+
+        per = max(1e-9, self.policy.rows_per_worker)
+        desired = math.ceil(rate / per)
+        return max(self.policy.min_workers, min(self.policy.max_workers, desired))
+
+    def _flap_check(self, now: float, direction: str) -> bool:
+        """True when issuing ``direction`` now would be (or already is) a
+        flap-lock: count direction REVERSALS among recent issued decisions."""
+        window = [
+            d
+            for d in self.decisions
+            if d.kind in ("scale_up", "scale_down")
+            and now - d.at <= self.policy.flap_window_s
+        ]
+        dirs = [("up" if d.kind == "scale_up" else "down") for d in window]
+        dirs.append(direction)
+        reversals = sum(1 for a, b in zip(dirs, dirs[1:]) if a != b)
+        if reversals >= self.policy.flap_reversals:
+            self.flap_locked = True
+            self.state = "flap_locked"
+            self.decisions.append(
+                AutoscaleDecision(
+                    now,
+                    "flap_lock",
+                    self.current_n,
+                    f"{reversals} direction reversal(s) within "
+                    f"{self.policy.flap_window_s:.0f}s — holding at "
+                    f"n={self.current_n} until an operator intervenes",
+                )
+            )
+            self._bump()
+            return True
+        return False
+
+    # -- transition feedback ---------------------------------------------------
+
+    def on_issued(self, target_n: int, now: float) -> None:
+        """The supervisor accepted the decision and wrote the directive."""
+        self._in_flight_target = int(target_n)
+        self.state = "transition_in_flight"
+        self._last_issue_at = now
+        self._bump()
+
+    def on_deferred(self, now: float) -> None:
+        """The supervisor could not issue the decision right now (a surgical
+        rejoin in flight, a race with a just-started transition): drop the
+        recorded decision so a deferral never counts against the flap window."""
+        if self.decisions and self.decisions[-1].kind in (
+            "scale_up", "scale_down",
+        ):
+            self.decisions.pop()
+
+    def on_refused(self, target_n: int, reason: str, now: float) -> None:
+        """The preflight vote refused the transition: record the TYPED
+        refusal, arm the backoff, and stop retrying inside it."""
+        self.last_refusal = AutoscaleRefusedError(target_n, reason)
+        self._refused_until = now + self.policy.refusal_backoff_s
+        self._in_flight_target = None
+        self.state = "watching"
+        self.decisions.append(
+            AutoscaleDecision(
+                now,
+                "refusal_backoff",
+                int(target_n),
+                f"preflight refused: {reason[:160]} — next attempt not before "
+                f"{self.policy.refusal_backoff_s:.0f}s",
+            )
+        )
+        self._bump()
+
+    def on_complete(self, new_n: int, now: float) -> None:
+        self.current_n = int(new_n)
+        self._in_flight_target = None
+        self.state = "flap_locked" if self.flap_locked else "watching"
+        self._bump()
+
+    def on_aborted(self, reason: str, now: float) -> None:
+        """The transition died mid-flight (crash racing the directive): the
+        recovery ladder owns the cluster now; hold until it reports stable."""
+        self._in_flight_target = None
+        self._await_stable = True
+        self.state = "flap_locked" if self.flap_locked else "watching"
+        self._bump()
+
+    def _bump(self) -> None:
+        self.generation += 1
+
+    # -- reporting -------------------------------------------------------------
+
+    def last_decision(self) -> "Optional[AutoscaleDecision]":
+        return self.decisions[-1] if self.decisions else None
+
+    def as_dict(self, now: "float | None" = None) -> Dict[str, Any]:
+        """Observability export. ``now`` must be the same injected clock the
+        controller is driven with (falls back to ``time.monotonic()``, the
+        supervisor's clock) — the backoff-remaining field is computed against
+        it."""
+        if now is None:
+            now = time.monotonic()
+        last = self.last_decision()
+        signals = self._last_signals
+        return {
+            "state": self.state,
+            "generation": self.generation,
+            "current_n": self.current_n,
+            "flap_locked": self.flap_locked,
+            "in_flight_target": self._in_flight_target,
+            "awaiting_stable": self._await_stable,
+            # seconds REMAINING in the refusal backoff (operator-readable),
+            # not the raw monotonic deadline
+            "refused_until_in_s": (
+                None
+                if self._refused_until is None
+                else round(max(0.0, self._refused_until - now), 1)
+            ),
+            "last_refusal": (
+                None
+                if self.last_refusal is None
+                else {
+                    "target_n": self.last_refusal.target_n,
+                    "reason": str(self.last_refusal)[:240],
+                    "type": type(self.last_refusal).__name__,
+                }
+            ),
+            "last_decision": (
+                None
+                if last is None
+                else {
+                    "at": last.at,
+                    "kind": last.kind,
+                    "target_n": last.target_n,
+                    "reason": last.reason,
+                }
+            ),
+            "signals": (
+                None
+                if signals is None
+                else {
+                    "ingest_rate": round(signals.ingest_rate, 1),
+                    "shed_rate": round(signals.shed_rate, 2),
+                    "barrier_frac": round(signals.barrier_frac, 4),
+                    "commit_p99_s": round(signals.commit_p99_s, 4),
+                    "brownout_level": signals.brownout_level,
+                    "stable": signals.stable,
+                }
+            ),
+        }
+
+
+# -- state-file plumbing (supervisor writes, workers mirror) -------------------
+
+
+def state_path(supervise_dir: str) -> str:
+    return os.path.join(supervise_dir, STATE_FILE)
+
+
+def write_state(
+    supervise_dir: str,
+    controller: AutoscaleController,
+    now: "float | None" = None,
+) -> None:
+    """Atomically export the controller state for the workers' ``/healthz``
+    mirror (and operator triage while the cluster is live). ``now`` is the
+    controller's driving clock (see :meth:`AutoscaleController.as_dict`)."""
+    path = state_path(supervise_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(controller.as_dict(now), f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def read_state(supervise_dir: "str | None") -> "Optional[Dict[str, Any]]":
+    if not supervise_dir:
+        return None
+    try:
+        with open(state_path(supervise_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

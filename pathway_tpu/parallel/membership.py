@@ -84,6 +84,11 @@ class MembershipDirective:
     target_n: int
     epoch: int  # the epoch the new topology will run at
     from_n: int  # worker count when the directive was issued
+    # who asked: "operator" (--scale / control endpoint / plan) or
+    # "autoscaler" (the closed control loop) — refusal feedback and
+    # post-mortems attribute the decision. NOT part of as_tuple(): the
+    # per-commit vote payload stays the stable 4-tuple.
+    origin: str = "operator"
 
     def as_tuple(self) -> tuple:
         return (self.generation, self.target_n, self.epoch, self.from_n)
@@ -110,6 +115,7 @@ def write_directive(supervise_dir: str, directive: MembershipDirective) -> None:
                 "target_n": directive.target_n,
                 "epoch": directive.epoch,
                 "from_n": directive.from_n,
+                "origin": directive.origin,
             },
             f,
         )
@@ -128,6 +134,7 @@ def read_directive(supervise_dir: "str | None") -> "Optional[MembershipDirective
         return MembershipDirective(
             int(raw["generation"]), int(raw["target_n"]),
             int(raw["epoch"]), int(raw["from_n"]),
+            origin=str(raw.get("origin", "operator")),
         )
     except (KeyError, TypeError, ValueError):
         return None

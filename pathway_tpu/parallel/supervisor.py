@@ -193,6 +193,7 @@ class Supervisor:
         poll_interval_s: float = 0.2,
         scale_plan: "List[dict] | None" = None,
         control_port: "int | None" = None,
+        autoscale: "bool | None" = None,
     ):
         if restart_mode not in ("surgical", "all"):
             raise ValueError(
@@ -259,9 +260,34 @@ class Supervisor:
         )
         self.last_reshard_s: "float | None" = None
         self._control_port = control_port
+        #: actual bound port once the endpoint is up (--control-port 0 lets
+        #: the OS pick; tests read this)
+        self.control_port: "Optional[int]" = None
         self._control_listener: "Optional[socket.socket]" = None
         self._scale_requests: List[int] = []
         self._scale_lock = threading.Lock()
+        self._last_statuses: Dict[int, dict] = {}
+        # closed-loop autoscaler (parallel/autoscaler.py): samples the
+        # workers' status-file signals each poll and drives request_scale
+        # through the SAME directive path as the operator surfaces — capacity
+        # follows load with no human in the loop
+        from pathway_tpu.parallel.autoscaler import (
+            AutoscaleController,
+            AutoscalePolicy,
+            autoscale_enabled,
+        )
+
+        if autoscale is None:
+            autoscale = autoscale_enabled()
+        self.autoscaler: "Optional[AutoscaleController]" = (
+            AutoscaleController(AutoscalePolicy.from_env(), processes)
+            if autoscale
+            else None
+        )
+        self._signal_carry: "Optional[tuple]" = None
+        self._last_autoscale_sample = 0.0
+        self._autoscaler_flap_logged = False
+        self._autoscaler_written_gen = -1
 
     def _surgical_enabled(self) -> bool:
         # n == 1 has no survivors to keep alive — surgical degenerates to
@@ -336,16 +362,47 @@ class Supervisor:
     # -- elastic membership ----------------------------------------------------
 
     def _start_control_endpoint(self) -> None:
-        """Tiny line-protocol control endpoint (``scale N\\n`` -> ``ok\\n``):
-        operators (or an autoscaler) resize the running cluster without
-        restarting it."""
+        """Tiny line-protocol control endpoint: operators (or an external
+        autoscaler) drive the running cluster without restarting it.
+
+        Commands (one per connection, newline-terminated):
+
+        - ``scale N``  -> ``ok`` (request queued; the directive path decides)
+        - ``status``   -> one JSON line: topology, membership state,
+          transition/rejoin flags, autoscale-controller state + last decision
+        - anything else answers ``err <reason>`` — a malformed command is
+          never silently dropped."""
         if self._control_port is None:
             return
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind(("127.0.0.1", self._control_port))
-        listener.listen(4)
+        listener.listen(8)
+        self.control_port = listener.getsockname()[1]
         self._control_listener = listener
+
+        def handle(line: str) -> bytes:
+            parts = line.split()
+            if not parts:
+                return b"err empty command (try: scale N | status)\n"
+            if parts[0] == "scale":
+                if len(parts) != 2:
+                    return b"err usage: scale N\n"
+                try:
+                    target = int(parts[1])
+                except ValueError:
+                    return (
+                        f"err scale target must be an integer, got "
+                        f"{parts[1]!r}\n".encode()
+                    )
+                with self._scale_lock:
+                    self._scale_requests.append(target)
+                return b"ok\n"
+            if parts[0] == "status":
+                return (
+                    json.dumps(self._control_status(), sort_keys=True) + "\n"
+                ).encode()
+            return f"err unknown command {parts[0]!r}\n".encode()
 
         def serve() -> None:
             while True:
@@ -356,19 +413,13 @@ class Supervisor:
                 try:
                     conn.settimeout(5.0)
                     line = b""
-                    while not line.endswith(b"\n") and len(line) < 64:
-                        chunk = conn.recv(64)
+                    while not line.endswith(b"\n") and len(line) < 256:
+                        chunk = conn.recv(256)
                         if not chunk:
                             break
                         line += chunk
-                    parts = line.decode("utf-8", "replace").split()
-                    if len(parts) == 2 and parts[0] == "scale":
-                        with self._scale_lock:
-                            self._scale_requests.append(int(parts[1]))
-                        conn.sendall(b"ok\n")
-                    else:
-                        conn.sendall(b"err unknown command\n")
-                except (OSError, ValueError):
+                    conn.sendall(handle(line.decode("utf-8", "replace").strip()))
+                except OSError:
                     pass
                 finally:
                     try:
@@ -380,10 +431,29 @@ class Supervisor:
             target=serve, daemon=True, name="pathway:supervisor-control"
         ).start()
 
-    def request_scale(self, target_n: int) -> bool:
+    def _control_status(self) -> Dict[str, Any]:
+        """Read-only snapshot for the ``status`` control command."""
+        statuses = self._last_statuses
+        return {
+            "n": self.n,
+            "cluster_epoch": self.cluster_epoch,
+            "restarts_used": self.restarts_used,
+            "transition_in_flight": self._transition is not None,
+            "rejoining": self._rejoining is not None,
+            "membership_state": {
+                str(rank): s.get("membership_state")
+                for rank, s in sorted(statuses.items())
+            },
+            "autoscaler": (
+                self.autoscaler.as_dict() if self.autoscaler is not None else None
+            ),
+        }
+
+    def request_scale(self, target_n: int, origin: str = "operator") -> bool:
         """Issue a MEMBERSHIP_CHANGE directive (and launch joiners for a
         grow). Returns False when the request is invalid or one is already
-        in flight."""
+        in flight. ``origin`` attributes the decision ("operator" surfaces vs
+        the "autoscaler" loop) for refusal feedback and post-mortems."""
         from pathway_tpu.parallel.membership import (
             MembershipDirective,
             write_directive,
@@ -413,7 +483,8 @@ class Supervisor:
         self._scale_generation += 1
         self.cluster_epoch += 1
         directive = MembershipDirective(
-            self._scale_generation, target_n, self.cluster_epoch, self.n
+            self._scale_generation, target_n, self.cluster_epoch, self.n,
+            origin=origin,
         )
         write_directive(self._supervise_dir, directive)
         self._transition = (directive, time.monotonic())
@@ -445,6 +516,54 @@ class Supervisor:
             pass
         self._log(f"launching joiner rank {rank} (target n={directive.target_n})")
         return subprocess.Popen([self.program, *self.arguments], env=env)
+
+    def _drive_autoscaler(self, statuses: Dict[int, dict]) -> None:
+        """One control-loop tick: aggregate the workers' published signals,
+        let the controller decide, and issue the decision through the SAME
+        directive path the operator surfaces use. The controller's damping
+        (cooldowns, hysteresis, refusal backoff, flap lock) lives in
+        ``parallel/autoscaler.py``; this method only feeds and obeys it."""
+        ctrl = self.autoscaler
+        if ctrl is None or self._supervise_dir is None:
+            return
+        now = time.monotonic()
+        if now - self._last_autoscale_sample < ctrl.policy.sample_period_s:
+            return
+        self._last_autoscale_sample = now
+        from pathway_tpu.parallel.autoscaler import aggregate_signals, write_state
+
+        signals, self._signal_carry = aggregate_signals(
+            statuses, self._signal_carry, now, self.n
+        )
+        if self._rejoining is not None or self._transition is not None:
+            # the recovery ladder / an in-flight transition owns the cluster
+            signals.stable = False
+        target = ctrl.sample(now, signals)
+        if target is not None:
+            if self.request_scale(target, origin="autoscaler"):
+                ctrl.on_issued(target, now)
+                decision = ctrl.last_decision()
+                self._log(
+                    f"autoscaler: scaling n={signals.current_n or self.n} -> "
+                    f"n={target} ({decision.reason if decision else 'decision'})"
+                )
+            else:
+                ctrl.on_deferred(now)
+        if ctrl.flap_locked and not self._autoscaler_flap_logged:
+            self._autoscaler_flap_logged = True
+            decision = ctrl.last_decision()
+            self._log(
+                "autoscaler FLAP-LOCKED: holding at n="
+                f"{self.n} and alerting instead of oscillating — "
+                f"{decision.reason if decision else ''} (resize manually via "
+                "the control endpoint if the load pattern is real)"
+            )
+        # export controller state for the workers' /healthz mirror + triage —
+        # only when it CHANGED (the generation exists to detect exactly this;
+        # steady "watching" must not cost a file write per sample forever)
+        if ctrl.generation != self._autoscaler_written_gen:
+            write_state(self._supervise_dir, ctrl, now)
+            self._autoscaler_written_gen = ctrl.generation
 
     def _poll_scale_requests(self, statuses: Dict[int, dict]) -> None:
         """Feed pending control-endpoint requests and due scale-plan entries
@@ -487,6 +606,16 @@ class Supervisor:
                     f"membership change to n={directive.target_n} refused by "
                     f"rank {rank}: {refused[1]}"
                 )
+                if self.autoscaler is not None and directive.origin == "autoscaler":
+                    # typed refusal feedback: the controller backs off this
+                    # direction instead of hammering the transition path
+                    self.autoscaler.on_refused(
+                        directive.target_n, str(refused[1]), time.monotonic()
+                    )
+                    refusal = self.autoscaler.last_refusal
+                    self._log(
+                        f"autoscaler: {type(refusal).__name__}: {refusal}"
+                    )
                 for jr in range(directive.from_n, len(self.handles)):
                     handle = self.handles[jr]
                     if handle.poll() is None:
@@ -531,6 +660,8 @@ class Supervisor:
             self.n = directive.target_n
             clear_directive(self._supervise_dir)
             self._transition = None
+            if self.autoscaler is not None:
+                self.autoscaler.on_complete(self.n, time.monotonic())
             self._log(
                 f"membership change complete: cluster is n={self.n} at epoch "
                 f"{directive.epoch} ({self.last_reshard_s:.1f}s)"
@@ -587,7 +718,9 @@ class Supervisor:
         while True:
             any_alive = False
             statuses = read_statuses(self._supervise_dir, len(self.handles))
+            self._last_statuses = statuses
             up_for = time.monotonic() - self._launched_at
+            self._drive_autoscaler(statuses)
             self._poll_scale_requests(statuses)
             wedged_transition = self._watch_transition(statuses)
             if wedged_transition is not None:
@@ -731,6 +864,10 @@ class Supervisor:
         from pathway_tpu.parallel.membership import clear_directive
 
         adopted: "Optional[int]" = None
+        if self._transition is not None and self.autoscaler is not None:
+            # a crash raced the directive: the recovery ladder owns the
+            # cluster — the controller holds until it reports stable again
+            self.autoscaler.on_aborted("transition aborted by a failure", time.monotonic())
         if self._transition is not None:
             directive, _started = self._transition
             if any(
@@ -840,6 +977,23 @@ class Supervisor:
             if flight is not None:
                 parts.append(flight)
             self._log(f"  post-mortem rank {rank}: " + ", ".join(parts))
+        if self.autoscaler is not None:
+            # the controller's side of the story: its state, the last
+            # decision, and any TYPED refusal (AutoscaleRefusedError) so a
+            # scale-up the preflight vote refused is triaged from here
+            ctrl = self.autoscaler
+            bits = [f"state {ctrl.state}", f"n={ctrl.current_n}"]
+            decision = ctrl.last_decision()
+            if decision is not None:
+                bits.append(
+                    f"last decision {decision.kind} -> n={decision.target_n} "
+                    f"({decision.reason})"
+                )
+            if ctrl.last_refusal is not None:
+                bits.append(
+                    f"{type(ctrl.last_refusal).__name__}: {ctrl.last_refusal}"
+                )
+            self._log("  post-mortem autoscaler: " + ", ".join(bits))
         self._log(f"not restarting: {why_final}")
 
     # -- entry point -----------------------------------------------------------

@@ -392,6 +392,140 @@ def test_membership_epoch_before_install_bug_caught_and_replayable():
 
 
 # ---------------------------------------------------------------------------
+# closed-loop autoscaler (controller <-> transition executor)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.autoscale
+def test_autoscaler_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.autoscaler_model(), max_schedules=N_SCHEDULES, name="autoscaler"
+    )
+    _BATTERY_SECONDS["autoscaler"] = time.monotonic() - t0
+    assert result.ok, (
+        f"autoscaler invariant failed on schedule {result.failing_schedule}: "
+        f"{result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.autoscale
+def test_autoscaler_invariants_hold_seeded():
+    result = sweep_seeds(
+        pm.autoscaler_model(), n_seeds=100, base_seed=61, name="autoscaler-seeded"
+    )
+    assert result.ok, f"seed {result.failing_seed}: {result.failure}"
+    assert result.distinct_schedules == 100
+
+
+@pytest.mark.autoscale
+def test_autoscaler_refusal_backoff_holds():
+    # the preflight vote refuses the first scale-up: the controller must back
+    # off typed and retry at most once per window, on every interleaving
+    result = explore(
+        pm.autoscaler_model(refuse_up=True),
+        max_schedules=N_SCHEDULES,
+        name="autoscaler-refuse",
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+
+
+@pytest.mark.autoscale
+def test_autoscaler_crash_racing_directive_holds():
+    # a transition dying mid-flight hands the cluster to the recovery ladder;
+    # the controller must never issue while it recovers, and never deadlock
+    result = explore(
+        pm.autoscaler_model(crash_up=True),
+        max_schedules=N_SCHEDULES,
+        name="autoscaler-crash",
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+
+
+@pytest.mark.autoscale
+def test_autoscaler_double_directive_bug_caught_and_replayable():
+    result = explore(
+        pm.autoscaler_model(bug="double_directive"),
+        max_schedules=400,
+        name="autoscaler-double",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the double-directive regression went undetected"
+    )
+    assert "two membership transitions in flight" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="two membership transitions"):
+        run_once(
+            pm.autoscaler_model(bug="double_directive"),
+            choices=result.failing_schedule,
+        )
+
+
+@pytest.mark.autoscale
+def test_autoscaler_cooldown_skip_bug_caught_with_seed():
+    # the back-to-back issue needs the executor to complete BETWEEN two
+    # controller ticks — deep in the decision tree, where seeded walks reach
+    # faster than root-systematic DFS (same split as the membership
+    # release-before-drain battery)
+    result = sweep_seeds(
+        pm.autoscaler_model(bug="cooldown_skip"),
+        n_seeds=200,
+        base_seed=71,
+        name="autoscaler-cooldown",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the cooldown-skip regression went undetected"
+    )
+    assert "cooldown violated" in str(result.failure)
+    assert result.failing_seed is not None
+    # the SEED alone reproduces the storm (deterministic walk)
+    with pytest.raises(InvariantViolation, match="cooldown violated"):
+        run_once(
+            pm.autoscaler_model(bug="cooldown_skip"), seed=result.failing_seed
+        )
+
+
+@pytest.mark.autoscale
+def test_autoscaler_refusal_retry_storm_caught_with_seed():
+    # the storm needs the refusal to land BETWEEN controller ticks before the
+    # cooldown re-opens — deep in the tree, seeded walks reach it
+    result = sweep_seeds(
+        pm.autoscaler_model(refuse_up=True, bug="refusal_retry"),
+        n_seeds=200,
+        base_seed=81,
+        name="autoscaler-retry-storm",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the refusal-retry storm went undetected"
+    )
+    assert "backoff window" in str(result.failure)
+    assert result.failing_seed is not None
+    with pytest.raises(InvariantViolation, match="backoff window"):
+        run_once(
+            pm.autoscaler_model(refuse_up=True, bug="refusal_retry"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.autoscale
+def test_autoscaler_no_shed_first_bug_caught_and_replayable():
+    result = explore(
+        pm.autoscaler_model(bug="no_shed_first"),
+        max_schedules=400,
+        name="autoscaler-no-shed",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the shed-first ordering regression went undetected"
+    )
+    assert "shed-first" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="shed-first"):
+        run_once(
+            pm.autoscaler_model(bug="no_shed_first"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
 # PWA101 <-> model check: the same inversion caught both ways
 # ---------------------------------------------------------------------------
 
@@ -448,7 +582,9 @@ def test_model_check_battery_within_budget():
     # the acceptance batteries above recorded their own wall time (no work is
     # redone here); each 200-schedule explore is a few seconds solo, and the
     # documented <60 s budget must hold even under full-suite load
-    if set(_BATTERY_SECONDS) != {"fence", "ckpt", "encsvc", "membership"}:
+    if set(_BATTERY_SECONDS) != {
+        "fence", "ckpt", "encsvc", "membership", "autoscaler",
+    }:
         pytest.skip("acceptance batteries did not run in this session (-k selection)")
     total = sum(_BATTERY_SECONDS.values())
     assert total < 60, f"model-check acceptance batteries too slow: {_BATTERY_SECONDS}"

@@ -1,0 +1,204 @@
+"""Serving-plane brownout integration (ISSUE 13): the noisy-neighbor flood
+and the reshard quiesce window against a LIVE REST route.
+
+Lives at the end of the suite's alphabetical order on purpose: these tests
+start real `pw.run` engines behind REST connectors, and streaming REST
+sources run forever (daemon threads) — parked here, their residual idle load
+cannot skew earlier timing-sensitive tests (the fusion profiler-attribution
+assertions in particular). Keep new always-on-server tests in this file.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.brownout import get_brownout, reset_brownout
+from pathway_tpu.internals.parse_graph import G
+
+pytestmark = pytest.mark.autoscale
+
+# -- serving plane: noisy neighbor + quiesce window ---------------------------
+
+
+def _start_rest_echo(port: int, *, max_pending: int, delay_s: float):
+    """A REST route backed by a deliberately slow engine pipeline (echo with
+    a per-row sleep) — the downstream pressure the brownout/shed path needs."""
+    from pathway_tpu.io.http import PathwayWebserver, rest_connector
+
+    G.clear()
+    ws = PathwayWebserver(host="127.0.0.1", port=port)
+
+    class Q(pw.Schema):
+        text: str
+
+    queries, writer = rest_connector(
+        webserver=ws, route="/v1/retrieve", schema=Q,
+        max_pending=max_pending, delete_completed_queries=True,
+        # these engines outlive the test as daemon threads (REST sources
+        # stream forever); a lazy commit tick keeps their idle churn from
+        # loading the rest of the suite's timing-sensitive tests
+        autocommit_duration_ms=25,
+    )
+
+    def slow_echo(t):
+        time.sleep(delay_s)
+        return t
+
+    writer(queries.select(result=pw.apply(slow_echo, pw.this.text)))
+    threading.Thread(
+        target=lambda: pw.run(monitoring_level=pw.MonitoringLevel.NONE),
+        daemon=True,
+    ).start()
+    deadline = time.monotonic() + 20
+    while True:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return
+        except OSError:
+            assert time.monotonic() < deadline, "REST server never came up"
+            time.sleep(0.2)
+
+
+def _post(port: int, text: str, client: str, timeout: float):
+    """POST one retrieve; returns (status_code, elapsed_s)."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/retrieve",
+        data=json.dumps({"text": text}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-Pathway-Client": client,
+        },
+    )
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status, time.monotonic() - t0
+    except urllib.error.HTTPError as exc:
+        exc.read()
+        return exc.code, time.monotonic() - t0
+    except Exception:
+        # transient transport hiccup (reset/timeout under suite load): report
+        # it as code 0 so caller loops retry instead of the THREAD dying
+        return 0, time.monotonic() - t0
+
+
+@pytest.mark.chaos
+def test_noisy_neighbor_flood_attributed_and_other_clients_bounded(monkeypatch):
+    """ROADMAP item-5 chaos op, landed against the global cap: one client
+    floods ``/v1/retrieve`` (parameters from the chaos ``noisy_neighbor``
+    plan op); the flood's sheds are ATTRIBUTED to it on the per-client shed
+    counters, and the polite client's completion times stay bounded — shed
+    fast with an honest Retry-After, never hung behind the flood."""
+    from pathway_tpu.engine import telemetry
+    from pathway_tpu.internals.chaos import get_chaos, reset_chaos
+
+    monkeypatch.setenv("PATHWAY_CHAOS_PLAN", json.dumps({
+        "load": {"op": "noisy_neighbor", "client": "flood", "rps": 60, "rows": 1},
+    }))
+    monkeypatch.setenv("PATHWAY_CHAOS_SEED", "1")
+    reset_chaos()
+    try:
+        params = get_chaos().noisy_neighbor()
+        assert params is not None
+        port = 18791
+        # 8 serial flood workers against a cap of 4: the flood EXCEEDS the
+        # admission cap by construction, not by a timing race
+        n_flood = 8
+        _start_rest_echo(port, max_pending=4, delay_s=0.08)
+
+        stop = threading.Event()
+        flood_results: list = []
+        flood_lock = threading.Lock()
+
+        def flood_worker():
+            gap = n_flood / max(1.0, params["rps"])  # workers share the rps
+            while not stop.is_set():
+                code, _t = _post(port, "flood query", params["client"], 30)
+                with flood_lock:
+                    flood_results.append(code)
+                time.sleep(gap)
+
+        floods = [
+            threading.Thread(target=flood_worker, daemon=True)
+            for _ in range(n_flood)
+        ]
+        for t in floods:
+            t.start()
+        time.sleep(1.0)  # let the flood saturate the admission cap
+        polite: list = []  # (final_code, total_s incl. honest retries)
+        try:
+            for i in range(6):
+                t0 = time.monotonic()
+                code = None
+                while time.monotonic() - t0 < 12.0:
+                    code, _t = _post(port, f"polite {i}", "polite", 30)
+                    if code == 200:
+                        break
+                    # the polite client honors Retry-After (bounded for the
+                    # test): a shed is a FAST, honest signal, not a hang
+                    time.sleep(0.5)
+                polite.append((code, time.monotonic() - t0))
+        finally:
+            stop.set()
+        for t in floods:
+            t.join(timeout=10)
+        # the flood was shed (429s) — and attributed to ITS client id
+        assert any(code == 429 for code in flood_results), flood_results
+        stages = telemetry.stage_snapshot("rest.shed")
+        flood_sheds = stages.get("rest.shed.client.flood", 0.0)
+        polite_sheds = stages.get("rest.shed.client.polite", 0.0)
+        assert flood_sheds > 0, stages
+        assert flood_sheds >= polite_sheds
+        # the polite client is BOUNDED: every request completed (served after
+        # honest retries) well inside the window instead of hanging behind
+        # the flood — the shed-fast + Retry-After contract
+        assert all(code == 200 for code, _t in polite), polite
+        assert max(t for _c, t in polite) < 12.0, polite
+        assert all(code in (0, 200, 429) for code in flood_results)
+    finally:
+        reset_chaos()
+        reset_brownout()
+
+
+@pytest.mark.chaos
+def test_quiesce_window_serves_429_not_hangs():
+    """While a membership transition has the commit loop paused, admitted
+    requests would hang until C+1 — the REST plane must shed with 429 + the
+    expected remaining pause as Retry-After instead (and recover the moment
+    the quiesce lifts)."""
+    from pathway_tpu.engine import telemetry
+
+    port = 18797
+    _start_rest_echo(port, max_pending=64, delay_s=0.0)
+    reset_brownout()
+    try:
+        code, _t = _post(port, "before", "c1", 20)
+        assert code == 200
+        get_brownout().enter_quiesce(3.0)
+        before = telemetry.stage_snapshot("rest.").get("rest.quiesce_shed", 0.0)
+        t0 = time.monotonic()
+        code, elapsed = _post(port, "during", "c1", 20)
+        assert code == 429
+        assert elapsed < 5.0  # shed fast, not parked until the pause ends
+        assert (
+            telemetry.stage_snapshot("rest.").get("rest.quiesce_shed", 0.0)
+            > before
+        )
+        get_brownout().exit_quiesce()
+        code, _t = _post(port, "after", "c1", 20)
+        assert code == 200
+        assert time.monotonic() - t0 < 20
+    finally:
+        reset_brownout()
+
+
