@@ -20,6 +20,13 @@ threaded source instead of user graphs: PWA101 lock-order cycles, PWA102
 unbounded waits, PWA103 unlocked shared writes, PWA104 thread-lifecycle
 hygiene — surfaced as ``cli analyze --runtime`` (same exit-code contract) and
 the ``PATHWAY_RUNTIME_LINT`` gate.
+
+A third family (``analysis/resources.py``) proves resource lifecycles and
+exception contracts over the same substrate: PWA201 acquire/release pairing,
+PWA202 typed-error swallowing, PWA203 write-only state, PWA204 exception-
+masking ``finally`` blocks, PWA205 telemetry-contract drift — folded into
+``cli analyze --runtime`` alongside PWA10x and gated independently by
+``PATHWAY_RESOURCE_LINT``.
 """
 
 from __future__ import annotations
@@ -55,6 +62,22 @@ from pathway_tpu.analysis.concurrency import (
     analyze_source,
     default_concurrency_passes,
     runtime_gate,
+)
+from pathway_tpu.analysis.resources import (
+    RESOURCE_MODULES,
+    AcquireReleasePass,
+    DeadStatePass,
+    FinallyMaskPass,
+    ResourceAnalysisContext,
+    ResourcePass,
+    TelemetryContractPass,
+    TypedErrorSwallowPass,
+    analyze_resource_source,
+    analyze_resources,
+    analyze_runtime_full,
+    build_resource_context,
+    default_resource_passes,
+    resource_gate,
 )
 from pathway_tpu.analysis.passes import (
     CheckpointCompatibilityPass,
@@ -96,6 +119,20 @@ __all__ = [
     "analyze_source",
     "default_concurrency_passes",
     "runtime_gate",
+    "RESOURCE_MODULES",
+    "AcquireReleasePass",
+    "DeadStatePass",
+    "FinallyMaskPass",
+    "ResourceAnalysisContext",
+    "ResourcePass",
+    "TelemetryContractPass",
+    "TypedErrorSwallowPass",
+    "analyze_resource_source",
+    "analyze_resources",
+    "analyze_runtime_full",
+    "build_resource_context",
+    "default_resource_passes",
+    "resource_gate",
 ]
 
 _CAPTURE_ENV = "PATHWAY_LINT_CAPTURE"

@@ -47,7 +47,6 @@ from __future__ import annotations
 import ast
 import os
 import re
-import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
@@ -1187,35 +1186,16 @@ def analyze_runtime(
     """Run the PWA101–104 pipeline over the runtime modules (or ``paths``).
     Same report type as the graph lint: JSON shape, exit-code contract, and
     ``emit_telemetry`` all carry over."""
+    from pathway_tpu.analysis.framework import run_runtime_passes
+
     if ctx is None:
         ctx = build_runtime_context(paths)
     if passes is None:
         passes = default_concurrency_passes()
-    diagnostics: List[Diagnostic] = []
-    timings: Dict[str, float] = {}
-    for p in passes:
-        t0 = time.perf_counter()
-        try:
-            found = p.run(ctx)
-        except Exception as exc:
-            # a broken pass must not crash the gate — but it must not report
-            # CLEAN either: a warning keeps exit 1 (2 under --strict), so CI
-            # sees that this pass silently checked nothing
-            d = Diagnostic(
-                code=p.code,
-                severity=Severity.WARNING,
-                message=(
-                    f"concurrency pass crashed ({type(exc).__name__}: {exc}); "
-                    "its diagnostics are unavailable for this tree — the "
-                    f"{p.code} guarantee is NOT being checked"
-                ),
-            )
-            found = [d]
-        diagnostics.extend(found)
-        timings[p.code] = time.perf_counter() - t0
-    diagnostics.sort(key=lambda d: (-int(d.severity), d.code, d.file or "", d.line or 0))
-    n_funcs = sum(1 for _ in _iter_funcs(ctx))
-    return AnalysisReport(diagnostics, node_count=n_funcs, pass_seconds=timings)
+    return run_runtime_passes(
+        passes, ctx, family="concurrency",
+        node_count=sum(1 for _ in _iter_funcs(ctx)),
+    )
 
 
 def analyze_source(source: str, name: str = "planted") -> AnalysisReport:
@@ -1232,28 +1212,12 @@ def runtime_gate() -> None:
     runtime's own concurrency before a run. ``warn`` logs and mirrors counters;
     ``error`` refuses the run on any PWA101–104 error. The report is cached
     process-wide — the runtime source cannot change under a live process."""
-    import logging
+    from pathway_tpu.analysis.framework import enforce_gate, gate_mode
 
-    mode = os.environ.get("PATHWAY_RUNTIME_LINT", "off").strip().lower()
-    if mode in ("off", "0", "false", "no", "none", ""):
+    mode = gate_mode("PATHWAY_RUNTIME_LINT")
+    if mode is None:
         return
-    if mode not in ("warn", "error"):
-        logging.getLogger("pathway_tpu.analysis").warning(
-            "unrecognized PATHWAY_RUNTIME_LINT=%r (expected off|warn|error); "
-            "falling back to 'warn'",
-            mode,
-        )
-        mode = "warn"
     global _cached_report
     if _cached_report is None:
         _cached_report = analyze_runtime()
-    report = _cached_report
-    report.emit_telemetry()
-    if report.diagnostics:
-        log = logging.getLogger("pathway_tpu.analysis")
-        for d in report.errors + report.warnings:
-            log.warning("%s", d.format())
-    if mode == "error" and report.errors:
-        from pathway_tpu.analysis.framework import GraphLintError
-
-        raise GraphLintError(report)
+    enforce_gate(_cached_report, mode)

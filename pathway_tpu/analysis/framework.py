@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import enum
 import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Set, Tuple
@@ -237,10 +238,19 @@ class AnalysisReport:
         *,
         node_count: int = 0,
         pass_seconds: "Dict[str, float] | None" = None,
+        pass_checked: "Dict[str, bool] | None" = None,
     ):
         self.diagnostics = diagnostics
         self.node_count = node_count
         self.pass_seconds = pass_seconds or {}
+        # per-pass "did it actually run": a crashed pass reports False so the
+        # lost coverage is machine-visible in the JSON output, not just a
+        # "NOT being checked" warning a CI grep can miss
+        self.pass_checked = (
+            pass_checked
+            if pass_checked is not None
+            else {code: True for code in self.pass_seconds}
+        )
 
     @property
     def errors(self) -> List[Diagnostic]:
@@ -282,6 +292,7 @@ class AnalysisReport:
                 "info": len(self.infos),
                 "nodes": self.node_count,
                 "pass_seconds": {k: round(v, 6) for k, v in self.pass_seconds.items()},
+                "checked": dict(sorted(self.pass_checked.items())),
             },
         }
 
@@ -329,6 +340,72 @@ class GraphLintError(Exception):
         super().__init__("\n".join(lines))
 
 
+def run_runtime_passes(passes: List[Any], ctx: Any, *, family: str, node_count: int) -> AnalysisReport:
+    """Shared pass-runner for the runtime lint families (PWA10x concurrency,
+    PWA20x resources): per-pass timings + ``checked`` flags, the crashed-pass
+    "NOT being checked" WARNING (a silently-dead pass must not report the tree
+    clean — exit 1, 2 under --strict), and the severity/code/location sort."""
+    diagnostics: List[Diagnostic] = []
+    timings: Dict[str, float] = {}
+    checked: Dict[str, bool] = {}
+    for p in passes:
+        t0 = time.perf_counter()
+        try:
+            found = p.run(ctx)
+            checked[p.code] = True
+        except Exception as exc:
+            found = [
+                Diagnostic(
+                    code=p.code,
+                    severity=Severity.WARNING,
+                    message=(
+                        f"{family} pass crashed ({type(exc).__name__}: {exc}); "
+                        "its diagnostics are unavailable for this tree — the "
+                        f"{p.code} guarantee is NOT being checked"
+                    ),
+                )
+            ]
+            checked[p.code] = False
+        diagnostics.extend(found)
+        timings[p.code] = time.perf_counter() - t0
+    diagnostics.sort(key=lambda d: (-int(d.severity), d.code, d.file or "", d.line or 0))
+    return AnalysisReport(
+        diagnostics, node_count=node_count, pass_seconds=timings, pass_checked=checked
+    )
+
+
+def gate_mode(env_var: str) -> "str | None":
+    """Parse a ``<env_var>=off|warn|error`` lint-gate knob (default ``off``).
+    ``None`` means off; an unrecognized value falls back LOUDLY to ``warn``
+    instead of silently disarming the gate."""
+    import logging
+
+    mode = os.environ.get(env_var, "off").strip().lower()
+    if mode in ("off", "0", "false", "no", "none", ""):
+        return None
+    if mode not in ("warn", "error"):
+        logging.getLogger("pathway_tpu.analysis").warning(
+            "unrecognized %s=%r (expected off|warn|error); falling back to 'warn'",
+            env_var, mode,
+        )
+        mode = "warn"
+    return mode
+
+
+def enforce_gate(report: AnalysisReport, mode: str) -> None:
+    """The shared warn/error gate tail: mirror telemetry, log findings, and
+    under ``error`` refuse the run on any error-severity diagnostic."""
+    import logging
+
+    report.emit_telemetry()
+    if report.diagnostics:
+        log = logging.getLogger("pathway_tpu.analysis")
+        for d in report.errors + report.warnings:
+            log.warning("%s", d.format())
+    if mode == "error" and report.errors:
+        raise GraphLintError(report)
+
+
 class GraphCaptureInterrupt(BaseException):
     """Raised by ``GraphRunner.run`` under ``PATHWAY_LINT_CAPTURE=1``: the graph
     is fully built and the program must not execute. Derives from BaseException
@@ -366,10 +443,12 @@ class PassManager:
             ctx = AnalysisContext(graph, persistence=persistence)
         diagnostics: List[Diagnostic] = []
         timings: Dict[str, float] = {}
+        checked: Dict[str, bool] = {}
         for p in self.passes:
             t0 = time.perf_counter()
             try:
                 found = p.run(ctx)
+                checked[p.code] = True
             except Exception as exc:  # a broken pass must never block a run
                 found = [
                     p.diag(
@@ -378,9 +457,11 @@ class PassManager:
                         "its diagnostics are unavailable for this graph",
                     )
                 ]
+                checked[p.code] = False
             diagnostics.extend(found)
             timings[p.code] = time.perf_counter() - t0
         diagnostics.sort(key=lambda d: (-int(d.severity), d.code, d.node_id))
         return AnalysisReport(
-            diagnostics, node_count=len(ctx.nodes), pass_seconds=timings
+            diagnostics, node_count=len(ctx.nodes), pass_seconds=timings,
+            pass_checked=checked,
         )

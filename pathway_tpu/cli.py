@@ -204,16 +204,20 @@ def replay(threads, processes, first_port, record_path, mode, continue_after_rep
 @click.option(
     "--runtime",
     is_flag=True,
-    help="lint the runtime's own threaded modules (PWA101-PWA104 concurrency "
-    "passes: lock-order cycles, unbounded waits, unlocked shared writes, "
-    "thread lifecycle) instead of a user program; PROGRAM is not required",
+    help="lint the runtime's own modules (PWA101-PWA104 concurrency passes "
+    "plus PWA201-PWA205 resource-lifecycle/exception-contract passes: "
+    "lock-order cycles, unbounded waits, unlocked shared writes, thread "
+    "lifecycle, acquire/release pairing, typed-error swallowing, write-only "
+    "state, finally masking, telemetry drift) instead of a user program; "
+    "PROGRAM is not required",
 )
 @click.argument("program", required=False)
 @click.argument("arguments", nargs=-1)
 def analyze(fmt, strict, runtime, program, arguments):
     """Static graph lint: build PROGRAM's dataflow graph without running it and
     report PWA001-PWA005 diagnostics (or, with ``--runtime``, lint the
-    runtime's own concurrency: PWA101-PWA104 over the threaded modules).
+    runtime's own source: PWA101-PWA104 concurrency over the threaded modules
+    plus PWA201-PWA205 resource-lifecycle/exception contracts).
 
     Exit-code contract (CI-gateable without parsing text): 0 = clean,
     1 = warnings only (2 with --strict), 2 = errors, 3 = PROGRAM itself crashed
@@ -231,9 +235,9 @@ def analyze(fmt, strict, runtime, program, arguments):
                 "--runtime lints the runtime itself and takes no PROGRAM; "
                 "run `analyze PROGRAM` separately for the graph lint"
             )
-        from pathway_tpu.analysis import analyze_runtime
+        from pathway_tpu.analysis import analyze_runtime_full
 
-        report = analyze_runtime()
+        report = analyze_runtime_full()
         report.emit_telemetry()
         if fmt.lower() == "json":
             click.echo(report.to_json())

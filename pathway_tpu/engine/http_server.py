@@ -174,8 +174,22 @@ class MonitoringServer:
                         # ...but a failing probe callback is NOT healthy
                         # either: keep HTTP 200 + alive (the process serves),
                         # and surface the degradation instead of masking it
-                        # behind a synthetic "running"
-                        payload = {"error": str(exc), "state": "degraded"}
+                        # behind a synthetic "running". Typed peer errors are
+                        # triaged first (PWA202 discipline): a probe aborted
+                        # by the epoch fence means the worker is FENCING, a
+                        # recoverable protocol state the supervisor reads —
+                        # not a generic degradation
+                        from pathway_tpu.parallel.cluster import (
+                            PeerShutdownError,
+                            PeerTimeoutError,
+                        )
+
+                        state = (
+                            "fencing"
+                            if isinstance(exc, (PeerShutdownError, PeerTimeoutError))
+                            else "degraded"
+                        )
+                        payload = {"error": str(exc), "state": state}
                     payload.setdefault("alive", True)
                     # degraded-cluster observability: the runner reports
                     # "fencing"/"rejoining" during a surgical restart, plus

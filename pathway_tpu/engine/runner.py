@@ -71,7 +71,6 @@ class GraphRunner:
         # operator chains collapsed into compiled ChainPrograms; None = stock
         # per-node dispatch (PATHWAY_FUSION=off, nested runners, nothing fuses)
         self._fusion_schedule: "List[Any] | None" = None
-        self._fusion_plan: Any = None
         # one AnalysisContext per runner, shared by the lint gate and the
         # fusion planner (building it twice = two full DAG walks per pw.run)
         self._analysis_ctx: Any = None
@@ -79,7 +78,6 @@ class GraphRunner:
         # protocol) + incremental rewind (undo record + mesh serve log)
         self._ckpt_interval_s = 0.0  # 0 = coordinated checkpoints off
         self._ckpt_compact = True  # PATHWAY_CHECKPOINT_COMPACT=0 disables
-        self._ckpt_attempts = 0
         self._ckpt_disabled_reason: "str | None" = None
         self._manifest_commit: "int | None" = None  # last durable manifest
         self._undo_depth = 0  # PATHWAY_UNDO_RING_DEPTH; 0 = rewind rung off
@@ -665,7 +663,6 @@ class GraphRunner:
         and the materialization set exist — journal replay already executes
         fused."""
         self._fusion_schedule = None
-        self._fusion_plan = None
         if self._materialize_all or self._fusion_mode() == "off":
             # nested iterate runners share the outer commit's substep; fusing
             # them would double-attribute and complicate the inner fixpoint
@@ -674,7 +671,6 @@ class GraphRunner:
         from pathway_tpu.engine.fusion import build_schedule
 
         plan = plan_fusion(self._analysis_context())
-        self._fusion_plan = plan
         self._fusion_schedule = build_schedule(self, plan)
         if self._fusion_schedule is not None and self._recorder is not None:
             # the region plan rides the flight recorder so a post-mortem dump
@@ -843,7 +839,6 @@ class GraphRunner:
 
         cluster = self._cluster
         t0 = time_mod.monotonic()
-        self._ckpt_attempts += 1
         epoch = getattr(cluster, "epoch", 0)
         if self._chaos is not None:
             self._chaos.begin_checkpoint_attempt()
@@ -2481,14 +2476,16 @@ class GraphRunner:
         ``error`` an error-severity finding refuses the run (GraphLintError)."""
         import logging
 
-        # the runtime's OWN concurrency (PWA101-104) gate rides here too but
-        # is an independent knob: PATHWAY_LINT=off must not disarm it.
-        # Default off — the runtime tree changes with the package, not the
-        # user program, so CI runs `cli analyze --runtime` instead of every
-        # pw.run paying a re-parse
-        from pathway_tpu.analysis import runtime_gate
+        # the runtime's OWN concurrency (PWA101-104) and resource/exception
+        # (PWA201-205) gates ride here too but are independent knobs:
+        # PATHWAY_LINT=off must not disarm them. Both default off — the
+        # runtime tree changes with the package, not the user program, so CI
+        # runs `cli analyze --runtime` instead of every pw.run paying a
+        # re-parse
+        from pathway_tpu.analysis import resource_gate, runtime_gate
 
         runtime_gate()
+        resource_gate()
         mode = os.environ.get("PATHWAY_LINT", "warn").strip().lower()
         if mode in ("off", "0", "false", "no", "none", ""):
             return

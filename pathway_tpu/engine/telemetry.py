@@ -70,6 +70,52 @@ def span(name: str, **attributes: Any) -> Iterator[None]:
 _stage_lock = threading.Lock()
 _stage_counters: Dict[str, float] = {}
 
+#: THE registered stage-counter namespaces. Every ``stage_add``/``stage_timer``
+#: /``stage_add_many`` literal must live under one of these prefixes — the
+#: PWA205 telemetry-contract lint (``analysis/resources.py``) enforces it
+#: statically, so a typo'd or forked counter name fails ``cli analyze
+#: --runtime`` instead of silently diverging from the /metrics dashboards.
+#: Adding a new subsystem = adding its prefix HERE (one home, greppable).
+STAGE_NAMESPACES: "tuple[str, ...]" = (
+    "autoscale.",   # closed-loop autoscaler decisions/flaps
+    "brownout.",    # overload-degradation ladder rungs + quiesce
+    "cluster.",     # mesh fences/rejoins/membership/reshard
+    "embed.",       # embed pipeline, caches, encoder service (embed.svc.*)
+    "eval.",        # batch-UDF evaluation
+    "exchange.",    # per-peer traffic + barrier waits/stragglers
+    "fuse.",        # whole-commit fusion planner/jit
+    "lint.",        # graph/runtime lint diagnostics
+    "modelcheck.",  # deterministic schedule exploration
+    "persist.",     # checkpoints, journal compaction
+    "rest.",        # REST admission/shed plane
+)
+
+#: registered flight-recorder event kinds (``FlightRecorder.record_event``
+#: literals) — same contract as STAGE_NAMESPACES, enforced by PWA205 so
+#: post-mortem tooling keyed on these names cannot silently miss an event.
+FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
+    "autoscale",
+    "barrier_timeout",
+    "brownout",
+    "chaos_checkpoint_kill",
+    "chaos_kill",
+    "checkpoint",
+    "checkpoint_deferred",
+    "drained",
+    "fence",
+    "fence_broadcast",
+    "fence_received",
+    "fusion",
+    "lint",
+    "membership",
+    "membership_applied",
+    "membership_left",
+    "modelcheck",
+    "peer_stale",
+    "rejoin",
+    "rejoin_installed",
+})
+
 
 def stage_add(name: str, value: float = 1.0) -> None:
     """Add ``value`` to the cumulative counter ``name``."""

@@ -192,6 +192,21 @@ def fence_rejoin_model(
                     f"survivor {surv.idx} delivered {len(post)} post-install "
                     f"frames, expected {expect}"
                 )
+                # install + frame conservation (last, so the planted-bug
+                # batteries keep their original first-failure messages):
+                # adopting the epoch must have gone THROUGH install(), and
+                # every frame addressed to a survivor (zombie + replacement +
+                # each peer) is accounted for — delivered or dropped stale,
+                # never silently vanished
+                assert surv.installed, (
+                    f"survivor {surv.idx} adopted epoch {new_epoch} without "
+                    "running install()"
+                )
+                assert len(surv.delivered) + surv.stale_dropped == n_survivors + 1, (
+                    f"survivor {surv.idx} frame accounting broke: "
+                    f"{len(surv.delivered)} delivered + {surv.stale_dropped} "
+                    f"stale-dropped != {n_survivors + 1} sent"
+                )
 
         return check
 
@@ -945,7 +960,9 @@ def membership_model(
                 assert not mm.parked, f"rank {m} stranded parked frames"
                 for frame_epoch, at_epoch, slot in mm.delivered:
                     assert frame_epoch == at_epoch, (
-                        f"stale-epoch delivery on rank {m} (slot {slot})"
+                        f"stale-epoch delivery on rank {m} (slot {slot}; "
+                        f"{mm.stale_dropped} other stale frames were dropped "
+                        "correctly)"
                     )
                 assert not mm.bad_rows, (
                     f"rows delivered to a non-owner on rank {m}: {mm.bad_rows}"

@@ -771,8 +771,8 @@ class TableSlice:
             raise AttributeError(name)
         try:
             return self._mapping[name]
-        except KeyError:
-            raise AttributeError(name)
+        except KeyError as exc:
+            raise AttributeError(name) from exc
 
     def without(self, *cols: Any) -> "TableSlice":
         drop = {_name_of(c) for c in cols}

@@ -33,12 +33,12 @@ def write(
         try:
             from google.cloud import bigquery
             from google.oauth2.service_account import Credentials
-        except ImportError:
+        except ImportError as exc:
             raise ImportError(
                 "no BigQuery client library (google-cloud-bigquery) is available "
                 "in this environment; pass _client=... (any object with the "
                 "bigquery.Client insert_rows_json surface)"
-            )
+            ) from exc
         if service_user_credentials_file is not None:
             credentials = Credentials.from_service_account_file(
                 service_user_credentials_file

@@ -108,11 +108,11 @@ def read(
     if _consumer_factory is None:
         try:
             import confluent_kafka  # noqa: F401
-        except ImportError:
+        except ImportError as exc:
             raise ImportError(
                 "no Kafka client library is available in this environment; pass "
                 "_consumer_factory=... or use pw.io.debezium.read_from_iterable(...)"
-            )
+            ) from exc
     names = schema.column_names()
     pk_cols = schema.primary_key_columns()
 

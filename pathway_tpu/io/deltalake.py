@@ -22,11 +22,11 @@ def _require() -> Any:
         import deltalake
 
         return deltalake
-    except ImportError:
+    except ImportError as exc:
         raise ImportError(
             "the deltalake package is not available in this environment; export the "
             "table to parquet/csv and use pw.io.fs.read, or install deltalake"
-        )
+        ) from exc
 
 
 def read(

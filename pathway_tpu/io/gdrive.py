@@ -20,11 +20,11 @@ def read(
     try:
         from googleapiclient.discovery import build  # noqa: F401
         from google.oauth2.service_account import Credentials
-    except ImportError:
+    except ImportError as exc:
         raise ImportError(
             "google-api-python-client is not available in this environment; "
             "sync the Drive folder to disk and use pw.io.fs.read instead"
-        )
+        ) from exc
 
     from pathway_tpu.internals import schema as sch
     from pathway_tpu.io.python import ConnectorSubject, read as py_read

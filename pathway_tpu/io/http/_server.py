@@ -277,11 +277,8 @@ class RestServerSubject:
         self.shed_by_client: Dict[str, int] = {}
         self._counter = 0
         self._lock = threading.Lock()
-        self._source: StreamingDataSource | None = None
 
     def run(self, source: StreamingDataSource) -> None:
-        self._source = source
-
         async def handler(request: Any) -> Any:
             import aiohttp.web as web
 

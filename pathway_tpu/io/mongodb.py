@@ -34,12 +34,12 @@ def write(
     if _client is None:
         try:
             import pymongo
-        except ImportError:
+        except ImportError as exc:
             raise ImportError(
                 "no MongoDB client library (pymongo) is available in this "
                 "environment; pass _client=... (any object with the pymongo "
                 "MongoClient surface)"
-            )
+            ) from exc
         _client = pymongo.MongoClient(connection_string)
     coll = _client[database][collection]
     add_batched_sink(

@@ -23,11 +23,11 @@ def _require() -> Any:
         import nats
 
         return nats
-    except ImportError:
+    except ImportError as exc:
         raise ImportError(
             "nats-py is not available in this environment; use "
             "pw.io.nats.read_from_iterable(...) or pw.io.python.read(...)"
-        )
+        ) from exc
 
 
 def read(

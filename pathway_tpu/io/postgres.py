@@ -70,11 +70,11 @@ def _connect(postgres_settings: dict) -> Any:
         import pg8000.dbapi
 
         return pg8000.dbapi.connect(**postgres_settings)
-    except ImportError:
+    except ImportError as exc:
         raise ImportError(
             "no PostgreSQL driver (psycopg2 / pg8000) is available in this "
             "environment; pass _connection_factory=... (any DB-API connection)"
-        )
+        ) from exc
 
 
 _PG_TYPES = {

@@ -16,8 +16,8 @@ def write(table: Table, publisher: Any, project_id: str, topic_id: str, **kwargs
             from google.cloud import pubsub_v1
 
             publisher = pubsub_v1.PublisherClient()
-        except ImportError:
-            raise ImportError("google-cloud-pubsub is not available in this environment")
+        except ImportError as exc:
+            raise ImportError("google-cloud-pubsub is not available in this environment") from exc
     topic_path = publisher.topic_path(project_id, topic_id)
     futures: list[Any] = []
 

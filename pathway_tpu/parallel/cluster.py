@@ -129,7 +129,6 @@ class ClusterExchange:
         self._membership_from = max(
             0, int(_env_float("PATHWAY_MEMBERSHIP_FROM", 0))
         )
-        self._membership_target: Optional[tuple] = None  # (target_n, epoch)
         self._pending_rejoin: Dict[int, tuple] = {}  # rank -> (socket, epoch)
         self._fence_dead: "set[int]" = set()  # ranks peers told us died
         self._fence_pending = False
@@ -282,14 +281,14 @@ class ClusterExchange:
                     ("127.0.0.1", self.first_port + peer), timeout=5
                 )
                 break
-            except OSError:
+            except OSError as exc:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise PeerTimeoutError(
                         f"cluster process {self.me} could not reach peer "
                         f"{peer} on port {self.first_port + peer} within "
                         f"{connect_budget:.0f}s"
-                    )
+                    ) from exc
                 time.sleep(min(remaining, delay * (1.0 + 0.25 * rng.random())))
                 delay = min(delay * 2, 2.0)
         # back to fully blocking: create_connection's dial timeout must not
@@ -385,7 +384,6 @@ class ClusterExchange:
         higher-ranked joiners — members park our hello until their engines
         reach the membership quiesce point and install (``apply_membership``).
         """
-        self._membership_target = (self.n, self.epoch)
         if self._chaos is not None:
             # deterministic fault injection: a joiner killed before it ever
             # installs — the headline join-side crash of the transition
@@ -985,7 +983,6 @@ class ClusterExchange:
                             self.stale_frames_dropped += 1
                     self.n = new_n
                     self.epoch = new_epoch
-                    self._membership_target = None
                     self._cv.notify_all()
                 elif self._closed:
                     raise PeerShutdownError(

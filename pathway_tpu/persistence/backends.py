@@ -93,8 +93,13 @@ class RetryingObjectStore(ObjectStore):
             for attempt in range(s.max_retries + 1):
                 try:
                     return fun(*args)
-                except Exception:
-                    if attempt == s.max_retries:
+                except Exception as exc:
+                    # triage before retrying (PWA202 discipline): a not-found
+                    # raised by an inner client (instead of the None contract)
+                    # is DEFINITIVE — burning the whole backoff schedule on it
+                    # delays the caller's absent-checkpoint handling by the
+                    # full retry budget for nothing
+                    if _is_not_found(exc) or attempt == s.max_retries:
                         raise
                     time.sleep(delay + random.random() * s.jitter)
                     delay *= s.backoff_factor

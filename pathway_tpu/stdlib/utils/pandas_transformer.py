@@ -21,8 +21,8 @@ def _argument_index(fun: Callable, arg: Any) -> int | None:
     names = list(inspect.signature(fun).parameters)
     try:
         return names.index(arg)
-    except ValueError:
-        raise ValueError(f"wrong output universe. No argument of name: {arg}")
+    except ValueError as exc:
+        raise ValueError(f"wrong output universe. No argument of name: {arg}") from exc
 
 
 def pandas_transformer(

@@ -14,11 +14,11 @@ def _require_bokeh() -> None:
     try:
         import bokeh  # noqa: F401
         import panel  # noqa: F401
-    except ImportError:
+    except ImportError as exc:
         raise ImportError(
             "bokeh/panel are not available in this environment; use "
             "pw.viz.table_snapshot(table) for the raw updating data"
-        )
+        ) from exc
 
 
 class _SnapshotCollector:

@@ -28,6 +28,59 @@ except Exception:
 import pytest
 
 
+@pytest.fixture
+def leak_oracle():
+    """Dynamic resource-leak oracle — the PWA201 static model proven against
+    the live runtime. Snapshots this process's fds (with their targets) and
+    threads before the test and fails on growth after it: a leaked socket,
+    pipe, file handle, or thread surviving the test is exactly the
+    acquire-without-release class the resource lint hunts. A generous settling
+    grace absorbs teardown that legitimately takes a moment under full-suite
+    load (daemon reapers, GC-driven closes)."""
+    import gc
+    import threading
+    import time
+
+    fd_dir = "/proc/self/fd"
+
+    def fd_snapshot():
+        out = {}
+        for fd in os.listdir(fd_dir):
+            try:
+                out[fd] = os.readlink(os.path.join(fd_dir, fd))
+            except OSError:
+                pass  # raced a close (or the listdir fd itself)
+        return out
+
+    before_fds = fd_snapshot()
+    before_threads = {t.ident for t in threading.enumerate()}
+    yield
+    deadline = time.monotonic() + 60
+    while True:
+        gc.collect()
+        after_fds = fd_snapshot()
+        new_threads = [
+            t
+            for t in threading.enumerate()
+            if t.ident not in before_threads and t.is_alive()
+        ]
+        fd_growth = len(after_fds) - len(before_fds)
+        new_sockets = [
+            target
+            for fd, target in after_fds.items()
+            if fd not in before_fds and "socket" in target
+        ]
+        if fd_growth <= 0 and not new_threads and not new_sockets:
+            break
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                "leak oracle: resources grew across the test — "
+                f"fd growth {fd_growth} (new sockets: {new_sockets}), "
+                f"leaked threads: {[t.name for t in new_threads]}"
+            )
+        time.sleep(0.5)
+
+
 @pytest.fixture(autouse=True)
 def clear_graph():
     """Each test gets a fresh global parse graph."""
