@@ -2467,6 +2467,68 @@ class ExternalIndexEvaluator(Evaluator):
         # kb -> (key, qvec, limit, filter) for re-answering mode
         self.live_queries: Dict[bytes, tuple] = {}
 
+    # -- elastic membership: the rebuildable-descriptor contract -------------
+    #
+    # The index's data side is broadcast (every rank holds the FULL index),
+    # so its content is identical everywhere and a membership transition can
+    # replicate it to the new topology from ONE export instead of refusing —
+    # when the index can export one. The query side (replies, live_queries)
+    # is keyed by query row key and partitions like any keyed state.
+
+    def rebuild_supported(self) -> bool:
+        """True when the backing index exports a rebuildable descriptor
+        (keys + host vectors + filter data) — the membership preflight's
+        alternative to the blanket device-resident refusal."""
+        index = self.index
+        return (
+            getattr(index, "rebuild_descriptor", None) is not None
+            and getattr(getattr(index, "store", None), "export_rows", None)
+            is not None
+        )
+
+    def rebuild_descriptor(self) -> "Any | None":
+        if not self.rebuild_supported():
+            return None
+        return self.index.rebuild_descriptor()
+
+    def install_rebuild_descriptor(self, desc: Any) -> None:
+        if desc is not None:
+            self.index.install_rebuild_descriptor(desc)
+
+    def reshard_check(self) -> "str | None":
+        if self.rebuild_supported():
+            return None
+        return (
+            "external index state lives outside the snapshot protocol "
+            "(device-resident) and this index type exports no rebuildable "
+            "descriptor"
+        )
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        """Partition the QUERY-side state by row key (the index content
+        itself rides the replicated descriptor, not the keyed export)."""
+        from pathway_tpu.internals.keys import KEY_DTYPE
+
+        out: Dict[int, Any] = {}
+        for dest, part in self.replies.reshard_partition(owner_of).items():
+            out.setdefault(dest, {})["replies"] = part
+        for kb, (key, qvec, limit, flt) in self.live_queries.items():
+            keys = np.frombuffer(kb, dtype=KEY_DTYPE)
+            dest = int(np.asarray(owner_of(keys))[0])
+            out.setdefault(dest, {}).setdefault("live_queries", {})[kb] = (
+                key, _to_host(qvec), limit, flt,
+            )
+        return out
+
+    def reshard_import(self, payload: Any) -> None:
+        payload = payload or {}
+        part = payload.get("replies")
+        if part is not None:
+            keys, diffs, columns = part
+            if len(keys):
+                self.replies.apply(Delta(keys, diffs, columns))
+        self.live_queries.update(payload.get("live_queries", {}))
+
     def _search_batch(
         self, vecs: List[Any], limits: List[int], filters: List[Any]
     ) -> List[List[tuple]]:

@@ -934,11 +934,41 @@ class GraphRunner:
         for node, _ in self._sources:
             nid = node.id
             offsets = last_offsets.get(nid, {})
+            rehydrate = getattr(
+                getattr(node.config["source"], "subject", None),
+                "rehydrate_state_deltas",
+                None,
+            )
+            # journal-frame markers are slim (no row payload): re-derive
+            # each marker's rows from the input deltas journaled up to its
+            # frame (row keys are content-addressed, the lookup is exact).
+            # Checkpoint/fragment deltas arrive hydrated and pass through.
+            row_values: Dict[bytes, Any] = {}
+            fed_until = 0
+
+            def _feed_rows(up_to: int) -> None:
+                nonlocal fed_until
+                for f_idx in range(fed_until, up_to):
+                    delta = frames[f_idx][1].get(nid)
+                    if delta is None or len(delta) == 0:
+                        continue
+                    for i in range(len(delta)):
+                        if delta.diffs[i] > 0:
+                            row_values[delta.keys[i].tobytes()] = {
+                                n: c[i] for n, c in delta.columns.items()
+                            }
+                fed_until = max(fed_until, up_to)
+
             state_deltas: List[Any] = []
             last_marker_idx = -1
             for idx, (_cid, _deltas, offs) in enumerate(frames):
                 deltas = offs.get(nid, {}).get("state_deltas")
                 if deltas:
+                    if rehydrate is not None and any(
+                        "rows" not in d and not d.get("deleted") for d in deltas
+                    ):
+                        _feed_rows(idx + 1)
+                        deltas = rehydrate(deltas, row_values)
                     state_deltas.extend(deltas)
                     last_marker_idx = idx
             tail: Optional[dict] = None

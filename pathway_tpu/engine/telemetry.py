@@ -84,6 +84,7 @@ STAGE_NAMESPACES: "tuple[str, ...]" = (
     "eval.",        # batch-UDF evaluation
     "exchange.",    # per-peer traffic + barrier waits/stragglers
     "fuse.",        # whole-commit fusion planner/jit
+    "index.",       # tiered IVF index: tier hits, prefetch, rebuild/swap
     "lint.",        # graph/runtime lint diagnostics
     "modelcheck.",  # deterministic schedule exploration
     "persist.",     # checkpoints, journal compaction
@@ -99,6 +100,7 @@ FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
     "brownout",
     "chaos_checkpoint_kill",
     "chaos_kill",
+    "chaos_rebuild_kill",
     "checkpoint",
     "checkpoint_deferred",
     "drained",
@@ -106,6 +108,8 @@ FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
     "fence_broadcast",
     "fence_received",
     "fusion",
+    "index_rebuild",
+    "index_swap",
     "lint",
     "membership",
     "membership_applied",

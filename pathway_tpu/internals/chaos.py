@@ -119,6 +119,9 @@ class Chaos:
         self._scale: List[Dict[str, Any]] = [
             dict(e) for e in (plan.get("scale") or [])
         ]
+        self._index: List[Dict[str, Any]] = [
+            dict(e) for e in (plan.get("index") or [])
+        ]
         self._load: Dict[str, Any] = dict(plan.get("load") or {})
         self._streams: Dict[str, random.Random] = {}
         self._backend_errors_left = int(self._backend.get("max_errors", 3))
@@ -129,6 +132,9 @@ class Chaos:
         # elastic-membership attempt counter, same discipline: `at` in a
         # scale entry names the Nth transition attempt of this incarnation
         self.scale_attempt = -1
+        # tiered-index background-rebuild attempt counter: `at` in an index
+        # entry names the Nth rebuild scheduled by this incarnation
+        self.rebuild_attempt = -1
         # observability for tests: what actually fired
         self.stats: Dict[str, int] = {
             "kills": 0,
@@ -139,6 +145,7 @@ class Chaos:
             "backend_errors": 0,
             "checkpoint_faults": 0,
             "scale_faults": 0,
+            "index_faults": 0,
         }
 
     # -- streams -------------------------------------------------------------
@@ -303,6 +310,69 @@ class Chaos:
                 **details,
             )
             recorder.dump(f"chaos_{op}")
+        except Exception:
+            pass  # the kill must fire regardless
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- tiered-index rebuild/swap faults ---------------------------------------
+
+    def begin_rebuild_attempt(self) -> int:
+        """Called by the tiered IVF store when it schedules a background
+        rebuild; returns the 0-based attempt index ``at`` gates on."""
+        self.rebuild_attempt += 1
+        return self.rebuild_attempt
+
+    def index_fault(self, op: str, rank: int) -> bool:
+        """True when the plan schedules tiered-index fault ``op`` for this
+        rank at the CURRENT rebuild attempt (and restart count). Ops:
+
+        - ``rebuild_kill``   — SIGKILL the rank while a background index
+          rebuild is in flight (the new generation must be discarded on
+          recovery; journal replay rebuilds the index bit-identically);
+        - ``tier_swap_torn`` — abort the generation swap at the commit
+          boundary (the pending generation is dropped, the OLD generation
+          keeps serving, and the next maintenance pass retries).
+
+        ``at`` defaults to every attempt; ``run`` defaults to every
+        incarnation (the cross-restart key, same contract as ``scale``
+        entries)."""
+        current_attempt = max(0, self.rebuild_attempt)
+        for entry in self._index:
+            if entry.get("op") != op:
+                continue
+            if int(entry.get("rank", -1)) != rank:
+                continue
+            want_run = entry.get("run")
+            if want_run is not None and int(want_run) != self.run_count:
+                continue
+            want_at = entry.get("at")
+            if want_at is not None and int(want_at) != current_attempt:
+                continue
+            self.stats["index_faults"] += 1
+            self._record_injection(
+                f"chaos_{op}", rank=rank, attempt=self.rebuild_attempt,
+                run=self.run_count,
+            )
+            return True
+        return False
+
+    def maybe_rebuild_kill(self, rank: int, **details: Any) -> None:
+        """SIGKILL this rank when a ``rebuild_kill`` index entry matches —
+        the kill lands while the background rebuild thread is mid-build, so
+        recovery must come up serving the OLD generation (or a journal-replay
+        rebuild), never a torn new one."""
+        if not self.index_fault("rebuild_kill", rank):
+            return
+        self.stats["kills"] += 1
+        try:
+            from pathway_tpu.engine.profile import get_flight_recorder
+
+            recorder = get_flight_recorder()
+            recorder.record_event(
+                "chaos_rebuild_kill", rank=rank, attempt=self.rebuild_attempt,
+                **details,
+            )
+            recorder.dump("chaos_rebuild_kill")
         except Exception:
             pass  # the kill must fire regardless
         os.kill(os.getpid(), signal.SIGKILL)

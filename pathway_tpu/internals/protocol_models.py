@@ -979,6 +979,165 @@ def membership_model(
 
 
 # ---------------------------------------------------------------------------
+# tiered IVF index: prefetch staging / background rebuild / generation swap
+# ---------------------------------------------------------------------------
+
+
+def tiered_index_model(
+    *,
+    n_clusters: int = 3,
+    n_reads: int = 4,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The tiered-index residency protocol (``ops/knn_tiers.py``), modeled
+    BEFORE the real threads were wired (the PR-9 discipline): reader threads
+    serve queries against the current generation (coarse probe reads the
+    centroids, scoring reads the cluster pages — BOTH under one lock hold,
+    the commit-boundary atomicity the engine thread gets for free); a
+    prefetch worker stages cold clusters hot (taking a staging slot, doing
+    the H2D work off-lock, releasing the slot on every path); a background
+    rebuilder builds the next generation's pages off to the side and SWAPS —
+    centroids and pages re-point together, only after every cluster of the
+    new generation is built, with the old generation's pages intact until
+    the instant the swap commits.
+
+    Invariants over every interleaving: no torn read (a query never mixes
+    generation-g centroids with generation-g' pages, and never reads an
+    incomplete or missing page set); the swap happens exactly once and only
+    after the new generation is complete; staging slots always return to
+    zero; no deadlock.
+
+    Planted bugs (each must be CAUGHT with a replayable schedule):
+    ``"torn_swap"`` — the swap publishes centroids and pages in two lock
+    acquisitions, so a reader between them mixes generations;
+    ``"swap_incomplete"`` — the rebuilder swaps after building only part of
+    the new generation (queries hit missing clusters);
+    ``"drop_old_early"`` — the rebuilder frees the old generation's pages
+    before the swap commits (in-flight queries read freed pages);
+    ``"leak_stage"`` — the prefetcher skips the staging-slot release when a
+    swap invalidated its target mid-stage (the slot-leak class behind a
+    permanently-wedged promotion pipeline)."""
+
+    new_gen = 1
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("index")
+        cv = sched.condition(lock, name="index.cv")
+        state: Dict[str, Any] = {
+            "centroids_gen": 0,
+            "pages_gen": 0,
+            # generation -> set of built cluster ids (complete == n_clusters)
+            "pages": {0: set(range(n_clusters))},
+            "hot": set(),
+            "staging": 0,
+            "swaps": 0,
+            "reads": [],  # (centroids_gen, pages_gen, missing_clusters)
+            "rebuild_done": False,
+            "readers_done": 0,
+        }
+
+        def reader_body(idx: int) -> None:
+            for _ in range(n_reads):
+                with cv:
+                    cg = state["centroids_gen"]
+                    pg = state["pages_gen"]
+                    built = state["pages"].get(pg, set())
+                    missing = n_clusters - len(built)
+                    state["reads"].append((cg, pg, missing))
+                sched.yield_point(f"reader{idx}")
+            with cv:
+                state["readers_done"] += 1
+                cv.notify_all()
+
+        def prefetcher_body() -> None:
+            for cid in range(n_clusters):
+                with cv:
+                    gen_at_start = state["pages_gen"]
+                    if cid not in state["pages"].get(gen_at_start, set()):
+                        continue
+                    state["staging"] += 1
+                sched.yield_point("stage")  # the off-lock H2D / unspill work
+                with cv:
+                    invalidated = state["pages_gen"] != gen_at_start
+                    if invalidated and bug == "leak_stage":
+                        # the planted leak: an invalidated stage abandons its
+                        # slot instead of releasing it on the way out
+                        continue
+                    state["staging"] -= 1
+                    if not invalidated:
+                        state["hot"].add(cid)
+                    cv.notify_all()
+
+        def rebuilder_body() -> None:
+            built: set = set()
+            target = (
+                range(n_clusters - 1)
+                if bug == "swap_incomplete"
+                else range(n_clusters)
+            )
+            for cid in target:
+                sched.yield_point("build")  # off-to-the-side training work
+                with cv:
+                    built.add(cid)
+                    state["pages"].setdefault(new_gen, set()).add(cid)
+            if bug == "drop_old_early":
+                # the planted regression: the old generation is freed BEFORE
+                # the swap commits — in-flight readers lose their pages
+                with cv:
+                    state["pages"][0] = set()
+            sched.yield_point("pre-swap")
+            if bug == "torn_swap":
+                # two lock acquisitions: a reader between them mixes gens
+                with cv:
+                    state["centroids_gen"] = new_gen
+                sched.yield_point("swap-gap")
+                with cv:
+                    state["pages_gen"] = new_gen
+                    state["swaps"] += 1
+                    state["rebuild_done"] = True
+                    cv.notify_all()
+            else:
+                with cv:
+                    state["centroids_gen"] = new_gen
+                    state["pages_gen"] = new_gen
+                    state["swaps"] += 1
+                    state["rebuild_done"] = True
+                    cv.notify_all()
+
+        for idx in range(2):
+            sched.spawn(reader_body, idx, name=f"reader{idx}")
+        sched.spawn(prefetcher_body, name="prefetch")
+        sched.spawn(rebuilder_body, name="rebuild")
+
+        def check() -> None:
+            for cg, pg, missing in state["reads"]:
+                assert cg == pg, (
+                    f"torn generation read: centroids from generation {cg} "
+                    f"scored against generation-{pg} pages"
+                )
+                assert missing == 0, (
+                    f"query read an incomplete generation: {missing} cluster "
+                    f"page set(s) missing from generation {pg}"
+                )
+            assert state["staging"] == 0, (
+                f"staging slots leaked: {state['staging']} still held after "
+                "every stage terminated"
+            )
+            assert state["swaps"] == 1, (
+                f"generation swap committed {state['swaps']} times (expected "
+                "exactly once)"
+            )
+            assert state["pages_gen"] == new_gen and state["centroids_gen"] == new_gen
+            assert len(state["pages"].get(new_gen, set())) == n_clusters, (
+                "swap committed an incomplete generation"
+            )
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
 # closed-loop autoscaler: sample -> decide -> directive -> transition outcome
 # ---------------------------------------------------------------------------
 

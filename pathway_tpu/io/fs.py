@@ -226,6 +226,13 @@ class _FsSubject:
         self.csv_settings = csv_settings
         self.seen: Dict[str, float] = {}
         self.emitted: Dict[str, List[dict]] = {}
+        # (file, mtime) -> rows AS PUSHED for that exact version (shared list
+        # refs with emitted — no copy). Checkpoint hydration must pair a
+        # drained marker with ITS OWN version's rows, never with whatever the
+        # scanner has since re-read: the engine may checkpoint while the
+        # scanner is a version ahead. Last two versions per file are kept
+        # (the drained history can trail the scanner by at most one segment).
+        self._pushed: Dict[tuple, List[dict]] = {}
         # elastic membership: file ownership is hash(path) mod n, so a
         # grow/shrink re-partitions the scan. The engine freezes the scanner
         # at a file boundary, exports/removes moved entries under the lock,
@@ -258,15 +265,78 @@ class _FsSubject:
 
     def restore(self, state_deltas: list) -> None:
         """Fold journaled per-file deltas back into the scan state (called before the
-        scanner thread starts)."""
+        scanner thread starts). Deltas arrive WITH rows: checkpoint/fragment
+        exports carry them directly, journal-frame markers are rehydrated from
+        their frames' input deltas by the runner before reaching here."""
         for delta in state_deltas:
             filepath = delta["file"]
             if delta.get("deleted"):
                 self.seen.pop(filepath, None)
                 self.emitted.pop(filepath, None)
             else:
+                if "rows" not in delta:
+                    raise ValueError(
+                        f"fs scan-state delta for {filepath!r} reached restore "
+                        "without rows: the journal frame that carried it lost "
+                        "its input deltas (corrupt journal) — clear the "
+                        "persistence directory to start fresh"
+                    )
                 self.seen[filepath] = delta["mtime"]
                 self.emitted[filepath] = list(delta["rows"])
+                self._pushed[(filepath, delta["mtime"])] = self.emitted[filepath]
+
+    def hydrate_state_deltas(self, state_deltas: list) -> list:
+        """Attach row payloads for the checkpoint export (journal frames ≤
+        the checkpoint get compacted away, so the blob must be
+        self-contained). Rows come from the VERSION-EXACT push record — a
+        drained marker must pair with its own version's rows even when the
+        scanner has already re-read the file (the engine may checkpoint one
+        segment behind)."""
+        out = []
+        for delta in state_deltas:
+            if delta.get("deleted") or "rows" in delta:
+                out.append(delta)
+                continue
+            rows = self._pushed.get((delta["file"], delta["mtime"]))
+            if rows is None:
+                # fallback: the live rows, valid only when the versions agree
+                # (a miss here means the marker trails by >1 version — the
+                # next drained marker supersedes it at the following fold)
+                rows = self.emitted.get(delta["file"], [])
+            out.append({**delta, "rows": list(rows)})
+        return out
+
+    @staticmethod
+    def rehydrate_state_deltas(state_deltas: list, row_values: dict) -> list:
+        """Re-derive the marker rows of journaled deltas from their frames'
+        input deltas (``row_values``: row-key bytes -> values dict, built by
+        the runner over the frames up to each marker). Row keys are
+        content-addressed ``(file, index)``, so the lookup is exact."""
+        from pathway_tpu.internals.keys import pointers_to_keys
+
+        out = []
+        for delta in state_deltas:
+            if delta.get("deleted") or "rows" in delta:
+                out.append(delta)
+                continue
+            filepath = delta["file"]
+            n = int(delta.get("n_rows", 0))
+            keys = pointers_to_keys(
+                [pointer_from(filepath, i, "fs") for i in range(n)]
+            )
+            rows = []
+            for i in range(n):
+                got = row_values.get(keys[i].tobytes())
+                if got is None:
+                    raise ValueError(
+                        f"fs scan-state marker for {filepath!r} names {n} "
+                        f"row(s) but row {i} is absent from the journal "
+                        "frames (corrupt journal) — clear the persistence "
+                        "directory to start fresh"
+                    )
+                rows.append(got)
+            out.append({**delta, "rows": rows})
+        return out
 
     def _process_file(self, source: StreamingDataSource, filepath: str) -> None:
         st = os.stat(filepath)
@@ -285,7 +355,21 @@ class _FsSubject:
             source.push(row, key=pointer_from(filepath, i, "fs"), diff=1)
         self.seen[filepath] = st.st_mtime
         self.emitted[filepath] = rows
-        source.push_state({"file": filepath, "mtime": st.st_mtime, "rows": rows})
+        stale = [
+            k for k in self._pushed
+            if k[0] == filepath and k[1] != st.st_mtime
+        ][:-1]  # keep the immediately-previous version for in-flight markers
+        for k in stale:
+            self._pushed.pop(k, None)
+        self._pushed[(filepath, st.st_mtime)] = rows
+        # the journaled marker carries NO row payload: the frame it rides in
+        # already holds this file's rows as input deltas, and the restore path
+        # re-derives them (rehydrate_state_deltas) — journaling both doubled
+        # the journal size. Checkpoint exports hydrate rows back in
+        # (hydrate_state_deltas) because compaction drops the frames.
+        source.push_state(
+            {"file": filepath, "mtime": st.st_mtime, "n_rows": len(rows)}
+        )
 
     def _process_deletion(self, source: StreamingDataSource, filepath: str) -> None:
         source.push_begin(filepath, ("deleted",))
@@ -293,6 +377,8 @@ class _FsSubject:
             source.push(row, key=pointer_from(filepath, i, "fs"), diff=-1)
         self.seen.pop(filepath, None)
         self.emitted.pop(filepath, None)
+        for k in [k for k in self._pushed if k[0] == filepath]:
+            self._pushed.pop(k, None)
         source.push_state({"file": filepath, "deleted": True})
 
     # -- elastic membership (reshard protocol; see parallel/membership.py) ---

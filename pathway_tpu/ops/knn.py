@@ -79,10 +79,17 @@ def kernel_cache_sizes() -> Dict[str, int]:
         except Exception:
             return -1
 
+    from pathway_tpu.ops import knn_tiers
+
     return {
         "dense_search": sz(_search_kernel),
         "ivf_query": sz(knn_ivf._ivf_query_fused),
         "ivf_pack": sz(knn_ivf._pack_pages_kernel),
+        # tiered store: assignment batches and hot blocks pad to pow2, so
+        # both caches must stay O(log) over ragged cluster sizes (an unpadded
+        # shape per cluster was an 18x ingest regression)
+        "tiered_assign": sz(knn_ivf._assign2_kernel),
+        "tiered_score": sz(knn_tiers._score_block_kernel),
     }
 
 
@@ -279,6 +286,19 @@ class DenseKNNStore(SlotIngestMixin):
     def _after_flush_removals(self) -> None:
         """Subclass hook: staged invalidations just applied."""
 
+    def export_rows(self) -> Tuple[List[Any], np.ndarray]:
+        """Every live (key, vector) pair as host arrays — the *rebuildable
+        descriptor* contract: an index over this store can be reconstructed
+        on another process from this export alone (membership handoff,
+        background rebuilds). One device gather for the whole corpus."""
+        self._flush()
+        keys = list(self.slot_of.keys())
+        if not keys:
+            return keys, np.zeros((0, self.dim), dtype=np.float32)
+        slots = np.fromiter(self.slot_of.values(), dtype=np.int64)
+        vecs = np.asarray(self._data[jnp.asarray(slots)].astype(jnp.float32))
+        return keys, vecs
+
     def search_batch(self, queries: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Returns (scores (q,k), slots (q,k), valid_mask (q,k)); slots map via key_of."""
         self._flush()
@@ -376,6 +396,33 @@ class BruteForceKnnIndex:
     def remove(self, key: Any) -> None:
         self.store.remove(key)
         self.filter_data.pop(key, None)
+
+    # -- rebuildable-descriptor contract (membership handoff) ----------------
+
+    def rebuild_descriptor(self) -> "Dict[str, Any] | None":
+        """The index content as a host-side descriptor another process can
+        rebuild the SAME index from (keys + vectors + filter data) — the
+        membership preflight's alternative to the blanket device-resident
+        refusal. ``None`` when the backing store cannot export (a typed
+        refusal is kept for those)."""
+        export = getattr(self.store, "export_rows", None)
+        if export is None:
+            return None
+        keys, vecs = export()
+        return {
+            "keys": keys,
+            "vectors": vecs,
+            "filter_data": dict(self.filter_data),
+        }
+
+    def install_rebuild_descriptor(self, desc: Dict[str, Any]) -> None:
+        """Rebuild this (fresh) index from a :meth:`rebuild_descriptor`
+        export: one bulk ingest, filter data restored alongside."""
+        keys = list(desc.get("keys", []))
+        if keys:
+            vectors = np.asarray(desc["vectors"], dtype=np.float32)
+            self.store.add_many(keys, vectors)
+        self.filter_data = dict(desc.get("filter_data", {}))
 
     def search(self, query_vector: Any, limit: int, filter_expr: Any = None) -> List[tuple]:
         return self.search_many([query_vector], [limit], [filter_expr])[0]
@@ -541,12 +588,28 @@ class IvfKnnIndex(BruteForceKnnIndex):
         n_clusters: int = 64,
         n_probe: int = 8,
         mesh: Any = None,
+        tiered: "bool | None" = None,
     ):
+        from pathway_tpu.ops.knn_tiers import tiering_enabled
+
+        if tiered is None:
+            tiered = tiering_enabled()
         if mesh is not None:
             from pathway_tpu.parallel.knn_sharded import ShardedIvfKnnStore
 
             store: Any = ShardedIvfKnnStore(
                 mesh,
+                dim,
+                metric=metric,
+                initial_capacity=initial_capacity,
+                n_clusters=n_clusters,
+                n_probe=n_probe,
+                tiered=tiered,
+            )
+        elif tiered:
+            from pathway_tpu.ops.knn_tiers import TieredIvfKnnStore
+
+            store = TieredIvfKnnStore(
                 dim,
                 metric=metric,
                 initial_capacity=initial_capacity,

@@ -1,0 +1,1712 @@
+"""Tiered IVF residency: device-hot / host-cold / frozen-spill cluster pages.
+
+ROADMAP item 4's missing piece: :class:`~pathway_tpu.ops.knn_ivf.IvfKnnStore`
+keeps the whole packed corpus in one tier, retrains stop-the-world when the
+corpus doubles, and rebuilds the full CSR after every mutation batch — fine up
+to the HBM budget, hopeless past it and under ``join_churn``-rate ingestion.
+This module makes the *page* (the pow2-padded 128-row unit the PR-1 layout was
+built around) the unit of residency, the DrJAX array-redistribution view: page
+sets move between tiers without per-row host round-trips.
+
+Design
+------
+- **Primary storage is per-cluster page blocks**, not one monolithic device
+  array: each cluster owns a pow2-capacity ``(rows, dim)`` host block (append
+  in place, validity mask for removals, per-cluster compaction past 50% dead —
+  churn touches only the clusters it names, never the global layout).
+- **Three tiers.** *Hot*: clusters whose blocks also hold a device mirror,
+  bounded by ``PATHWAY_IVF_HBM_BUDGET_MB`` (0 = unbounded — every cluster is
+  promotable, the pre-tiered behavior). *Cold*: host-RAM blocks. *Frozen
+  spill* (optional): idle, churn-free clusters serialized behind the existing
+  persistence ``ObjectStore`` contract (``attach_spill`` or
+  ``PATHWAY_IVF_SPILL_DIR``) and dropped from RAM.
+- **Probe-frequency EWMA drives residency**: every ``search_batch`` folds the
+  coarse-quantizer's probed cluster set into a per-cluster EWMA
+  (``PATHWAY_IVF_EWMA_ALPHA``); hot promotion follows probes, demotion evicts
+  the coldest hot blocks when the budget is exceeded. A browned-out probe set
+  (``engine/brownout.py`` rung 2 halves ``n_probe``) NEVER triggers promotion
+  churn — degradation must not thrash the tiers it is protecting.
+- **Async prefetch**: the clusters named by the coarse top-``n_probe`` are
+  staged by a background worker *before* the scoring loop needs them, so a
+  cold/frozen hit costs one overlap window (hot clusters score while the
+  stage runs), not a synchronous H2D / object-store stall. Stall time that
+  does surface is measured (``pathway_ivf_prefetch_stall_seconds``).
+- **Incremental centroid maintenance**: per-cluster drift counters (adds +
+  removals vs. the size the cluster was last trained at) trigger per-cluster
+  recenter / re-assign / split / merge only — bounded work per maintenance
+  pass, no global retrain on the churn path.
+- **Fence-riding background rebuild**: when cumulative churn reaches
+  ``PATHWAY_IVF_REBUILD_DRIFT`` × the trained corpus size, a full re-train
+  builds a NEW generation off to the side (background thread over an
+  immutable snapshot; live churn keeps landing in the old generation and in
+  a dirty-set) and the store swaps generations atomically at the next commit
+  boundary — the swap reconciles the dirty-set, takes one bounded pause, and
+  the OLD generation keeps serving until the instant it commits (chaos ops
+  ``rebuild_kill`` / ``tier_swap_torn`` prove the crash windows). The
+  protocol is modeled first (``tiered_index_model`` in
+  ``internals/protocol_models.py``) per the PR-9 discipline.
+
+Scoring is cluster-major exactly like the CPU BLAS path of ``knn_ivf``
+(identical metric epilogue), so **residency never changes results**: the same
+query over the same corpus is bitwise identical whatever tier each cluster
+sits in — the honesty key ``bench.py ivfscale`` carries. On non-CPU backends
+hot blocks score through a jitted pow2-bucketed device GEMM
+(:func:`_score_block_kernel`); fusing the multi-page probe the PR-1 kernel
+runs for the untiered store is named upside in ROADMAP item 4.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.engine import telemetry
+from pathway_tpu.internals.shapes import next_pow2
+from pathway_tpu.ops.knn import topk_rows
+from pathway_tpu.ops.knn_ivf import _KMEANS_CHUNK, _assign2_kernel, _kmeans_kernel
+
+PAGE = 128  # residency granularity mirrors the packed-page layout of knn_ivf
+
+# sentinel centroid for merged-away clusters: far enough that the coarse
+# affinity is hugely negative, small enough that |c|^2 stays finite in f32
+_DEAD_CENTROID = 1e18
+
+
+class TieredIndexError(RuntimeError):
+    """Typed failure of the tiered index machinery (spill tier unreachable,
+    rebuild worker died) — callers triage by type, never by repr."""
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def tiering_enabled() -> bool:
+    """``PATHWAY_IVF_TIERED``: ``on`` / ``off`` / ``auto`` (default — tiered
+    exactly when an HBM budget is configured, so existing deployments keep
+    the untiered store bit-for-bit)."""
+    mode = _env("PATHWAY_IVF_TIERED", "auto").lower()
+    if mode in ("on", "1", "true", "yes"):
+        return True
+    if mode in ("off", "0", "false", "no"):
+        return False
+    return hbm_budget_bytes() > 0
+
+
+def hbm_budget_bytes() -> int:
+    """``PATHWAY_IVF_HBM_BUDGET_MB`` as bytes; 0 = unbounded hot tier."""
+    try:
+        return int(float(_env("PATHWAY_IVF_HBM_BUDGET_MB", "0")) * (1 << 20))
+    except ValueError:
+        return 0
+
+
+def _prefetch_enabled() -> bool:
+    return _env("PATHWAY_IVF_PREFETCH", "on").lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+def _ewma_alpha() -> float:
+    try:
+        return min(1.0, max(0.01, float(_env("PATHWAY_IVF_EWMA_ALPHA", "0.2"))))
+    except ValueError:
+        return 0.2
+
+
+def _cluster_drift_threshold() -> float:
+    try:
+        return max(0.05, float(_env("PATHWAY_IVF_CLUSTER_DRIFT", "0.5")))
+    except ValueError:
+        return 0.5
+
+
+def _rebuild_drift_threshold() -> float:
+    try:
+        return max(0.1, float(_env("PATHWAY_IVF_REBUILD_DRIFT", "1.0")))
+    except ValueError:
+        return 1.0
+
+
+def _spill_ewma_threshold() -> float:
+    try:
+        return float(_env("PATHWAY_IVF_SPILL_EWMA", "0.01"))
+    except ValueError:
+        return 0.01
+
+
+# ---------------------------------------------------------------------------
+# frozen-spill tier: a minimal filesystem ObjectStore (the persistence
+# contract: put/get/list/delete) for the PATHWAY_IVF_SPILL_DIR knob; any
+# real ObjectStore (S3/Azure/memory) attaches through attach_spill().
+# ---------------------------------------------------------------------------
+
+
+class DirSpillStore:
+    """Directory-backed ``ObjectStore`` for the frozen tier. Writes are
+    atomic (tmp + rename): a torn spill can never serve a half-written
+    cluster block."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "__"))
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> "bytes | None":
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def list(self, prefix: str) -> List[str]:
+        pref = prefix.replace("/", "__")
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return []
+        return [n.replace("__", "/") for n in names if n.startswith(pref)]
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# cluster page blocks
+# ---------------------------------------------------------------------------
+
+
+class _ClusterPages:
+    """One cluster's rows as an appendable pow2-capacity host block.
+
+    ``vecs[:n]`` rows are write-once (an append lands past ``n``; a re-add is
+    remove + append), so a background-rebuild snapshot that records
+    ``(vecs, n, valid.copy())`` reads a consistent corpus without copying the
+    vectors. ``valid`` flips in place on removal — the one mutable field, and
+    the one the snapshot copies."""
+
+    __slots__ = ("slots", "vecs", "norms", "valid", "n", "n_live", "mutations")
+
+    def __init__(self, dim: int, cap: int = PAGE):
+        cap = next_pow2(max(PAGE, cap))
+        self.slots = np.full(cap, -1, dtype=np.int64)
+        self.vecs = np.zeros((cap, dim), dtype=np.float32)
+        self.norms = np.zeros(cap, dtype=np.float32)
+        self.valid = np.zeros(cap, dtype=bool)
+        self.n = 0
+        self.n_live = 0
+        # bumped on every append/invalidate: a device mirror built off-lock is
+        # only installable when the count it captured still matches (object
+        # identity alone misses IN-PLACE churn during the stage)
+        self.mutations = 0
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.vecs.nbytes + self.norms.nbytes + self.slots.nbytes)
+
+    def append(self, slots: np.ndarray, vecs: np.ndarray, norms: np.ndarray) -> int:
+        """Append rows; returns the first position. Grows pow2 (the old
+        arrays stay valid for any rebuild snapshot holding them)."""
+        need = self.n + len(slots)
+        if need > len(self.slots):
+            cap = next_pow2(need)
+            dim = self.vecs.shape[1]
+            new_slots = np.full(cap, -1, dtype=np.int64)
+            new_vecs = np.zeros((cap, dim), dtype=np.float32)
+            new_norms = np.zeros(cap, dtype=np.float32)
+            new_valid = np.zeros(cap, dtype=bool)
+            new_slots[: self.n] = self.slots[: self.n]
+            new_vecs[: self.n] = self.vecs[: self.n]
+            new_norms[: self.n] = self.norms[: self.n]
+            new_valid[: self.n] = self.valid[: self.n]
+            self.slots, self.vecs = new_slots, new_vecs
+            self.norms, self.valid = new_norms, new_valid
+        first = self.n
+        self.slots[first:need] = slots
+        self.vecs[first:need] = vecs
+        self.norms[first:need] = norms
+        self.valid[first:need] = True
+        self.n = need
+        self.n_live += len(slots)
+        self.mutations += 1
+        return first
+
+    def invalidate(self, pos: int) -> None:
+        if self.valid[pos]:
+            self.valid[pos] = False
+            self.n_live -= 1
+            self.mutations += 1
+
+    def live_rows(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        mask = self.valid[: self.n]
+        return self.slots[: self.n][mask], self.vecs[: self.n][mask], self.norms[: self.n][mask]
+
+    def to_blob(self) -> bytes:
+        slots, vecs, norms = self.live_rows()
+        return pickle.dumps(
+            {"slots": slots, "vecs": vecs, "norms": norms},
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+
+    @classmethod
+    def from_blob(cls, dim: int, blob: bytes) -> "_ClusterPages":
+        raw = pickle.loads(blob)
+        n = len(raw["slots"])
+        block = cls(dim, cap=max(PAGE, n))
+        if n:
+            block.append(raw["slots"], raw["vecs"], raw["norms"])
+        return block
+
+
+# ---------------------------------------------------------------------------
+# tier manager: residency shared between the engine thread and the prefetcher
+# ---------------------------------------------------------------------------
+
+
+class TierManager:
+    """Residency state for ONE index generation: which clusters are hot
+    (device mirror within the HBM budget), which are host-cold, which are
+    frozen in the spill store. Shared by the engine thread (scoring,
+    promotion decisions) and the prefetch worker (staging) — every field
+    below is guarded by ``_cv``'s lock."""
+
+    def __init__(
+        self,
+        dim: int,
+        generation: int,
+        *,
+        budget_bytes: int = 0,
+        device: Any = None,
+        spill_store: Any = None,
+        spill_prefix: str = "ivf-spill",
+    ):
+        self.dim = dim
+        self.generation = generation
+        self.budget_bytes = budget_bytes
+        self.device = device
+        self._cv = threading.Condition()
+        self.pages: Dict[int, Optional[_ClusterPages]] = {}
+        self.hot: Dict[int, Any] = {}  # cid -> device mirror (True on CPU)
+        # bytes COUNTED IN per hot cid: demotion must subtract exactly what
+        # promotion added, not the block's current (possibly grown) size
+        self._hot_nbytes: Dict[int, int] = {}
+        self.hot_bytes = 0
+        self.spilled: Dict[int, str] = {}  # cid -> object key
+        self.staging: set = set()
+        self.spill_store = spill_store
+        self.spill_prefix = spill_prefix
+
+    # -- residency reads ------------------------------------------------------
+
+    def residency(self, cid: int) -> str:
+        with self._cv:
+            if cid in self.hot:
+                return "hot"
+            if self.pages.get(cid) is not None:
+                return "cold"
+            if cid in self.spilled:
+                return "spilled"
+            return "absent"
+
+    def counts(self) -> Dict[str, int]:
+        with self._cv:
+            hot = len(self.hot)
+            spilled = sum(
+                1 for c, p in self.pages.items() if p is None and c in self.spilled
+            )
+            cold = sum(1 for c, p in self.pages.items() if p is not None) - hot
+            return {"hot": hot, "cold": max(0, cold), "spilled": spilled}
+
+    def occupancy(self) -> float:
+        with self._cv:
+            if self.budget_bytes <= 0:
+                return 1.0 if self.hot else 0.0
+            return self.hot_bytes / self.budget_bytes
+
+    # -- engine-side installs -------------------------------------------------
+
+    def install(self, cid: int, block: _ClusterPages) -> None:
+        """(Re)install a cluster's host block (fresh build or post-churn
+        rebuild): any device mirror drops and the spill entry clears. The
+        BLOB stays in the store — a background rebuild's snapshot may still
+        be reading it; a re-freeze overwrites the same key and the
+        generation-swap prefix sweep collects the rest."""
+        with self._cv:
+            self.pages[cid] = block
+            self._demote_locked(cid)
+            self.spilled.pop(cid, None)
+            self._cv.notify_all()
+
+    def drop(self, cid: int) -> None:
+        with self._cv:
+            self.pages.pop(cid, None)
+            self._demote_locked(cid)
+            self.spilled.pop(cid, None)
+
+    # -- hot tier -------------------------------------------------------------
+
+    def _device_mirror(self, block: _ClusterPages) -> Any:
+        if jax.default_backend() == "cpu":
+            return True  # zero-copy host==device; residency is bookkeeping
+        vecs = jnp.asarray(block.vecs)
+        norms = jnp.asarray(block.norms)
+        mask = jnp.where(jnp.asarray(block.valid), 0.0, -jnp.inf).astype(jnp.float32)
+        if self.device is not None:
+            vecs = jax.device_put(vecs, self.device)
+            norms = jax.device_put(norms, self.device)
+            mask = jax.device_put(mask, self.device)
+        return (vecs, norms, mask)
+
+    def promote(self, cid: int) -> bool:
+        """Stage ``cid`` hot (called by the prefetcher, or inline). Returns
+        False when the block is absent (still frozen) or already hot."""
+        with self._cv:
+            block = self.pages.get(cid)
+            if block is None or cid in self.hot:
+                return False
+            nbytes = block.nbytes
+            mutations = block.mutations
+            if 0 < self.budget_bytes < nbytes:
+                # a block bigger than the WHOLE budget can never fit: promoting
+                # it would evict the entire hot set and still overflow — it
+                # serves from the cold tier (hot_bytes <= budget stays a real
+                # invariant because of this refusal)
+                return False
+            self.staging.add(cid)
+        try:
+            mirror = self._device_mirror(block)
+        finally:
+            # the staging slot is released on EVERY path — a failed device
+            # put must not wedge the cluster out of both tiers
+            # (tiered_index_model's leak_stage planted bug)
+            with self._cv:
+                self.staging.discard(cid)
+        evicted: List[Any] = []
+        with self._cv:
+            if (
+                self.pages.get(cid) is not block
+                or block.mutations != mutations
+            ):
+                # churn invalidated the block mid-stage — either replaced
+                # outright or mutated IN PLACE (append/invalidate): a mirror
+                # built from the pre-churn view must never install
+                return False
+            self.hot[cid] = mirror
+            self._hot_nbytes[cid] = nbytes
+            self.hot_bytes += nbytes
+            if self.budget_bytes > 0:
+                evicted = self._evict_over_budget_locked(keep=cid)
+            self._cv.notify_all()
+        if evicted:
+            telemetry.stage_add("index.demotions", float(len(evicted)))
+        return True
+
+    def _demote_locked(self, cid: int) -> None:
+        if cid in self.hot:
+            del self.hot[cid]  # noqa: PWA103 (caller holds self._cv)
+            self.hot_bytes -= self._hot_nbytes.pop(cid, 0)  # noqa: PWA103 (caller holds self._cv)
+            self.hot_bytes = max(0, self.hot_bytes)  # noqa: PWA103 (caller holds self._cv)
+
+    def _evict_over_budget_locked(self, keep: int) -> List[int]:
+        """Evict hot mirrors (never ``keep``) until within budget; caller
+        holds the lock. Eviction order is insertion order — the EWMA-driven
+        promotion stream re-promotes anything still actually probed."""
+        evicted: List[int] = []
+        while self.hot_bytes > self.budget_bytes and len(self.hot) > 1:
+            victim = next((c for c in self.hot if c != keep), None)
+            if victim is None:
+                break
+            self._demote_locked(victim)
+            evicted.append(victim)
+        return evicted
+
+    # -- frozen spill tier ----------------------------------------------------
+
+    def spill(self, cid: int) -> bool:
+        """Freeze a cold, churn-free cluster into the object store and drop
+        its host block. Engine thread only."""
+        if self.spill_store is None:
+            return False
+        with self._cv:
+            block = self.pages.get(cid)
+            if block is None or cid in self.hot or cid in self.staging:
+                return False
+            if block.n != block.n_live:
+                # non-compact blocks must NOT freeze: the blob stores live
+                # rows compacted, so positions would shift across the
+                # round-trip and desynchronize the store's slot locators
+                # (the caller compacts first)
+                return False
+        key = f"{self.spill_prefix}/gen{self.generation}/cluster{cid}"
+        self.spill_store.put(key, block.to_blob())
+        with self._cv:
+            if self.pages.get(cid) is not block:
+                return False  # churned while serializing: blob is stale
+            self.pages[cid] = None
+            self.spilled[cid] = key
+        return True
+
+    def unspill(self, cid: int) -> Optional[_ClusterPages]:
+        """Load a frozen cluster back to the cold tier (prefetcher or the
+        synchronous stall path). Returns the block, or None when the cluster
+        is not frozen (already loaded by a racing stage)."""
+        with self._cv:
+            block = self.pages.get(cid)
+            if block is not None:
+                return block
+            key = self.spilled.get(cid)
+            if key is None or cid in self.staging:
+                return None
+            self.staging.add(cid)
+        blob = None
+        try:
+            if self.spill_store is not None:
+                blob = self.spill_store.get(key)
+        finally:
+            with self._cv:
+                self.staging.discard(cid)
+        if blob is None:
+            raise TieredIndexError(
+                f"spill tier lost cluster {cid} (key {key!r}): the frozen "
+                "object store no longer serves it"
+            )
+        loaded = _ClusterPages.from_blob(self.dim, blob)
+        with self._cv:
+            if self.pages.get(cid) is None and self.spilled.get(cid) == key:
+                self.pages[cid] = loaded
+                # the entry clears (the cluster is cold again) but the BLOB
+                # stays — a rebuild snapshot may still name it; re-freezing
+                # overwrites the same generation-scoped key, and the swap's
+                # prefix sweep deletes the whole retired generation, so
+                # growth is bounded at one blob per cluster per generation
+                self.spilled.pop(cid, None)
+                self._cv.notify_all()
+                return loaded
+            return self.pages.get(cid)
+
+    def wait_loaded(self, cid: int, timeout: float) -> Optional[_ClusterPages]:
+        """Block (bounded) until a staged cluster's block lands — the stall
+        path when the prefetch window did not fully hide the load."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                block = self.pages.get(cid)
+                if block is not None:
+                    return block
+                if cid not in self.staging and cid not in self.spilled:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(timeout=min(0.25, remaining))
+
+
+# ---------------------------------------------------------------------------
+# async prefetcher
+# ---------------------------------------------------------------------------
+
+
+class Prefetcher:
+    """One background worker staging cluster pages ahead of the scorer:
+    unspills frozen clusters and promotes probed ones hot. Lazy-spawned,
+    daemon, joined on :meth:`close`; the request queue is bounded so a probe
+    storm degrades to synchronous loads instead of unbounded memory."""
+
+    _IDLE_POLL_S = 0.25
+
+    def __init__(self) -> None:
+        self._queue: "queue.Queue[tuple]" = queue.Queue(maxsize=4096)
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+
+    def request(self, manager: TierManager, cids: List[int], *, promote: bool) -> None:
+        self._ensure_thread()
+        for cid in cids:
+            try:
+                self._queue.put_nowait((manager, cid, promote))
+            except queue.Full:
+                break  # scorer falls back to its synchronous path
+        telemetry.stage_add("index.prefetch_requests", float(len(cids)))
+
+    def _ensure_thread(self) -> None:
+        with self._mu:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, name="pathway:ivf-prefetch", daemon=True
+                )
+                self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                manager, cid, promote = self._queue.get(timeout=self._IDLE_POLL_S)
+            except queue.Empty:
+                continue
+            try:
+                if manager.residency(cid) == "spilled":
+                    manager.unspill(cid)
+                    telemetry.stage_add("index.unspills")
+                if promote and manager.promote(cid):
+                    telemetry.stage_add("index.promotions")
+                telemetry.stage_add("index.prefetch_staged")
+            except TieredIndexError:
+                # the scorer's synchronous path will surface the typed
+                # failure to the caller with full context
+                telemetry.stage_add("index.prefetch_errors")
+
+    def close(self) -> None:
+        with self._mu:
+            thread = self._thread
+            self._thread = None
+        if thread is not None and thread.is_alive():
+            self._stop.set()
+            thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# device scoring kernel (hot tier, non-CPU backends)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def _score_block_kernel(
+    vecs: jax.Array, norms: jax.Array, mask: jax.Array, queries: jax.Array, metric: str
+) -> jax.Array:
+    """Score one hot cluster block on device: (q, rows) exact scores with the
+    SAME metric epilogue as the host path (bitwise parity is the tier-honesty
+    contract). Block capacities are pow2 so the jit cache stays O(log)."""
+    dot = jnp.dot(queries, vecs.T, preferred_element_type=jnp.float32)
+    qn = jnp.sum(queries * queries, axis=1, keepdims=True)
+    if metric == "l2sq":
+        scores = 2.0 * dot - norms[None, :] - qn
+    elif metric == "cos":
+        scores = dot / jnp.maximum(
+            jnp.sqrt(qn) * jnp.sqrt(norms)[None, :], 1e-30
+        )
+    else:  # ip
+        scores = dot
+    return scores + mask[None, :]
+
+
+# ---------------------------------------------------------------------------
+# background rebuild
+# ---------------------------------------------------------------------------
+
+
+class _RebuildResult:
+    __slots__ = ("generation", "centroids", "pages", "where", "trained_sizes", "error")
+
+    def __init__(self, generation: int):
+        self.generation = generation
+        self.centroids: Optional[np.ndarray] = None
+        self.pages: Dict[int, _ClusterPages] = {}
+        self.where: Dict[int, tuple] = {}
+        self.trained_sizes: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+def _two_means(vecs: np.ndarray, iters: int = 6) -> np.ndarray:
+    """Host 2-means over one cluster's members; returns a bool mask of the
+    second group (the split path — same algorithm the untiered store uses)."""
+    c0, c1 = vecs[0], vecs[len(vecs) // 2]
+    g1 = np.zeros(len(vecs), dtype=bool)
+    for _ in range(iters):
+        d0 = np.sum((vecs - c0) ** 2, axis=1)
+        d1 = np.sum((vecs - c1) ** 2, axis=1)
+        g1 = d1 < d0
+        if g1.all() or (~g1).all():
+            break
+        c0 = vecs[~g1].mean(axis=0)
+        c1 = vecs[g1].mean(axis=0)
+    return g1
+
+
+_TRAIN_SAMPLE_PER_CLUSTER = 32
+
+
+def _train_centroids(
+    sample: np.ndarray, n_clusters: int, train_iters: int, seed: int = 0
+) -> np.ndarray:
+    """k-means over a bounded sample (faiss-style) through the shared device
+    kernel; returns host (C, dim) f32 centroids."""
+    rng = np.random.default_rng(seed)
+    seeds = rng.choice(len(sample), size=n_clusters, replace=len(sample) < n_clusters)
+    init = jnp.asarray(sample[seeds], dtype=jnp.float32)
+    pad = (-len(sample)) % _KMEANS_CHUNK
+    vecs = sample
+    if pad:
+        vecs = np.concatenate([sample, np.zeros((pad, sample.shape[1]), np.float32)])
+    valid = np.arange(len(vecs)) < len(sample)
+    cents = _kmeans_kernel(
+        jnp.asarray(vecs), jnp.asarray(valid), init, train_iters
+    )
+    # writable host copy: per-cluster maintenance recenters rows in place
+    return np.array(cents, dtype=np.float32)
+
+
+def _assign_rows_np(rows: np.ndarray, centroids: np.ndarray) -> np.ndarray:
+    """Top-2 centroid assignment, chunked through the shared device kernel.
+    Row counts pad to pow2 buckets (floor 256): maintenance assigns ragged
+    per-cluster member sets every pass, and an unpadded shape per size would
+    compile a fresh XLA program per cluster — the compile storm IS the pause
+    this store exists to avoid."""
+    if not len(rows):
+        return np.zeros((0, 2), dtype=np.int32)
+    cents = jnp.asarray(centroids)
+    chunk = max(1024, (1 << 28) // max(len(centroids), rows.shape[1], 1))
+    parts = []
+    for start in range(0, len(rows), chunk):
+        block = rows[start : start + chunk]
+        n = len(block)
+        bucket = next_pow2(max(256, n))
+        if bucket != n:
+            block = np.concatenate(
+                [block, np.zeros((bucket - n, block.shape[1]), block.dtype)]
+            )
+        got = np.asarray(_assign2_kernel(jnp.asarray(block), cents))
+        parts.append(got[:n])
+    return np.concatenate(parts)
+
+
+# ---------------------------------------------------------------------------
+# the tiered store
+# ---------------------------------------------------------------------------
+
+
+class TieredIvfKnnStore:
+    """Keyed IVF-Flat store with tiered page residency and churn-native
+    maintenance. API-compatible with :class:`~pathway_tpu.ops.knn_ivf.
+    IvfKnnStore` where the engine touches it (``add``/``add_many``/
+    ``remove``/``search_batch``/``key_of``/``slot_of``/``export_rows``)."""
+
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "l2sq",
+        initial_capacity: int = 1024,  # accepted for API parity; blocks size themselves
+        n_clusters: int = 64,
+        n_probe: int = 8,
+        train_iters: int = 8,
+        device: Any = None,
+        hbm_budget_bytes: "int | None" = None,
+        spill_store: Any = None,
+        prefetch: "bool | None" = None,
+    ):
+        assert metric in ("l2sq", "cos", "ip")
+        self.dim = dim
+        self.metric = metric
+        self.device = device
+        self.n_clusters = max(2, n_clusters)
+        self.n_probe = min(n_probe, self.n_clusters)
+        self._n_clusters_base = self.n_clusters
+        self.train_iters = train_iters
+        self.slot_of: Dict[Any, int] = {}
+        self.key_of: Dict[int, Any] = {}
+        self._next_slot = 0
+        # staged adds keyed by slot (insertion-ordered): a removal of a
+        # just-staged row is an O(1) pop, not an O(n) list scan — interleaved
+        # add/remove churn waves would otherwise go quadratic
+        self._staged: Dict[int, np.ndarray] = {}
+        self._staged_removals: List[int] = []
+        # pre-train holding pen: rows wait here until the first training pass
+        self._untrained_slots: List[int] = []
+        self._untrained_vecs: List[np.ndarray] = []
+        # current generation
+        self.generation = 0
+        self._cents: Optional[np.ndarray] = None  # (C, dim) f32, host
+        self._where: Dict[int, tuple] = {}  # slot -> (cid, pos)
+        self._trained_sizes = np.zeros(0, dtype=np.int64)
+        self._drift = np.zeros(0, dtype=np.int64)
+        self._ewma = np.zeros(0, dtype=np.float64)
+        self._churn_since_train = 0
+        self._trained_total = 0
+        self._batches = 0  # search batches served (spill settling guard)
+        if hbm_budget_bytes is None:
+            hbm_budget_bytes = hbm_budget_bytes_env()
+        self._budget_bytes = int(hbm_budget_bytes)
+        if spill_store is None:
+            spill_dir = os.environ.get("PATHWAY_IVF_SPILL_DIR")
+            if spill_dir:
+                spill_store = DirSpillStore(spill_dir)
+        self.tiers = TierManager(
+            dim, 0, budget_bytes=self._budget_bytes, device=device,
+            spill_store=spill_store,
+        )
+        self._prefetch_on = _prefetch_enabled() if prefetch is None else bool(prefetch)
+        self._prefetcher = Prefetcher()
+        # hot-block device scoring (non-CPU backends) rides a first-use
+        # bitwise parity probe against the host path — any deviation (e.g.
+        # accumulation-order differences) permanently downgrades scoring to
+        # host BLAS, so residency can never change results (the PR-8 fusion
+        # discipline)
+        self._device_checked = False
+        self._device_ok = True
+        # background rebuild state (shared with the rebuild worker)
+        self._mu = threading.Lock()
+        self._pending: Optional[_RebuildResult] = None
+        self._rebuild_thread: Optional[threading.Thread] = None
+        self._rebuild_dirty: Optional[set] = None  # slots churned post-snapshot
+        # observability (engine thread; tests and the bench read it)
+        self.stats: Dict[str, float] = {
+            "rebuilds": 0, "swaps": 0, "swaps_torn": 0, "splits": 0,
+            "merges": 0, "compactions": 0, "spills": 0, "max_pause_s": 0.0,
+            "prefetch_stall_s": 0.0, "probe_hot": 0, "probe_cold": 0,
+            "probe_spilled": 0,
+        }
+
+    # -- ingest ---------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.slot_of)
+
+    def add(self, key: Any, vector: Any) -> None:
+        vector = np.asarray(vector, dtype=np.float32).reshape(-1)
+        assert vector.shape[0] == self.dim, (
+            f"dim mismatch: {vector.shape[0]} != {self.dim}"
+        )
+        if key in self.slot_of:
+            self.remove(key)
+        slot = self._next_slot
+        self._next_slot += 1
+        self.slot_of[key] = slot
+        self.key_of[slot] = key
+        self._staged[slot] = vector
+
+    def add_many(self, keys: List[Any], vectors: Any) -> None:
+        vectors = np.asarray(vectors, dtype=np.float32).reshape(len(keys), self.dim)
+        last = {k: i for i, k in enumerate(keys)}  # intra-batch dedup: last wins
+        if len(last) != len(keys):
+            keep = sorted(last.values())
+            keys = [keys[i] for i in keep]
+            vectors = vectors[keep]
+        for k in [k for k in keys if k in self.slot_of]:
+            self.remove(k)
+        first = self._next_slot
+        slots = list(range(first, first + len(keys)))
+        self._next_slot += len(keys)
+        self.slot_of.update(zip(keys, slots))
+        self.key_of.update(zip(slots, keys))
+        self._staged.update(zip(slots, vectors))
+
+    def remove(self, key: Any) -> None:
+        slot = self.slot_of.pop(key, None)
+        if slot is None:
+            return
+        self.key_of.pop(slot, None)
+        if self._staged.pop(slot, None) is not None:
+            return
+        self._staged_removals.append(slot)
+
+    # -- churn application (the flush path: NO global rebuild) ----------------
+
+    def _flush(self) -> None:
+        if self._staged:
+            slots = np.fromiter(self._staged.keys(), dtype=np.int64)
+            vecs = np.stack(list(self._staged.values())).astype(np.float32)
+            self._staged = {}
+            if self._cents is None:
+                self._untrained_slots.extend(slots.tolist())
+                self._untrained_vecs.extend(vecs)
+            else:
+                self._place_rows(slots, vecs)
+        if self._staged_removals:
+            removals = self._staged_removals
+            self._staged_removals = []
+            for slot in removals:
+                self._remove_slot(slot)
+
+    def _place_rows(self, slots: np.ndarray, vecs: np.ndarray) -> None:
+        """Assign a churn batch to its clusters and append per-cluster — the
+        incremental path: only the touched clusters' blocks re-stage."""
+        top2 = _assign_rows_np(vecs, self._cents)
+        norms = np.sum(vecs * vecs, axis=1)
+        order = np.argsort(top2[:, 0], kind="stable")
+        cids = top2[order, 0]
+        uniq, first_idx = np.unique(cids, return_index=True)
+        bounds = np.append(first_idx, len(cids))
+        dirty = self._rebuild_dirty
+        for g, cid in enumerate(uniq):
+            sel = order[bounds[g] : bounds[g + 1]]
+            cid = int(cid)
+            block = self._block(cid, create=True)
+            first = block.append(slots[sel], vecs[sel], norms[sel])
+            for j, row in enumerate(sel):
+                self._where[int(slots[row])] = (cid, first + j)
+            self.tiers.install(cid, block)
+            if cid < len(self._drift):
+                self._drift[cid] += len(sel)
+        self._churn_since_train += len(slots)
+        if dirty is not None:
+            dirty.update(int(s) for s in slots)
+
+    def _remove_slot(self, slot: int) -> None:
+        loc = self._where.pop(slot, None)
+        if loc is None:
+            # still in the pre-train pen
+            if slot in self._untrained_slots:
+                i = self._untrained_slots.index(slot)
+                del self._untrained_slots[i]
+                del self._untrained_vecs[i]
+            return
+        cid, pos = loc
+        block = self._block(cid, create=False)
+        if block is not None:
+            block.invalidate(pos)
+            self.tiers.install(cid, block)  # stale mirrors/blobs drop
+        if cid < len(self._drift):
+            self._drift[cid] += 1
+        self._churn_since_train += 1
+        if self._rebuild_dirty is not None:
+            self._rebuild_dirty.add(slot)
+
+    def _block(self, cid: int, *, create: bool) -> Optional[_ClusterPages]:
+        """The cluster's host block, unspilling synchronously when frozen
+        (churn unfreezes — the spill tier only holds idle clusters)."""
+        with self.tiers._cv:
+            block = self.tiers.pages.get(cid)
+            frozen = block is None and cid in self.tiers.spilled
+        if block is None and frozen:
+            block = self.tiers.unspill(cid)
+            if block is None:
+                # the prefetcher is mid-stage on this cluster: WAIT for its
+                # block rather than installing an empty one over it (which
+                # would orphan every row the stage is about to land)
+                block = self.tiers.wait_loaded(cid, timeout=30.0)
+        if block is None and create:
+            with self.tiers._cv:
+                block = self.tiers.pages.get(cid)
+                if block is None:
+                    block = _ClusterPages(self.dim)
+                    self.tiers.pages[cid] = block
+                    self.tiers._cv.notify_all()
+        return block
+
+    # -- training / maintenance ----------------------------------------------
+
+    def _initial_train(self) -> None:
+        if not self._untrained_slots:
+            return
+        slots = np.asarray(self._untrained_slots, dtype=np.int64)
+        vecs = np.stack(self._untrained_vecs).astype(np.float32)
+        self._untrained_slots, self._untrained_vecs = [], []
+        self.n_clusters = self._n_clusters_base
+        rng = np.random.default_rng(0)
+        cap = self.n_clusters * _TRAIN_SAMPLE_PER_CLUSTER
+        sample = vecs if len(vecs) <= cap else vecs[rng.choice(len(vecs), cap, replace=False)]
+        self._cents = _train_centroids(sample, self.n_clusters, self.train_iters)
+        self._grow_cluster_arrays(self.n_clusters)
+        self._place_rows(slots, vecs)
+        # splits bound the bucket width the probes pay for
+        self._split_oversized_clusters()
+        self._trained_total = len(slots)
+        self._trained_sizes = np.array(
+            [self._live_count(c) for c in range(self.n_clusters)], dtype=np.int64
+        )
+        self._drift = np.zeros(self.n_clusters, dtype=np.int64)
+        self._churn_since_train = 0
+
+    def _grow_cluster_arrays(self, n: int) -> None:
+        if len(self._drift) < n:
+            extra = n - len(self._drift)
+            self._drift = np.concatenate([self._drift, np.zeros(extra, np.int64)])
+            self._trained_sizes = np.concatenate(
+                [self._trained_sizes, np.zeros(extra, np.int64)]
+            )
+            self._ewma = np.concatenate([self._ewma, np.zeros(extra, np.float64)])
+
+    def _live_count(self, cid: int) -> int:
+        with self.tiers._cv:
+            block = self.tiers.pages.get(cid)
+        return block.n_live if block is not None else 0
+
+    @staticmethod
+    def _cap_for(n_live: int, n_clusters: int) -> int:
+        mean = max(1, n_live // max(n_clusters, 1))
+        cap = 8
+        while cap < (3 * mean + 1) // 2:
+            cap *= 2
+        return cap
+
+    def _split_oversized_clusters(self) -> None:
+        cap = self._cap_for(len(self.slot_of), self.n_clusters)
+        limit = 2 * self._n_clusters_base
+        for cid in range(self.n_clusters):
+            if self.n_clusters >= limit:
+                break
+            block = self._block(cid, create=False)
+            if block is None or block.n_live <= cap:
+                continue
+            self._split_cluster(cid)
+
+    def _split_cluster(self, cid: int) -> None:
+        """2-means split: half the members move to a NEW cluster — bounded
+        per-cluster work, the locators of exactly the moved rows rewrite."""
+        block = self._block(cid, create=False)
+        if block is None or block.n_live < 2 * PAGE // 8:
+            return
+        slots, vecs, norms = block.live_rows()
+        g1 = _two_means(vecs)
+        if not g1.any() or g1.all():
+            return
+        new_cid = self.n_clusters
+        self.n_clusters += 1
+        self._grow_cluster_arrays(self.n_clusters)
+        keep_block = _ClusterPages(self.dim, cap=int((~g1).sum()))
+        keep_block.append(slots[~g1], vecs[~g1], norms[~g1])
+        new_block = _ClusterPages(self.dim, cap=int(g1.sum()))
+        new_block.append(slots[g1], vecs[g1], norms[g1])
+        for j, s in enumerate(slots[~g1]):
+            self._where[int(s)] = (cid, j)
+        for j, s in enumerate(slots[g1]):
+            self._where[int(s)] = (new_cid, j)
+        cents = np.asarray(self._cents)
+        new_cents = np.concatenate([cents, vecs[g1].mean(axis=0)[None, :]])
+        new_cents[cid] = vecs[~g1].mean(axis=0)
+        self._cents = new_cents
+        self.tiers.install(cid, keep_block)
+        self.tiers.install(new_cid, new_block)
+        self._trained_sizes[cid] = keep_block.n_live
+        self._trained_sizes[new_cid] = new_block.n_live
+        self._drift[cid] = 0
+        self._drift[new_cid] = 0
+        self.stats["splits"] += 1
+        telemetry.stage_add("index.splits")
+
+    def _maintain_cluster(self, cid: int) -> None:
+        """Per-cluster drift response: compact, recenter, re-assign strays,
+        split or merge — never a global pass."""
+        block = self._block(cid, create=False)
+        if block is None:
+            return
+        if block.n_live < block.n // 2 and block.n >= PAGE:
+            self._compact_cluster(cid, block)
+            block = self._block(cid, create=False)
+            if block is None:
+                return
+        slots, vecs, norms = block.live_rows()
+        n_live = len(slots)
+        if n_live == 0:
+            self._cents[cid] = _DEAD_CENTROID  # never probed until a row lands again
+            self._drift[cid] = 0
+            self._trained_sizes[cid] = 0
+            return
+        self._cents[cid] = vecs.mean(axis=0)
+        # re-assign: members now nearer another centroid move there
+        top2 = _assign_rows_np(vecs, self._cents)
+        stray = top2[:, 0] != cid
+        small = n_live < max(4, self._cap_for(len(self.slot_of), self.n_clusters) // 16)
+        if small and self.n_clusters > 2:
+            # merge: drain the cluster entirely into each row's next-best home
+            dest = np.where(top2[:, 0] == cid, top2[:, 1], top2[:, 0])
+            self._move_rows(cid, slots, vecs, norms, dest)
+            self._cents[cid] = _DEAD_CENTROID
+            self.stats["merges"] += 1
+            telemetry.stage_add("index.merges")
+        elif stray.any() and stray.sum() < n_live:
+            self._move_rows(
+                cid, slots[stray], vecs[stray], norms[stray], top2[stray, 0]
+            )
+        block = self._block(cid, create=False)
+        if block is not None and block.n_live > self._cap_for(
+            len(self.slot_of), self.n_clusters
+        ):
+            self._split_cluster(cid)
+        self._drift[cid] = 0
+        self._trained_sizes[cid] = self._live_count(cid)
+
+    def _move_rows(
+        self,
+        from_cid: int,
+        slots: np.ndarray,
+        vecs: np.ndarray,
+        norms: np.ndarray,
+        dest: np.ndarray,
+    ) -> None:
+        src = self._block(from_cid, create=False)
+        for s in slots:
+            loc = self._where.get(int(s))
+            if loc is not None and src is not None and loc[0] == from_cid:
+                src.invalidate(loc[1])
+        order = np.argsort(dest, kind="stable")
+        uniq, first_idx = np.unique(dest[order], return_index=True)
+        bounds = np.append(first_idx, len(order))
+        for g, cid in enumerate(uniq):
+            cid = int(cid)
+            if cid == from_cid:
+                continue
+            sel = order[bounds[g] : bounds[g + 1]]
+            target = self._block(cid, create=True)
+            first = target.append(slots[sel], vecs[sel], norms[sel])
+            for j, row in enumerate(sel):
+                self._where[int(slots[row])] = (cid, first + j)
+            self.tiers.install(cid, target)
+        if src is not None:
+            self.tiers.install(from_cid, src)
+
+    def _compact_cluster(self, cid: int, block: _ClusterPages) -> None:
+        slots, vecs, norms = block.live_rows()
+        fresh = _ClusterPages(self.dim, cap=max(PAGE, len(slots)))
+        if len(slots):
+            fresh.append(slots, vecs, norms)
+        for j, s in enumerate(slots):
+            self._where[int(s)] = (cid, j)
+        self.tiers.install(cid, fresh)
+        self.stats["compactions"] += 1
+        telemetry.stage_add("index.compactions")
+
+    def _maintain(self) -> None:
+        """The commit-boundary maintenance pass: bounded per-cluster work for
+        drifted clusters; schedule/commit the background rebuild."""
+        if self._cents is None:
+            return
+        if self._rebuild_inflight():
+            # the pending generation supersedes any per-cluster fix; churning
+            # blocks under the rebuild snapshot would be wasted work
+            return
+        t0 = time.perf_counter()
+        did = 0
+        threshold = _cluster_drift_threshold()
+        drifted = np.nonzero(
+            self._drift > np.maximum(8, threshold * np.maximum(self._trained_sizes, 1))
+        )[0]
+        for cid in drifted[:64]:  # bound one pass; the rest drift into the next
+            self._maintain_cluster(int(cid))
+            did += 1
+        if did:
+            telemetry.stage_add("index.maintain_clusters", float(did))
+        if (
+            self._churn_since_train
+            >= _rebuild_drift_threshold() * max(self._trained_total, 1)
+            and not self._rebuild_inflight()
+        ):
+            self._schedule_rebuild()
+        self._maybe_spill()
+        pause = time.perf_counter() - t0
+        if did or pause > 1e-4:
+            telemetry.stage_add("index.maintain_s", pause)
+            self.stats["max_pause_s"] = max(self.stats["max_pause_s"], pause)
+
+    def _maybe_spill(self) -> None:
+        if self.tiers.spill_store is None or self._cents is None:
+            return
+        if self._batches < 4:
+            return  # EWMA has no history yet: freezing now thrashes the probes
+        eps = _spill_ewma_threshold()
+        frozen = 0
+        for cid in range(min(self.n_clusters, len(self._ewma))):
+            if frozen >= 16:
+                break
+            if self._ewma[cid] >= eps or self._drift[cid] > 0:
+                continue
+            if self.tiers.residency(cid) != "cold":
+                continue
+            block = self._block(int(cid), create=False)
+            if block is not None and block.n != block.n_live:
+                # compact first: positions must survive the spill round-trip
+                self._compact_cluster(int(cid), block)
+            if self.tiers.spill(int(cid)):
+                frozen += 1
+        if frozen:
+            self.stats["spills"] += frozen
+            telemetry.stage_add("index.spills", float(frozen))
+
+    # -- background rebuild ----------------------------------------------------
+
+    def _rebuild_inflight(self) -> bool:
+        with self._mu:
+            return self._rebuild_thread is not None or self._pending is not None
+
+    def _schedule_rebuild(self) -> None:
+        """Snapshot the corpus (write-once rows + copied validity masks) and
+        train the next generation off-thread; live churn keeps landing in the
+        current generation AND in the dirty-set the swap reconciles."""
+        from pathway_tpu.internals.chaos import get_chaos
+        from pathway_tpu.internals.config import get_pathway_config
+
+        # (vecs, norms, slots, valid, n) per resident cluster; frozen clusters
+        # enter as ("spill", key) and the WORKER loads them off-thread — the
+        # schedule pause must never be proportional to the spill tier (blobs
+        # are retained until the swap's prefix sweep, so the reads are safe)
+        snapshot: List[tuple] = []
+        with self.tiers._cv:
+            pages = dict(self.tiers.pages)
+            spilled = dict(self.tiers.spilled)
+        for cid in range(self.n_clusters):
+            block = pages.get(cid)
+            if block is None:
+                key = spilled.get(cid)
+                if key is not None:
+                    snapshot.append(("spill", key))
+                continue
+            if block.n == 0:
+                continue
+            snapshot.append(
+                (block.vecs, block.norms, block.slots, block.valid[: block.n].copy(), block.n)
+            )
+        if not snapshot:
+            return
+        chaos = get_chaos()
+        rank = get_pathway_config().process_id
+        if chaos is not None:
+            chaos.begin_rebuild_attempt()
+        generation = self.generation + 1
+        self.stats["rebuilds"] += 1
+        telemetry.stage_add("index.rebuilds")
+        _record_event(
+            "index_rebuild", generation=generation, clusters=len(snapshot),
+            rows=len(self.slot_of),
+        )
+        # _rebuild_dirty is engine-thread-only (churn bookkeeping the swap
+        # reconciles); only the thread handle itself is shared with the worker
+        self._rebuild_dirty = set()
+        thread = threading.Thread(
+            target=self._rebuild_worker,
+            args=(generation, snapshot, chaos, rank),
+            name="pathway:ivf-rebuild",
+            daemon=True,
+        )
+        with self._mu:
+            self._rebuild_thread = thread
+        thread.start()
+
+    def _rebuild_worker(
+        self, generation: int, snapshot: List[tuple], chaos: Any, rank: int
+    ) -> None:
+        result = _RebuildResult(generation)
+        try:
+            if chaos is not None:
+                chaos.maybe_rebuild_kill(rank, generation=generation)
+            spill_store = self.tiers.spill_store
+            resolved: List[tuple] = []
+            for entry in snapshot:
+                if not isinstance(entry[0], str):
+                    resolved.append(entry)  # resident (vecs, norms, slots, valid, n)
+                    continue
+                blob = spill_store.get(entry[1]) if spill_store is not None else None
+                if blob is None:
+                    raise TieredIndexError(
+                        f"rebuild snapshot lost frozen cluster blob {entry[1]!r}"
+                    )
+                block = _ClusterPages.from_blob(self.dim, blob)
+                resolved.append(
+                    (block.vecs, block.norms, block.slots,
+                     block.valid[: block.n].copy(), block.n)
+                )
+            snapshot = resolved
+            rng = np.random.default_rng(generation)
+            n_clusters = self._n_clusters_base
+            cap = n_clusters * _TRAIN_SAMPLE_PER_CLUSTER
+            total = sum(int(v.sum()) for _, _, _, v, _ in snapshot)
+            # proportional per-cluster sample, streamed block by block
+            parts = []
+            for vecs, _norms, _slots, valid, n in snapshot:
+                live = vecs[:n][valid]
+                take = min(len(live), max(1, int(round(cap * len(live) / max(total, 1)))))
+                if take >= len(live):
+                    parts.append(live)
+                else:
+                    parts.append(live[rng.choice(len(live), take, replace=False)])
+            sample = np.concatenate(parts) if parts else np.zeros((0, self.dim), np.float32)
+            cents = _train_centroids(sample, n_clusters, self.train_iters, seed=generation)
+            # stream-assign every live row, collecting the new membership
+            members: Dict[int, List[tuple]] = {}
+            for vecs, norms, slots, valid, n in snapshot:
+                live = valid
+                lv = vecs[:n][live]
+                if not len(lv):
+                    continue
+                top2 = _assign_rows_np(lv, cents)
+                ls, ln = slots[:n][live], norms[:n][live]
+                for cid in np.unique(top2[:, 0]):
+                    sel = top2[:, 0] == cid
+                    members.setdefault(int(cid), []).append((ls[sel], lv[sel], ln[sel]))
+            # materialize blocks (+ split badly oversized clusters)
+            pages: Dict[int, _ClusterPages] = {}
+            for cid, chunks in members.items():
+                slots_c = np.concatenate([c[0] for c in chunks])
+                vecs_c = np.concatenate([c[1] for c in chunks])
+                norms_c = np.concatenate([c[2] for c in chunks])
+                block = _ClusterPages(self.dim, cap=max(PAGE, len(slots_c)))
+                block.append(slots_c, vecs_c, norms_c)
+                pages[cid] = block
+            cents, pages = _rebuild_split_pass(cents, pages, self.dim, self._n_clusters_base)
+            where: Dict[int, tuple] = {}
+            trained = np.zeros(len(cents), dtype=np.int64)
+            for cid, block in pages.items():
+                trained[cid] = block.n_live
+                for j in range(block.n):
+                    where[int(block.slots[j])] = (cid, j)
+            result.centroids = cents
+            result.pages = pages
+            result.where = where
+            result.trained_sizes = trained
+        except BaseException as exc:  # noqa: PWA202 (shipped typed to the engine thread via _pending.error — the swap path re-raises it as TieredIndexError)
+            result.error = exc
+        with self._mu:
+            self._pending = result
+            self._rebuild_thread = None
+
+    def _maybe_swap(self) -> None:
+        """The commit-boundary generation swap: atomic from any reader's view
+        (everything re-points under one engine-thread pass; queries only run
+        between commits). The OLD generation serves until this commits."""
+        from pathway_tpu.internals.chaos import get_chaos
+        from pathway_tpu.internals.config import get_pathway_config
+
+        with self._mu:
+            pending = self._pending
+            if pending is None:
+                return
+            self._pending = None
+        dirty = self._rebuild_dirty or set()
+        self._rebuild_dirty = None
+        if pending.error is not None:
+            raise TieredIndexError(
+                f"background index rebuild for generation {pending.generation} "
+                f"failed: {pending.error!r}"
+            ) from pending.error
+        chaos = get_chaos()
+        if chaos is not None and chaos.index_fault(
+            "tier_swap_torn", get_pathway_config().process_id
+        ):
+            # injected torn swap: the pending generation is DISCARDED before
+            # anything re-points — the old generation keeps serving, drift
+            # still exceeds the threshold, and the next maintenance pass
+            # schedules a fresh rebuild (the retry the chaos test asserts)
+            self.stats["swaps_torn"] += 1
+            telemetry.stage_add("index.swaps_torn")
+            _record_event("index_swap", generation=pending.generation, torn=True)
+            return
+        t0 = time.perf_counter()
+        new_tiers = TierManager(
+            self.dim, pending.generation, budget_bytes=self._budget_bytes,
+            device=self.device, spill_store=self.tiers.spill_store,
+        )
+        for cid, block in pending.pages.items():
+            new_tiers.pages[cid] = block
+        cents = pending.centroids
+        where = pending.where
+        trained = pending.trained_sizes
+        # reconcile churn that landed after the snapshot
+        dirty_adds: List[int] = []
+        for slot in dirty:
+            if slot not in self.key_of:
+                # removed post-snapshot: flip it dead in the new generation
+                loc = where.get(slot)
+                if loc is not None:
+                    block = new_tiers.pages.get(loc[0])
+                    if block is not None:
+                        block.invalidate(loc[1])
+                continue
+            if slot not in where:
+                dirty_adds.append(slot)
+        if dirty_adds:
+            vecs = np.stack([self._vector_of(s) for s in dirty_adds]).astype(np.float32)
+            top2 = _assign_rows_np(vecs, cents)
+            norms = np.sum(vecs * vecs, axis=1)
+            for i, slot in enumerate(dirty_adds):
+                cid = int(top2[i, 0])
+                block = new_tiers.pages.get(cid)
+                if block is None:
+                    block = _ClusterPages(self.dim)
+                    new_tiers.pages[cid] = block
+                pos = block.append(
+                    np.asarray([slot]), vecs[i : i + 1], norms[i : i + 1]
+                )
+                where[slot] = (cid, pos)
+        # the swap: one engine-thread re-point (commit-boundary atomicity)
+        old_tiers = self.tiers
+        self._cents = cents
+        self._where = where
+        self.n_clusters = len(cents)
+        self.tiers = new_tiers
+        self.generation = pending.generation
+        self._trained_sizes = trained
+        self._drift = np.zeros(len(cents), dtype=np.int64)
+        self._ewma = np.zeros(len(cents), dtype=np.float64)
+        self._trained_total = len(self.slot_of)
+        self._churn_since_train = 0
+        # re-arm the spill settling guard: the fresh generation's EWMA is all
+        # zeros, and freezing before it has history would spill the hottest
+        # working set right at the swap
+        self._batches = 0
+        # the old generation is retired: sweep EVERY blob under its prefix
+        # (incl. ones whose entries were popped by unspill) — the frozen tier
+        # must never accumulate one full copy per rebuild
+        if old_tiers.spill_store is not None:
+            with old_tiers._cv:
+                old_tiers.spilled.clear()
+            prefix = f"{old_tiers.spill_prefix}/gen{old_tiers.generation}"
+            for key in old_tiers.spill_store.list(prefix):
+                old_tiers.spill_store.delete(key)
+        pause = time.perf_counter() - t0
+        self.stats["swaps"] += 1
+        self.stats["max_pause_s"] = max(self.stats["max_pause_s"], pause)
+        telemetry.stage_add_many({"index.swaps": 1.0, "index.swap_s": pause})
+        _record_event(
+            "index_swap", generation=self.generation, pause_s=round(pause, 4),
+            clusters=self.n_clusters,
+        )
+
+    def _vector_of(self, slot: int) -> np.ndarray:
+        loc = self._where.get(slot)
+        if loc is None:
+            raise TieredIndexError(f"slot {slot} has no located vector")
+        block = self._block(loc[0], create=False)
+        if block is None:
+            raise TieredIndexError(f"cluster {loc[0]} pages unavailable for slot {slot}")
+        return block.vecs[loc[1]]
+
+    # -- search ---------------------------------------------------------------
+
+    def _effective_n_probe(self) -> int:
+        """Brownout-aware probe count (same contract as the untiered store)."""
+        from pathway_tpu.engine.brownout import get_brownout
+
+        return max(1, self.n_probe >> get_brownout().nprobe_shift())
+
+    def _prepare_search(self) -> bool:
+        self._flush()
+        if self._cents is None:
+            self._initial_train()
+        self._maybe_swap()
+        self._maintain()
+        # a swap scheduled by THIS maintain pass is taken at the NEXT commit
+        # boundary — queries in between keep the old generation (fence-riding)
+        return self._cents is not None
+
+    def _touch(self, probed: np.ndarray, counts: np.ndarray, allow_promote: bool) -> None:
+        alpha = _ewma_alpha()
+        if len(self._ewma) < self.n_clusters:
+            self._grow_cluster_arrays(self.n_clusters)
+        self._ewma *= 1.0 - alpha
+        share = counts / max(counts.sum(), 1)
+        self._ewma[probed] += alpha * share * len(probed)
+        if not allow_promote:
+            return
+        to_promote = [
+            int(c) for c in probed if self.tiers.residency(int(c)) in ("cold", "spilled")
+        ]
+        if not to_promote:
+            return
+        if self._prefetch_on:
+            self._prefetcher.request(self.tiers, to_promote, promote=True)
+        else:
+            for cid in to_promote:
+                if self.tiers.residency(cid) == "spilled":
+                    self.tiers.unspill(cid)
+                self.tiers.promote(cid)
+
+    def _scoring_block(self, cid: int, res_at_probe: str) -> Optional[_ClusterPages]:
+        """The block for scoring. A cluster that was FROZEN at probe time
+        observes its surfaced stall — ~0 when the prefetch overlap window hid
+        the load entirely (exactly what the stall histogram should say), the
+        real wait when it did not."""
+        if res_at_probe == "spilled":
+            t0 = time.perf_counter()
+            block = self.tiers.wait_loaded(cid, timeout=0.05)
+            if block is None:
+                block = self.tiers.unspill(cid)
+            if block is None:
+                # a slow stage (large cluster / slow object store) is still in
+                # flight: wait it out — silently skipping the cluster would
+                # change results, the one thing residency must never do
+                block = self.tiers.wait_loaded(cid, timeout=30.0)
+                if block is None and self.tiers.residency(cid) != "absent":
+                    raise TieredIndexError(
+                        f"cluster {cid} pages never arrived from the spill "
+                        "tier (stage wedged or object store unreachable)"
+                    )
+            stall = time.perf_counter() - t0
+            self.stats["prefetch_stall_s"] += stall
+            from pathway_tpu.engine.profile import histogram
+
+            histogram("pathway_ivf_prefetch_stall_seconds").observe(stall)
+            telemetry.stage_add("index.prefetch_stall_s", stall)
+            return block
+        res = self.tiers.residency(cid)
+        if res in ("hot", "cold"):
+            with self.tiers._cv:
+                return self.tiers.pages.get(cid)
+        if res == "absent":
+            return None  # empty cluster: no pages anywhere, nothing to score
+        block = self.tiers.wait_loaded(cid, timeout=0.05)
+        return block if block is not None else self.tiers.unspill(cid)
+
+    def search_batch(self, queries: Any, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        ready = self._prepare_search()
+        q = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
+        nq = q.shape[0]
+        k_eff = max(1, k)
+        if not ready:
+            return (
+                np.full((nq, k_eff), -np.inf, dtype=np.float32),
+                np.full((nq, k_eff), -1, dtype=np.int64),
+                np.zeros((nq, k_eff), dtype=bool),
+            )
+        from pathway_tpu.engine.brownout import get_brownout
+
+        self._batches += 1
+        shift = get_brownout().nprobe_shift()
+        n_probe = max(1, min(self.n_probe >> shift, self.n_clusters))
+        cents = self._cents
+        cn = np.sum(cents * cents, axis=1)
+        aff = 2.0 * q @ cents.T - cn[None, :]
+        if n_probe < self.n_clusters:
+            probe = np.argpartition(aff, -n_probe, axis=1)[:, -n_probe:]
+        else:
+            probe = np.broadcast_to(
+                np.arange(self.n_clusters), (nq, self.n_clusters)
+            ).copy()
+        probed, counts = np.unique(probe, return_counts=True)
+        # residency census AT PROBE TIME — the hit rate reflects where the
+        # coarse quantizer found each cluster, before any staging moves it
+        at_probe = {int(c): self.tiers.residency(int(c)) for c in probed}
+        n_hot = sum(1 for r in at_probe.values() if r == "hot")
+        n_cold = sum(1 for r in at_probe.values() if r == "cold")
+        n_spilled = sum(1 for r in at_probe.values() if r == "spilled")
+        self.stats["probe_hot"] += n_hot
+        self.stats["probe_cold"] += n_cold
+        self.stats["probe_spilled"] += n_spilled
+        telemetry.stage_add_many({
+            "index.probes": float(len(probed)),
+            "index.probe_hot": float(n_hot),
+            "index.probe_cold": float(n_cold),
+            "index.probe_spilled": float(n_spilled),
+        })
+        # a browned-out probe set must never thrash the tiers (rung 2 is
+        # half the clusters — promoting for it evicts the real working set)
+        self._touch(probed, counts, allow_promote=shift == 0)
+        # async prefetch: name every probed frozen cluster BEFORE scoring, so
+        # the load overlaps the hot/cold scoring work below
+        frozen = [cid for cid, r in at_probe.items() if r == "spilled"]
+        if frozen and self._prefetch_on:
+            self._prefetcher.request(self.tiers, frozen, promote=False)
+        qn = np.sum(q * q, axis=1)
+        # cluster-major scoring, resident clusters first (the overlap window)
+        order_ids = sorted(
+            at_probe, key=lambda c: 0 if at_probe[c] in ("hot", "cold") else 1
+        )
+        blocks: Dict[int, _ClusterPages] = {}
+        widths: Dict[int, int] = {}
+        for cid in order_ids:
+            block = self._scoring_block(cid, at_probe[cid])
+            if block is not None and block.n > 0:
+                blocks[cid] = block
+                widths[cid] = block.n
+        # per-query candidate layout (same shape discipline as _search_numpy)
+        pc = np.array(
+            [[widths.get(int(c), 0) for c in row] for row in probe], dtype=np.int64
+        )
+        col0 = np.zeros_like(pc)
+        np.cumsum(pc[:, :-1], axis=1, out=col0[:, 1:])
+        W = int(pc.sum(axis=1).max()) if nq else 0
+        if W == 0:
+            return (
+                np.full((nq, k_eff), -np.inf, dtype=np.float32),
+                np.full((nq, k_eff), -1, dtype=np.int64),
+                np.zeros((nq, k_eff), dtype=bool),
+            )
+        buf_s = np.full((nq, W), -np.inf, dtype=np.float32)
+        buf_i = np.full((nq, W), -1, dtype=np.int64)
+        flatc = probe.ravel()
+        flatq = np.repeat(np.arange(nq), probe.shape[1])
+        flats = col0.ravel()
+        order = np.argsort(flatc, kind="stable")
+        fc, fq, fs = flatc[order], flatq[order], flats[order]
+        uniq, first = np.unique(fc, return_index=True)
+        bounds = np.append(first, len(fc))
+        device_hot = jax.default_backend() != "cpu"
+        for g in range(len(uniq)):
+            cid = int(uniq[g])
+            block = blocks.get(cid)
+            if block is None:
+                continue
+            sel = slice(bounds[g], bounds[g + 1])
+            qs, ds = fq[sel], fs[sel]
+            n = block.n
+            mirror = None
+            if device_hot and self._device_ok:
+                with self.tiers._cv:
+                    mirror = self.tiers.hot.get(cid)
+
+            def host_scores() -> np.ndarray:
+                s = q[qs] @ block.vecs[:n].T
+                if self.metric == "l2sq":
+                    s = 2.0 * s - block.norms[:n][None, :] - qn[qs][:, None]
+                elif self.metric == "cos":
+                    s = s / np.maximum(
+                        np.sqrt(qn[qs])[:, None]
+                        * np.sqrt(block.norms[:n])[None, :],
+                        1e-30,
+                    )
+                return np.where(block.valid[:n][None, :], s, -np.inf)
+
+            if mirror is not None and mirror is not True:
+                sub = np.asarray(
+                    _score_block_kernel(
+                        mirror[0], mirror[1], mirror[2],
+                        jnp.asarray(q[qs]), self.metric,
+                    )
+                )[:, :n]
+                if not self._device_checked:
+                    # first-use parity probe: the device GEMM must agree with
+                    # the host path byte-for-byte or it never scores again
+                    self._device_checked = True
+                    if not np.array_equal(sub, host_scores()):
+                        self._device_ok = False
+                        telemetry.stage_add("index.device_parity_rejects")
+                        sub = host_scores()
+            else:
+                sub = host_scores()
+            cols = ds[:, None] + np.arange(n)[None, :]
+            buf_s[qs[:, None], cols] = sub
+            buf_i[qs[:, None], cols] = np.where(block.valid[:n], block.slots[:n], -1)
+        scores, idx = topk_rows(buf_s, buf_i, k_eff)
+        valid = np.isfinite(scores)
+        # per-batch tier observability (hit rate, occupancy)
+        from pathway_tpu.engine.profile import histogram
+
+        total = n_hot + n_cold + n_spilled
+        if total > 0:
+            histogram("pathway_ivf_tier_hit_ratio").observe(
+                (n_hot + n_cold) / total
+            )
+        histogram("pathway_ivf_tier_occupancy_ratio").observe(self.tiers.occupancy())
+        return scores, idx, valid
+
+    # -- export / lifecycle ----------------------------------------------------
+
+    def export_rows(self) -> Tuple[List[Any], np.ndarray]:
+        """Every live (key, vector) pair — the rebuildable-descriptor
+        contract shared with the dense stores."""
+        self._flush()
+        keys: List[Any] = []
+        parts: List[np.ndarray] = []
+        if self._untrained_slots:
+            keys.extend(self.key_of[s] for s in self._untrained_slots)
+            parts.extend(v[None, :] for v in self._untrained_vecs)
+        seen_cids = set(
+            cid for cid, _pos in self._where.values()
+        )
+        for cid in sorted(seen_cids):
+            block = self._block(cid, create=False)
+            if block is None:
+                continue
+            slots, vecs, _norms = block.live_rows()
+            for j, s in enumerate(slots):
+                key = self.key_of.get(int(s))
+                if key is not None:
+                    keys.append(key)
+                    parts.append(vecs[j : j + 1])
+        if not parts:
+            return keys, np.zeros((0, self.dim), dtype=np.float32)
+        return keys, np.concatenate(parts)
+
+    def attach_spill(self, store: Any, prefix: str = "ivf-spill") -> None:
+        """Enable the frozen tier behind any persistence ``ObjectStore``."""
+        with self.tiers._cv:
+            self.tiers.spill_store = store
+            self.tiers.spill_prefix = prefix
+
+    def tier_stats(self) -> Dict[str, Any]:
+        counts = self.tiers.counts()
+        out = dict(self.stats)
+        out.update(counts)
+        out["generation"] = self.generation
+        out["n_clusters"] = self.n_clusters
+        out["hot_bytes"] = self.tiers.hot_bytes
+        out["budget_bytes"] = self._budget_bytes
+        out["occupancy"] = self.tiers.occupancy()
+        out["rebuild_inflight"] = self._rebuild_inflight()
+        return out
+
+    def close(self) -> None:
+        """Join the worker threads (tests and long-lived servers); the store
+        remains usable — workers re-spawn lazily."""
+        self._prefetcher.close()
+        with self._mu:
+            thread = self._rebuild_thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=30.0)
+
+
+def hbm_budget_bytes_env() -> int:
+    """Alias kept separate so the ctor default reads the env exactly once."""
+    return hbm_budget_bytes()
+
+
+def _rebuild_split_pass(
+    cents: np.ndarray,
+    pages: Dict[int, _ClusterPages],
+    dim: int,
+    base_clusters: int,
+) -> Tuple[np.ndarray, Dict[int, _ClusterPages]]:
+    """Split oversized clusters of a freshly-built generation (bounds the
+    per-probe page budget like the untiered store's train-time splits)."""
+    total = sum(b.n_live for b in pages.values())
+    cap = TieredIvfKnnStore._cap_for(total, max(len(cents), 1))
+    limit = 2 * base_clusters
+    cents_list = [cents]
+    for _ in range(6):
+        n_now = sum(c.shape[0] for c in cents_list)
+        over = [
+            cid for cid, b in pages.items() if b.n_live > cap
+        ]
+        if not over or n_now + len(over) > limit:
+            break
+        for cid in over:
+            block = pages[cid]
+            slots, vecs, norms = block.live_rows()
+            g1 = _two_means(vecs)
+            if not g1.any() or g1.all():
+                continue
+            new_cid = sum(c.shape[0] for c in cents_list)
+            keep = _ClusterPages(dim, cap=max(PAGE, int((~g1).sum())))
+            keep.append(slots[~g1], vecs[~g1], norms[~g1])
+            moved = _ClusterPages(dim, cap=max(PAGE, int(g1.sum())))
+            moved.append(slots[g1], vecs[g1], norms[g1])
+            pages[cid] = keep
+            pages[new_cid] = moved
+            all_c = np.concatenate(cents_list)
+            all_c[cid] = vecs[~g1].mean(axis=0)
+            cents_list = [all_c, vecs[g1].mean(axis=0)[None, :]]
+    return np.concatenate(cents_list).astype(np.float32), pages
+
+
+def _record_event(kind: str, **details: Any) -> None:
+    try:
+        from pathway_tpu.engine.profile import get_flight_recorder
+
+        get_flight_recorder().record_event(kind, **details)
+    except Exception:  # noqa: PWA202 (observability must never kill the serving path; no typed contract rides through here)
+        pass

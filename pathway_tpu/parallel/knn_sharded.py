@@ -211,6 +211,7 @@ class ShardedIvfKnnStore:
         n_clusters: int = 64,
         n_probe: int = 8,
         dtype: Any = None,
+        tiered: bool = False,
     ):
         from pathway_tpu.ops.knn_ivf import IvfKnnStore
 
@@ -220,20 +221,43 @@ class ShardedIvfKnnStore:
         self.dim = dim
         self.metric = metric
         self.n_shards = len(devices)
+        self.tiered = bool(tiered)
         per_shard_cap = max(16, -(-initial_capacity // self.n_shards))
-        kwargs: dict = {} if dtype is None else {"dtype": dtype}
-        self.stores: List[IvfKnnStore] = [
-            IvfKnnStore(
-                dim,
-                metric=metric,
-                initial_capacity=per_shard_cap,
-                n_clusters=n_clusters,
-                n_probe=n_probe,
-                device=dev,
-                **kwargs,
-            )
-            for dev in devices
-        ]
+        if tiered:
+            # one tiered sub-store per shard device, the per-chip HBM budget
+            # split evenly (each shard manages its own hot set / prefetch /
+            # background rebuild — the swap stays shard-local, riding each
+            # shard's own commit boundary)
+            from pathway_tpu.ops.knn_tiers import TieredIvfKnnStore, hbm_budget_bytes
+
+            budget = hbm_budget_bytes()
+            per_shard_budget = budget // self.n_shards if budget else 0
+            self.stores: List[Any] = [
+                TieredIvfKnnStore(
+                    dim,
+                    metric=metric,
+                    initial_capacity=per_shard_cap,
+                    n_clusters=n_clusters,
+                    n_probe=n_probe,
+                    device=dev,
+                    hbm_budget_bytes=per_shard_budget,
+                )
+                for dev in devices
+            ]
+        else:
+            kwargs: dict = {} if dtype is None else {"dtype": dtype}
+            self.stores = [
+                IvfKnnStore(
+                    dim,
+                    metric=metric,
+                    initial_capacity=per_shard_cap,
+                    n_clusters=n_clusters,
+                    n_probe=n_probe,
+                    device=dev,
+                    **kwargs,
+                )
+                for dev in devices
+            ]
         self.slot_of: Dict[Any, int] = {}
         self.key_of: Dict[int, Any] = {}
         self._shard_of: Dict[Any, int] = {}
@@ -306,8 +330,10 @@ class ShardedIvfKnnStore:
             parts_s.append(s[:, :k_eff])
             parts_i.append(gi[:, :k_eff])
 
-        if jax.default_backend() == "cpu":
-            # host BLAS path per shard — host-bound, nothing to overlap
+        if jax.default_backend() == "cpu" or self.tiered:
+            # host BLAS path per shard — host-bound, nothing to overlap (the
+            # tiered sub-stores dispatch their own hot-block device GEMMs and
+            # prefetch staging inside search_batch)
             for shard, store in enumerate(self.stores):
                 s, i, _v = store.search_batch(queries, k_eff)
                 globalize(s, i, shard)
@@ -335,6 +361,20 @@ class ShardedIvfKnnStore:
             np.concatenate(parts_s, axis=1), np.concatenate(parts_i, axis=1), k_eff
         )
         return scores, idx, np.isfinite(scores)
+
+    def export_rows(self) -> Tuple[List[Any], np.ndarray]:
+        """Every live (key, vector) pair across all shards — the rebuildable-
+        descriptor contract shared with the single-chip stores."""
+        keys: List[Any] = []
+        parts: List[np.ndarray] = []
+        for store in self.stores:
+            shard_keys, shard_vecs = store.export_rows()
+            keys.extend(shard_keys)
+            if len(shard_keys):
+                parts.append(np.asarray(shard_vecs, dtype=np.float32))
+        if not parts:
+            return keys, np.zeros((0, self.dim), dtype=np.float32)
+        return keys, np.concatenate(parts)
 
 
 def _interleaved_free_list(start: int, stop: int, n_shards: int) -> List[int]:

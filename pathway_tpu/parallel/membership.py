@@ -243,9 +243,15 @@ def compute_reshard_plan(runner: Any) -> ReshardPlan:
                     p = contrib[0]
                 else:
                     p = "refuse"
-                if p in ("bykey", "source") and node.kind not in _KEY_PRESERVING:
+                if (
+                    p in ("bykey", "source")
+                    and node.kind not in _KEY_PRESERVING
+                    and node.kind != "external_index"
+                ):
                     # key-changing op: output keys are neither the exchange
-                    # key nor the preserved source key — not partitionable
+                    # key nor the preserved source key — not partitionable.
+                    # external_index is exempt: its output universe IS the
+                    # query input's universe (replies keyed by query key).
                     p = "refuse"
         memo[node.id] = p
         return p
@@ -265,6 +271,17 @@ def compute_reshard_plan(runner: Any) -> ReshardPlan:
                 "this build cannot re-partition it across a membership "
                 "change (join/dedup handoff is the ROADMAP follow-on)"
             )
+            continue
+        if node.kind == "external_index":
+            # the new contract: an index that exports a rebuildable
+            # descriptor replicates to the new topology (its data side is
+            # broadcast — every rank already holds identical content); the
+            # typed refusal is KEPT for index types that cannot export
+            reason = ev.reshard_check() if ev is not None else "no evaluator"
+            if reason is not None:
+                refusals.append(f"node {node.id} ({node.kind}): {reason}")
+                continue
+            policies[node.id] = "replicate"
             continue
         if not getattr(ev, "SNAPSHOT_CAPTURE", True):
             refusals.append(
@@ -412,6 +429,7 @@ def build_fragments(
             "states": {},
             "evals": {},
             "evals_full": {},
+            "evals_rebuild": {},
             "source_offsets": {},
             "source_deltas": {},
         }
@@ -421,6 +439,23 @@ def build_fragments(
     for nid, policy in plan.policies.items():
         ev = runner.evaluators[nid]
         state = runner.states.get(nid)
+        if policy == "replicate":
+            # replicated index content: identical on every old rank by the
+            # broadcast construction, so rank 0's descriptor is authoritative
+            # and ships to EVERY new rank; the keyed query-side state
+            # partitions by row key like any bykey evaluator
+            if me == 0:
+                desc = ev.rebuild_descriptor()
+                for dest in range(new_n):
+                    fragments[dest]["evals_rebuild"][nid] = desc
+            for dest, payload in ev.reshard_export(bykey, new_n).items():
+                fragments[dest]["evals"][nid] = payload
+            if state is not None and nid in runner._materialized:
+                for dest, part in state.reshard_partition(bykey).items():
+                    fragments[dest]["states"][nid] = part
+                    if dest != me:
+                        rows_moved += len(part[0])
+            continue
         if policy == "root":
             # centralized state lives at rank 0 ONLY — rank 0's copy is
             # authoritative, and a non-root rank's empty mirror must never
@@ -483,6 +518,10 @@ def import_fragments(runner: Any, frags: List[dict]) -> Dict[str, int]:
             ev = runner.evaluators.get(int(nid))
             if ev is not None:
                 ev.load_state_dict(blobs)
+        for nid, desc in frag.get("evals_rebuild", {}).items():
+            ev = runner.evaluators.get(int(nid))
+            if ev is not None and desc is not None:
+                ev.install_rebuild_descriptor(desc)
     return {"rows_imported": rows}
 
 

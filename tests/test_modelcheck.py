@@ -392,6 +392,108 @@ def test_membership_epoch_before_install_bug_caught_and_replayable():
 
 
 # ---------------------------------------------------------------------------
+# tiered IVF index (prefetch staging / background rebuild / generation swap)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tiered
+def test_tiered_index_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.tiered_index_model(), max_schedules=N_SCHEDULES, name="tiered"
+    )
+    _BATTERY_SECONDS["tiered"] = time.monotonic() - t0
+    assert result.ok, (
+        f"tiered-index invariant failed on schedule {result.failing_schedule}: "
+        f"{result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.tiered
+def test_tiered_index_invariants_hold_seeded():
+    result = sweep_seeds(
+        pm.tiered_index_model(), n_seeds=100, base_seed=91, name="tiered-seeded"
+    )
+    assert result.ok, f"seed {result.failing_seed}: {result.failure}"
+    assert result.distinct_schedules == 100
+
+
+@pytest.mark.tiered
+def test_tiered_torn_swap_bug_caught_with_seed():
+    # the reader must land between the two swap acquisitions — deep in the
+    # tree, seeded walks reach it (same split as the membership batteries)
+    result = sweep_seeds(
+        pm.tiered_index_model(bug="torn_swap"),
+        n_seeds=300,
+        base_seed=7,
+        name="tiered-torn",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the torn-swap regression went undetected"
+    )
+    assert "torn generation read" in str(result.failure)
+    assert result.failing_seed is not None
+    with pytest.raises(InvariantViolation, match="torn generation read"):
+        run_once(
+            pm.tiered_index_model(bug="torn_swap"), seed=result.failing_seed
+        )
+
+
+@pytest.mark.tiered
+def test_tiered_incomplete_swap_bug_caught_and_replayable():
+    result = explore(
+        pm.tiered_index_model(bug="swap_incomplete"),
+        max_schedules=300,
+        name="tiered-incomplete",
+    )
+    assert isinstance(result.failure, InvariantViolation)
+    assert "incomplete generation" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="incomplete generation"):
+        run_once(
+            pm.tiered_index_model(bug="swap_incomplete"),
+            choices=result.failing_schedule,
+        )
+
+
+@pytest.mark.tiered
+def test_tiered_drop_old_early_bug_caught_with_seed():
+    # the old generation freed before the swap commits: an in-flight query
+    # must hit the hole — again a deep interleaving, reached by seeded walks
+    result = sweep_seeds(
+        pm.tiered_index_model(bug="drop_old_early"),
+        n_seeds=300,
+        base_seed=7,
+        name="tiered-dropold",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the old-generation-freed-early regression went undetected"
+    )
+    assert "incomplete generation" in str(result.failure)
+    assert result.failing_seed is not None
+    with pytest.raises(InvariantViolation, match="incomplete generation"):
+        run_once(
+            pm.tiered_index_model(bug="drop_old_early"), seed=result.failing_seed
+        )
+
+
+@pytest.mark.tiered
+def test_tiered_stage_leak_bug_caught_and_replayable():
+    result = explore(
+        pm.tiered_index_model(bug="leak_stage"),
+        max_schedules=400,
+        name="tiered-leak",
+    )
+    assert isinstance(result.failure, InvariantViolation)
+    assert "staging slots leaked" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="staging slots leaked"):
+        run_once(
+            pm.tiered_index_model(bug="leak_stage"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
 # closed-loop autoscaler (controller <-> transition executor)
 # ---------------------------------------------------------------------------
 
@@ -583,7 +685,7 @@ def test_model_check_battery_within_budget():
     # redone here); each 200-schedule explore is a few seconds solo, and the
     # documented <60 s budget must hold even under full-suite load
     if set(_BATTERY_SECONDS) != {
-        "fence", "ckpt", "encsvc", "membership", "autoscaler",
+        "fence", "ckpt", "encsvc", "membership", "autoscaler", "tiered",
     }:
         pytest.skip("acceptance batteries did not run in this session (-k selection)")
     total = sum(_BATTERY_SECONDS.values())

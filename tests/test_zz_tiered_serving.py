@@ -1,0 +1,150 @@
+"""Tiered-index × brownout serving interplay (ISSUE 15 satellite): a LIVE
+REST retrieve route over a tiered IVF external index, with the brownout
+ladder's rung 2 engaged mid-stream — the halved probe set must keep serving
+AND must never trigger tier-promotion churn.
+
+Lives at the end of the suite's alphabetical order on purpose (the
+``test_zz_`` discipline): this test starts a real ``pw.run`` engine behind a
+REST connector, and streaming REST sources run forever (daemon threads) — a
+lazy autocommit tick keeps the residual idle load off earlier
+timing-sensitive tests."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.brownout import get_brownout, reset_brownout
+from pathway_tpu.internals.parse_graph import G
+
+pytestmark = pytest.mark.tiered
+
+
+def _fake_vec(text: str, dim: int = 8) -> np.ndarray:
+    digest = hashlib.sha256(str(text).encode()).digest()
+    rng = np.random.default_rng(int.from_bytes(digest[:8], "little"))
+    v = rng.normal(size=dim).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+def _start_retrieve_server(port: int, monkeypatch) -> None:
+    from pathway_tpu.io.http import PathwayWebserver, rest_connector
+    from pathway_tpu.stdlib.indexing import IvfKnnFactory
+
+    monkeypatch.setenv("PATHWAY_IVF_TIERED", "on")
+    # a tiny hot budget (~16 KiB) keeps most clusters COLD, so a promotion
+    # during the browned-out window would be observable — the assertion is
+    # about real candidates, not a vacuously-hot store
+    monkeypatch.setenv("PATHWAY_IVF_HBM_BUDGET_MB", "0.016")
+    G.clear()
+
+    @pw.udf
+    def embed(text: str) -> np.ndarray:
+        return _fake_vec(text)
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_builder({"text": str}),
+        [(f"doc-{i}",) for i in range(64)],
+    )
+    factory = IvfKnnFactory(dimensions=8, n_clusters=4, n_probe=4, embedder=embed)
+    index = factory.build_index(docs.text, docs)
+    ws = PathwayWebserver(host="127.0.0.1", port=port)
+
+    class Q(pw.Schema):
+        text: str
+
+    queries, writer = rest_connector(
+        webserver=ws, route="/v1/retrieve", schema=Q,
+        delete_completed_queries=True,
+        # lazy tick: the daemon engine's idle churn stays off the suite
+        autocommit_duration_ms=25,
+    )
+    res = index.query_as_of_now(
+        queries.text, number_of_matches=1, collapse_rows=True
+    )
+    writer(res.select(result=pw.apply(lambda t: list(t), pw.this.text)))
+    threading.Thread(
+        target=lambda: pw.run(monitoring_level=pw.MonitoringLevel.NONE),
+        daemon=True,
+    ).start()
+    deadline = time.monotonic() + 20
+    while True:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return
+        except OSError:
+            assert time.monotonic() < deadline, "REST server never came up"
+            time.sleep(0.2)
+
+
+def _retrieve(port: int, text: str, timeout: float = 30.0):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/retrieve",
+        data=json.dumps({"text": text}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as exc:
+        exc.read()
+        return exc.code, None
+    except Exception:
+        return 0, None
+
+
+def test_browned_out_retrieve_serves_without_promotion_churn(monkeypatch):
+    """Rung 2 engaged against a live tiered-index retrieve route: requests
+    keep answering (recall degrades honestly via the halved probe set) and
+    the browned-out window issues ZERO tier-promotion prefetches — the
+    degradation ladder must never thrash the tiers it protects."""
+    from pathway_tpu.engine import telemetry
+
+    reset_brownout()
+    try:
+        port = 18911
+        _start_retrieve_server(port, monkeypatch)
+
+        def ask(text: str) -> list:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 20.0:
+                code, body = _retrieve(port, text)
+                if code == 200:
+                    return body.get("result") if isinstance(body, dict) else body
+                time.sleep(0.3)  # shed/transient: honest retry
+            raise AssertionError(f"retrieve {text!r} never answered")
+
+        # warm serving at rung 0 (trains the index, settles the EWMA)
+        for i in range(4):
+            got = ask(f"doc-{i * 7}")
+            assert got == [f"doc-{i * 7}"], got
+
+        get_brownout().observe_occupancy(0.95)  # engage rung 2
+        assert get_brownout().nprobe_shift() == 1
+        before = telemetry.stage_snapshot("index.").get(
+            "index.prefetch_requests", 0.0
+        )
+        # browned-out serving: answers keep coming (full probe is 4, halved
+        # is 2 — the self-match query still lands in its own cluster)
+        for i in range(6):
+            got = ask(f"doc-{i * 9 + 1}")
+            assert got == [f"doc-{i * 9 + 1}"], got
+        after = telemetry.stage_snapshot("index.").get(
+            "index.prefetch_requests", 0.0
+        )
+        assert after == before, (
+            "browned-out probes triggered tier-promotion churn",
+            before, after,
+        )
+    finally:
+        reset_brownout()
