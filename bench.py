@@ -259,7 +259,16 @@ def bench_ivf_scale() -> dict:
     )
     results["ivfscale_hot_clusters"] = stats["hot"]
     results["ivfscale_occupancy"] = round(stats["occupancy"], 3)
-    results["ivfscale_docs_over_hot_budget"] = round(corpus_bytes / budget, 1)
+    # per-slot footprint MEASURED from the resident blocks (payload dtype +
+    # sidecars), not an assumed fp32 row width — the assumption misprices
+    # the store whenever the payload dtype differs (PATHWAY_IVF_QUANT)
+    blocks = list(store.tiers.pages.values())
+    slot_bytes = sum(b.nbytes for b in blocks) / max(
+        sum(b.vecs.shape[0] for b in blocks), 1
+    )
+    results["ivfscale_docs_over_hot_budget"] = round(
+        n_docs * slot_bytes / budget, 1
+    )
 
     # -- churn phase: sustained replace traffic while serving ------------------
     # enough waves to cross the rebuild-drift threshold: the full re-train
@@ -372,6 +381,161 @@ def bench_ivf_scale() -> dict:
         results["ivfscale_prefetch_stalls"] = 0
     store.close()
     shutil.rmtree(spill_dir, ignore_errors=True)
+    return results
+
+
+def bench_quant() -> dict:
+    """Quantized retrieval tower (``PATHWAY_IVF_QUANT=int8``): the SAME
+    corpus in an fp32-payload and an int8-payload tiered store at the SAME
+    hot budget. The capacity multiple is MEASURED from actual block bytes
+    (never an assumed row width), the recall cost is measured against brute
+    force with the exact-rescore epilogue on, and the rescore contract is
+    re-proven from outside the store: every returned score must be bitwise
+    equal to ``rescore_pairs`` recomputed over the returned (query, slot)
+    pairs from the fp32 source rows. CPU-honest — every key is a real
+    measurement that degrades loudly, never a skip."""
+    from pathway_tpu.engine.profile import histograms
+    from pathway_tpu.ops.knn_quant import rescore_pairs
+    from pathway_tpu.ops.knn_tiers import TieredIvfKnnStore
+
+    dim = 128
+    n_docs = 6_000 if SMOKE else 24_000
+    n_queries, k = 128, 10
+    n_centers = 128
+    rng = np.random.default_rng(21)
+    centers = rng.normal(scale=4.0, size=(n_centers, dim)).astype(np.float32)
+
+    def clustered(n: int, seed: int) -> np.ndarray:
+        r = np.random.default_rng(seed)
+        return (
+            centers[r.integers(0, n_centers, n)]
+            + r.normal(size=(n, dim)).astype(np.float32)
+        ).astype(np.float32)
+
+    data = clustered(n_docs, 22)
+    queries = clustered(n_queries, 23)
+    n_clusters = max(16, n_docs // 512)
+    budget = max(1, (n_docs * dim * 4) // 10)
+    keys = [f"d{i}" for i in range(n_docs)]
+    results: dict = {"quant_docs": n_docs, "quant_dim": dim}
+
+    def build(quant: str) -> TieredIvfKnnStore:
+        # full probe isolates the payload-dtype cost: recall differences are
+        # then quantization, not probe luck
+        store = TieredIvfKnnStore(
+            dim, metric="l2sq", n_clusters=n_clusters, n_probe=n_clusters,
+            hbm_budget_bytes=budget, quant=quant,
+        )
+        for s in range(0, n_docs, 4000):
+            store.add_many(keys[s : s + 4000], data[s : s + 4000])
+        store.search_batch(queries[:8], k)  # train/maintain off the clock
+        return store
+
+    f32 = build("off")
+    q8 = build("int8")
+
+    qn_full = np.sum(queries * queries, axis=1)
+    dn = np.sum(data * data, axis=1)[None, :]
+    exact_idx = np.argsort(
+        qn_full[:, None] + dn - 2.0 * queries @ data.T, axis=1
+    )[:, :k]
+    want = [{f"d{j}" for j in exact_idx[r]} for r in range(n_queries)]
+
+    def recall(store: TieredIvfKnnStore) -> float:
+        _s, idx, _v = store.search_batch(queries, k)
+        hits = 0
+        for r in range(n_queries):
+            got = {store.key_of.get(int(x)) for x in idx[r] if x >= 0}
+            hits += len(got & want[r])
+        return hits / (n_queries * k)
+
+    recall_f = recall(f32)
+    recall_q = recall(q8)
+    ratio = recall_q / max(recall_f, 1e-12)
+    results["quant_recall_at_10_fp32"] = round(recall_f, 4)
+    results["quant_recall_at_10_int8"] = round(recall_q, 4)
+    results["quant_recall_ratio"] = round(ratio, 4)
+    results["quant_recall_honest"] = bool(ratio >= 0.99)
+    # the store's own online audit (also populates the /metrics histogram)
+    results["quant_recall_audit"] = round(
+        float(q8.quant_recall_audit(queries[:64], k=k)), 4
+    )
+
+    # -- rescore-epilogue bitwise honesty --------------------------------------
+    # recompute OUTSIDE the store: gather each returned slot's fp32 source
+    # row, rebuild its norm with the store's own expression, push the pairs
+    # through the pinned epilogue — bitwise equality or the key goes false
+    s_q, i_q, _ = q8.search_batch(queries, k)
+    bitwise = True
+    for r in range(n_queries):
+        m = i_q[r] >= 0
+        slots = i_q[r][m].astype(int)
+        if slots.size == 0:
+            continue
+        vecs = np.stack([q8._vector_of(int(s)) for s in slots]).astype(np.float32)
+        norms = np.sum(vecs * vecs, axis=1)
+        qi = np.full(slots.size, r)
+        exact = rescore_pairs(
+            queries[qi], vecs, norms, qn_full[qi], "l2sq"
+        ).astype(np.float32)
+        bitwise = bitwise and np.array_equal(exact, s_q[r][m])
+    results["quant_rescore_bitwise"] = bool(bitwise)
+
+    # -- measured capacity multiple at the same budget -------------------------
+    def slot_bytes(store: TieredIvfKnnStore) -> float:
+        blocks = list(store.tiers.pages.values())
+        return sum(b.nbytes for b in blocks) / max(
+            sum(b.vecs.shape[0] for b in blocks), 1
+        )
+
+    multiple = slot_bytes(f32) / max(slot_bytes(q8), 1e-12)
+    results["quant_capacity_multiple"] = round(multiple, 2)
+    results["quant_capacity_honest"] = bool(multiple >= 3.5)
+
+    # -- solo-retrieve p50 (CPU fallback: host BLAS both sides) ----------------
+    # per-query interleave + min-of-medians: the two stores alternate on every
+    # single query (order flipped each rep) so host drift, frequency scaling,
+    # and cache-warmth hit both code paths identically instead of whichever
+    # store happened to run second
+    f32.search_batch(queries[:1], k)  # warm both jit/BLAS paths
+    q8.search_batch(queries[:1], k)
+    rounds_f, rounds_q = [], []
+    for rep in range(3):
+        lat_f, lat_q = [], []
+        for r in range(64):
+            pair = ((f32, lat_f), (q8, lat_q))
+            if (rep + r) % 2:
+                pair = pair[::-1]
+            for store, lat in pair:
+                t1 = time.perf_counter()
+                store.search_batch(queries[r : r + 1], k)
+                lat.append(time.perf_counter() - t1)
+        rounds_f.append(float(np.median(lat_f)))
+        rounds_q.append(float(np.median(lat_q)))
+    p50_f = min(rounds_f)
+    p50_q = min(rounds_q)
+    results["quant_solo_p50_ms"] = round(p50_q * 1000.0, 3)
+    results["quant_solo_p50_fp32_ms"] = round(p50_f * 1000.0, 3)
+    # 10% tolerance absorbs host timer noise at sub-ms latencies
+    results["quant_solo_p50_no_worse"] = bool(p50_q <= p50_f * 1.10)
+
+    # -- residency moves stay bitwise-invariant under int8 ---------------------
+    sub = queries[:64]
+    a_s, a_i, _ = q8.search_batch(sub, k)
+    q8.tiers.budget_bytes = 0  # lift the budget: everything is promotable
+    for cid in range(q8.n_clusters):
+        if q8.tiers.residency(cid) == "spilled":
+            q8.tiers.unspill(cid)
+        q8.tiers.promote(cid)
+    b_s, b_i, _ = q8.search_batch(sub, k)
+    results["quant_bitwise_residency"] = bool(
+        np.array_equal(a_s, b_s) and np.array_equal(a_i, b_i)
+    )
+
+    depth = histograms().get("pathway_ivf_quant_rescore_depth")
+    results["quant_rescore_batches"] = int(depth.count) if depth is not None else 0
+    f32.close()
+    q8.close()
     return results
 
 
@@ -2539,6 +2703,7 @@ def _register_section(
 
 _register_section("knn", lambda: bench_knn(), full=600, small=300, device_bound=True)
 _register_section("ivfscale", lambda: bench_ivf_scale(), full=900, small=900)
+_register_section("quant", lambda: bench_quant(), full=600, small=300)
 _register_section("embedder", lambda: bench_embedder(), full=420, small=240, device_bound=True)
 _register_section("embedpipe", lambda: bench_embedpipe(), full=600, small=420, device_bound=True)
 _register_section("encsvc", lambda: bench_encsvc(), full=600, small=420, device_bound=True)

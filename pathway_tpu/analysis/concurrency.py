@@ -71,6 +71,7 @@ RUNTIME_MODULES: Tuple[str, ...] = (
     "pathway_tpu/models/embed_pipeline.py",
     "pathway_tpu/models/encoder_service.py",
     "pathway_tpu/ops/knn_tiers.py",
+    "pathway_tpu/ops/knn_quant.py",
     "pathway_tpu/engine/http_server.py",
     "pathway_tpu/engine/telemetry.py",
     "pathway_tpu/internals/sched.py",

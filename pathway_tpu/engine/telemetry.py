@@ -85,6 +85,7 @@ STAGE_NAMESPACES: "tuple[str, ...]" = (
     "exchange.",    # per-peer traffic + barrier waits/stragglers
     "fuse.",        # whole-commit fusion planner/jit
     "index.",       # tiered IVF index: tier hits, prefetch, rebuild/swap
+    "index.quant.", # int8 retrieval: rescore batches, recalibrations, audits
     "lint.",        # graph/runtime lint diagnostics
     "modelcheck.",  # deterministic schedule exploration
     "persist.",     # checkpoints, journal compaction
@@ -100,6 +101,7 @@ FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
     "brownout",
     "chaos_checkpoint_kill",
     "chaos_kill",
+    "chaos_quant_kill",
     "chaos_rebuild_kill",
     "checkpoint",
     "checkpoint_deferred",
@@ -116,6 +118,7 @@ FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
     "membership_left",
     "modelcheck",
     "peer_stale",
+    "quant_swap",
     "rejoin",
     "rejoin_installed",
 })

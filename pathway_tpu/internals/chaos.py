@@ -331,7 +331,11 @@ class Chaos:
           recovery; journal replay rebuilds the index bit-identically);
         - ``tier_swap_torn`` — abort the generation swap at the commit
           boundary (the pending generation is dropped, the OLD generation
-          keeps serving, and the next maintenance pass retries).
+          keeps serving, and the next maintenance pass retries);
+        - ``quant``          — abort a quantization-scale recalibration
+          before the sidecar install (the OLD per-page scales keep serving;
+          fp32 rows are untouched, so the exact rescore epilogue is
+          unaffected and the next maintenance pass recalibrates).
 
         ``at`` defaults to every attempt; ``run`` defaults to every
         incarnation (the cross-restart key, same contract as ``scale``

@@ -1138,6 +1138,155 @@ def tiered_index_model(
 
 
 # ---------------------------------------------------------------------------
+# quantized retrieval: scale recalibration install vs concurrent scoring
+# ---------------------------------------------------------------------------
+
+
+def quant_recalibration_model(
+    *,
+    n_pages: int = 3,
+    n_reads: int = 4,
+    abort: bool = False,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The quantization-sidecar recalibration protocol
+    (``ops/knn_tiers.py::_recalibrate_quant``), modeled before the chaos
+    acceptance was wired: reader threads score pages by reading the
+    (scale, codes, cached-f32-cast) triple per page under one lock hold —
+    the commit-boundary atomicity a quantized score depends on, because a
+    new scale applied to old codes (or a stale cached cast of old codes)
+    silently mis-scores every row on the page. The recalibrator requantizes
+    every page off to the side (off-lock), then either ABORTS before the
+    install (the ``quant`` chaos op: nothing published, old sidecars keep
+    serving) or installs scales + codes + cast-invalidation in ONE lock
+    acquisition.
+
+    Invariants over every interleaving: no torn sidecar read (a reader
+    never mixes new scales with old codes or vice versa); the cached cast
+    always matches the codes it was cast from; an aborted recalibration
+    publishes NOTHING (serving state is bitwise the old generation); a
+    completed one installs exactly once, completely; no deadlock.
+
+    Planted bugs (each must be CAUGHT with a replayable schedule):
+    ``"torn_install"`` — scales and codes install in two lock acquisitions,
+    so a reader between them scores old codes at new scales;
+    ``"stale_cast"`` — the install forgets to invalidate the cached f32
+    cast of the codes (the real ``_qf32`` hazard), so readers score the OLD
+    cast at the new scale;
+    ``"install_after_abort"`` — the chaos-abort path publishes the new
+    scales anyway (recovery must serve the old generation bit-exactly)."""
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("index")
+        cv = sched.condition(lock, name="index.quant.cv")
+        state: Dict[str, Any] = {
+            # per-page sidecar versions; a consistent page has all three equal
+            "scales_ver": [0] * n_pages,
+            "codes_ver": [0] * n_pages,
+            "cast_ver": [0] * n_pages,
+            "installs": 0,
+            "aborts": 0,
+            "reads": [],  # (page, scales_ver, codes_ver, cast_ver)
+            "readers_done": 0,
+        }
+
+        def reader_body(idx: int) -> None:
+            for r in range(n_reads):
+                page = (idx + r) % n_pages
+                with cv:
+                    state["reads"].append(
+                        (
+                            page,
+                            state["scales_ver"][page],
+                            state["codes_ver"][page],
+                            state["cast_ver"][page],
+                        )
+                    )
+                sched.yield_point(f"reader{idx}")
+            with cv:
+                state["readers_done"] += 1
+                cv.notify_all()
+
+        def recalibrator_body() -> None:
+            for _page in range(n_pages):
+                sched.yield_point("requantize")  # off-lock scale+code rebuild
+            if abort:
+                # the chaos `quant` op fires before the install: the new
+                # sidecars are dropped on the floor, old scales keep serving
+                with cv:
+                    state["aborts"] += 1
+                    if bug == "install_after_abort":
+                        # planted: the abort path publishes anyway
+                        for page in range(n_pages):
+                            state["scales_ver"][page] = 1
+                    cv.notify_all()
+                return
+            sched.yield_point("pre-install")
+            if bug == "torn_install":
+                # two lock acquisitions: a reader between them scores old
+                # codes at new scales
+                with cv:
+                    for page in range(n_pages):
+                        state["scales_ver"][page] = 1
+                sched.yield_point("install-gap")
+                with cv:
+                    for page in range(n_pages):
+                        state["codes_ver"][page] = 1
+                        state["cast_ver"][page] = 1
+                    state["installs"] += 1
+                    cv.notify_all()
+            else:
+                with cv:
+                    for page in range(n_pages):
+                        state["scales_ver"][page] = 1
+                        state["codes_ver"][page] = 1
+                        if bug != "stale_cast":
+                            state["cast_ver"][page] = 1
+                    state["installs"] += 1
+                    cv.notify_all()
+
+        for idx in range(2):
+            sched.spawn(reader_body, idx, name=f"reader{idx}")
+        sched.spawn(recalibrator_body, name="recalibrate")
+
+        def check() -> None:
+            for page, sv, codv, castv in state["reads"]:
+                assert sv == codv, (
+                    f"torn sidecar read on page {page}: generation-{sv} "
+                    f"scales applied to generation-{codv} codes"
+                )
+                assert castv == codv, (
+                    f"stale cached cast on page {page}: generation-{codv} "
+                    f"codes scored through a generation-{castv} f32 cast"
+                )
+            # the cast invariant also holds at quiescence: a stale cache is
+            # a latent mis-score even if no read raced the install
+            for page in range(n_pages):
+                assert state["cast_ver"][page] == state["codes_ver"][page], (
+                    f"stale cached cast on page {page}: generation-"
+                    f"{state['codes_ver'][page]} codes left behind a "
+                    f"generation-{state['cast_ver'][page]} f32 cast"
+                )
+            if abort:
+                assert state["installs"] == 0 and state["aborts"] == 1
+                assert all(v == 0 for v in state["scales_ver"]), (
+                    "aborted recalibration published new scales — recovery "
+                    "must serve the old sidecars bit-exactly"
+                )
+            else:
+                assert state["installs"] == 1, (
+                    f"recalibration installed {state['installs']} times "
+                    "(expected exactly once)"
+                )
+                assert all(v == 1 for v in state["scales_ver"])
+                assert all(v == 1 for v in state["codes_ver"])
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
 # closed-loop autoscaler: sample -> decide -> directive -> transition outcome
 # ---------------------------------------------------------------------------
 

@@ -501,7 +501,14 @@ class EmbedPipeline:
 
         self.encoder = encoder
         self.sub_batch = int(sub_batch)
-        self.cache = EmbedCache(cache_size, model=model)
+        # the encoder's quantized-tower mode joins the content-hash salt AND
+        # the semantic keys: embeddings cached under one geometry can never
+        # answer a query encoded under the other (a mode flip misses, it
+        # does not serve stale lattice points)
+        quant_tag = getattr(encoder, "quant_tag", "") or ""
+        self.cache = EmbedCache(
+            cache_size, model=f"{model}|{quant_tag}" if quant_tag else model
+        )
         self._pad_padded = 0.0
         self._pad_real = 0.0
         if max_queue_rows is None:
@@ -545,6 +552,7 @@ class EmbedPipeline:
             mode=semantic_mode,
             threshold=semantic_threshold,
             canonicalize=getattr(encoder, "canonicalize", None) or default_canonicalize,
+            key_tag=quant_tag,
         )
         self.coalescer = QueryCoalescer(
             self._encode_device_rows,

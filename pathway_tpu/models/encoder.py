@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -24,6 +25,23 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pathway_tpu.internals.shapes import next_pow2
+
+
+def quant_encode_enabled() -> bool:
+    """``PATHWAY_IVF_QUANT_ENCODE``: quantized query-tower encode mode.
+    ``auto`` (default) follows ``PATHWAY_IVF_QUANT`` — the encoder rounds its
+    embeddings onto the per-row symmetric int8 lattice exactly when the index
+    scores in int8, so query vectors arrive pre-scaled for the int8 scorer
+    and its re-quantization is code-stable (zero additional rounding).
+    ``on``/``off`` force the mode independently of the index."""
+    mode = os.environ.get("PATHWAY_IVF_QUANT_ENCODE", "auto").strip().lower()
+    if mode in ("on", "1", "true", "yes", "int8"):
+        return True
+    if mode in ("off", "0", "false", "no"):
+        return False
+    from pathway_tpu.ops.knn_quant import quant_mode
+
+    return quant_mode() == "int8"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -289,11 +307,31 @@ class JaxSentenceEncoder:
         # embeddings ship in transfer_dtype — on a tunneled TPU the host<->device
         # bytes, not the FLOPs, bound throughput
         out_dtype = self.transfer_dtype
-        self._encode_ids = jax.jit(
-            lambda params, ids: self.model.apply(
-                params, ids, (ids != 0).astype(jnp.int32)
-            ).astype(out_dtype)
-        )
+        # quantized query tower (PATHWAY_IVF_QUANT_ENCODE): fold a per-row
+        # symmetric int8 lattice round into the jitted forward — s = max|v|/127,
+        # v -> round(v/s)*s in f32 BEFORE the wire cast. The row max is itself
+        # a lattice point, so the int8 scorer's re-quantization reproduces the
+        # codes exactly (|k| <= 127 keeps even the f16 wire perturbation under
+        # half a code step); geometry served from cache must key on this mode
+        self.quant_encode = quant_encode_enabled()
+        self.quant_tag = "quant:int8" if self.quant_encode else ""
+        if self.quant_encode:
+            def _fwd(params: Any, ids: jax.Array) -> jax.Array:
+                out = self.model.apply(
+                    params, ids, (ids != 0).astype(jnp.int32)
+                ).astype(jnp.float32)
+                s = jnp.maximum(
+                    jnp.max(jnp.abs(out), axis=1, keepdims=True), 1e-30
+                ) / 127.0
+                return (jnp.round(out / s) * s).astype(out_dtype)
+
+            self._encode_ids = jax.jit(_fwd)
+        else:
+            self._encode_ids = jax.jit(
+                lambda params, ids: self.model.apply(
+                    params, ids, (ids != 0).astype(jnp.int32)
+                ).astype(out_dtype)
+            )
 
     def _hf_tokenize(self, tok: Any, texts: list[str]) -> Tuple[np.ndarray, np.ndarray]:
         out = tok(

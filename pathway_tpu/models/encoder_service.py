@@ -133,13 +133,21 @@ class SemanticQueryCache:
         mode: str = "exact",
         threshold: float = 0.95,
         canonicalize: Callable[[str], str] | None = None,
+        key_tag: str = "",
     ):
         if mode not in ("exact", "cosine", "off"):
             raise ValueError(f"semantic cache mode must be exact|cosine|off, got {mode!r}")
         self.mode = mode
         self.max_entries = int(max_entries) if mode != "off" else 0
         self.threshold = float(threshold)
-        self._canon = canonicalize or default_canonicalize
+        base_canon = canonicalize or default_canonicalize
+        if key_tag:
+            # geometry-mode tag (e.g. the encoder's quantized-tower mode)
+            # folded into every key: a mode flip can never serve embeddings
+            # encoded under the other geometry — stale entries simply miss
+            self._canon = lambda text: f"{key_tag}\x00{base_canon(text)}"
+        else:
+            self._canon = base_canon
         self._lock = threading.Lock()
         self._data: "OrderedDict[str, np.ndarray]" = OrderedDict()
         self._proxies: "OrderedDict[str, np.ndarray]" = OrderedDict()

@@ -79,7 +79,7 @@ def kernel_cache_sizes() -> Dict[str, int]:
         except Exception:
             return -1
 
-    from pathway_tpu.ops import knn_tiers
+    from pathway_tpu.ops import knn_quant, knn_tiers
 
     return {
         "dense_search": sz(_search_kernel),
@@ -90,6 +90,11 @@ def kernel_cache_sizes() -> Dict[str, int]:
         # shape per cluster was an 18x ingest regression)
         "tiered_assign": sz(knn_ivf._assign2_kernel),
         "tiered_score": sz(knn_tiers._score_block_kernel),
+        # quantized tower: int8 coarse probe and block scorer (pow2-padded
+        # centroid counts / block capacities / query buckets, same O(log)
+        # cache discipline)
+        "quant_probe": sz(knn_quant.quant_probe_kernel),
+        "quant_score": sz(knn_quant.quant_score_block_kernel),
     }
 
 
@@ -409,15 +414,38 @@ class BruteForceKnnIndex:
         if export is None:
             return None
         keys, vecs = export()
-        return {
+        desc: Dict[str, Any] = {
             "keys": keys,
             "vectors": vecs,
             "filter_data": dict(self.filter_data),
         }
+        quant_state = getattr(self.store, "quant_state", None)
+        if quant_state is not None:
+            # quantized state joins the membership/checkpoint protocols:
+            # mode + dtype + per-page sidecars ride the descriptor so the
+            # receiving side can verify it serves the SAME tower geometry
+            desc["quant"] = quant_state()
+        return desc
 
     def install_rebuild_descriptor(self, desc: Dict[str, Any]) -> None:
         """Rebuild this (fresh) index from a :meth:`rebuild_descriptor`
-        export: one bulk ingest, filter data restored alongside."""
+        export: one bulk ingest, filter data restored alongside. A
+        descriptor whose quantization mode differs from this store's is a
+        typed refusal (``QuantConfigError``) — replicating fp32 geometry
+        into an int8 replica (or vice versa) must fail loudly, never serve
+        silently mismatched scores."""
+        quant = desc.get("quant")
+        if quant is not None:
+            from pathway_tpu.ops.knn_quant import QuantConfigError
+
+            want = str(quant.get("mode", "off"))
+            have = str(getattr(self.store, "quant", "off"))
+            if want != have:
+                raise QuantConfigError(
+                    f"rebuild descriptor carries quant mode {want!r} but this "
+                    f"store runs {have!r}: replication across quantization "
+                    "modes is refused (set PATHWAY_IVF_QUANT consistently)"
+                )
         keys = list(desc.get("keys", []))
         if keys:
             vectors = np.asarray(desc["vectors"], dtype=np.float32)

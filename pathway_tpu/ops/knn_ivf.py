@@ -84,6 +84,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from pathway_tpu.ops.knn import DenseKNNStore, next_pow2, pad_queries_pow2, topk_rows
+from pathway_tpu.ops.knn_quant import host_metric_scores
 
 _KMEANS_CHUNK = 4096
 
@@ -614,13 +615,7 @@ class IvfKnnStore(DenseKNNStore):
                     continue
                 sel = slice(bounds[g], bounds[g + 1])
                 qs, ds = fq[sel], fs[sel]
-                sub = q[qs] @ data[mem].T  # (group_q, mc) — BLAS GEMM
-                if self.metric == "l2sq":
-                    sub = 2.0 * sub - norms[mem][None, :] - qn[qs][:, None]
-                elif self.metric == "cos":
-                    sub = sub / np.maximum(
-                        np.sqrt(qn[qs])[:, None] * np.sqrt(norms[mem])[None, :], 1e-30
-                    )
+                sub = host_metric_scores(q[qs], data[mem], norms[mem], qn[qs], self.metric)
                 cols = ds[:, None] + np.arange(mc)[None, :]
                 buf_s[qs[:, None], cols] = sub
                 buf_i[qs[:, None], cols] = mem

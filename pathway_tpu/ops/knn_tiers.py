@@ -63,6 +63,7 @@ import pickle
 import queue
 import threading
 import time
+from itertools import repeat as _repeat
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -72,8 +73,10 @@ import jax.numpy as jnp
 
 from pathway_tpu.engine import telemetry
 from pathway_tpu.internals.shapes import next_pow2
+from pathway_tpu.ops import knn_quant
 from pathway_tpu.ops.knn import topk_rows
 from pathway_tpu.ops.knn_ivf import _KMEANS_CHUNK, _assign2_kernel, _kmeans_kernel
+from pathway_tpu.ops.knn_quant import quant_mode, rescore_k
 
 PAGE = 128  # residency granularity mirrors the packed-page layout of knn_ivf
 
@@ -98,14 +101,21 @@ def _env(name: str, default: str) -> str:
 
 def tiering_enabled() -> bool:
     """``PATHWAY_IVF_TIERED``: ``on`` / ``off`` / ``auto`` (default — tiered
-    exactly when an HBM budget is configured, so existing deployments keep
-    the untiered store bit-for-bit)."""
+    exactly when an HBM budget is configured OR a quantization mode is opted
+    in, so existing deployments keep the untiered store bit-for-bit while
+    ``PATHWAY_IVF_QUANT=int8`` alone engages the tower that hosts it; the
+    alternative — silently serving fp32 under an int8 opt-in — would violate
+    the loud-refusal contract)."""
     mode = _env("PATHWAY_IVF_TIERED", "auto").lower()
     if mode in ("on", "1", "true", "yes"):
         return True
     if mode in ("off", "0", "false", "no"):
         return False
-    return hbm_budget_bytes() > 0
+    if hbm_budget_bytes() > 0:
+        return True
+    from pathway_tpu.ops.knn_quant import quant_mode
+
+    return quant_mode() != "off"
 
 
 def hbm_budget_bytes() -> int:
@@ -210,11 +220,21 @@ class _ClusterPages:
     remove + append), so a background-rebuild snapshot that records
     ``(vecs, n, valid.copy())`` reads a consistent corpus without copying the
     vectors. ``valid`` flips in place on removal — the one mutable field, and
-    the one the snapshot copies."""
+    the one the snapshot copies.
 
-    __slots__ = ("slots", "vecs", "norms", "valid", "n", "n_live", "mutations")
+    With ``quant=True`` the block also carries the derived int8 mirror:
+    ``qvecs`` codes plus per-page ``qscale``/``qzero`` sidecars
+    (``knn_quant``). The fp32 rows stay the source of truth — codes
+    re-derive on append for exactly the touched pages, and recalibration
+    swaps whole sidecar arrays atomically."""
 
-    def __init__(self, dim: int, cap: int = PAGE):
+    __slots__ = (
+        "slots", "vecs", "norms", "valid", "n", "n_live", "mutations",
+        "quant", "qvecs", "qscale", "qzero", "_qf32", "_qsrow", "_maskadd",
+        "_negn",
+    )
+
+    def __init__(self, dim: int, cap: int = PAGE, *, quant: bool = False):
         cap = next_pow2(max(PAGE, cap))
         self.slots = np.full(cap, -1, dtype=np.int64)
         self.vecs = np.zeros((cap, dim), dtype=np.float32)
@@ -226,10 +246,93 @@ class _ClusterPages:
         # only installable when the count it captured still matches (object
         # identity alone misses IN-PLACE churn during the stage)
         self.mutations = 0
+        self.quant = bool(quant)
+        if self.quant:
+            n_pages = max(1, cap // PAGE)
+            self.qvecs: "np.ndarray | None" = np.zeros((cap, dim), dtype=np.int8)
+            self.qscale: "np.ndarray | None" = np.ones(n_pages, dtype=np.float32)
+            self.qzero: "np.ndarray | None" = np.zeros(n_pages, dtype=np.float32)
+        else:
+            self.qvecs = None
+            self.qscale = None
+            self.qzero = None
+        self._qf32: "np.ndarray | None" = None
+        self._qsrow: "np.ndarray | None" = None
+        self._maskadd: "Tuple[int, np.ndarray] | None" = None
+        self._negn: "Tuple[int, np.ndarray] | None" = None
 
     @property
     def nbytes(self) -> int:
+        if self.quant:
+            # quant mode prices the QUANTIZED mirror payload (codes + sidecars
+            # + exact norms): the hot budget buys ~(1 + 4/dim)x fewer bytes
+            # per row than fp32, which IS the capacity multiple the bench
+            # measures — the fp32 source rows live in host RAM regardless
+            return int(
+                self.qvecs.nbytes + self.qscale.nbytes + self.qzero.nbytes
+                + self.norms.nbytes + self.slots.nbytes
+            )
         return int(self.vecs.nbytes + self.norms.nbytes + self.slots.nbytes)
+
+    def qvecs_f32(self) -> np.ndarray:
+        """Cached f32 cast of the int8 codes for host BLAS scoring (numpy
+        integer matmul bypasses BLAS entirely; the cast keeps the exact
+        integer dots on the fast path). Host-only scratch — excluded from
+        ``nbytes`` on purpose: the budget prices the device-mirror payload."""
+        if self._qf32 is None:
+            self._qf32 = self.qvecs.astype(np.float32)
+        return self._qf32
+
+    def qsrow(self, n: int) -> np.ndarray:
+        """Cached per-ROW expansion of the per-page scales (host scoring
+        multiplies it against every query batch; re-running ``np.repeat``
+        per block per batch dominated solo-query latency). Invalidated with
+        the f32 cast — both are derived views of the same sidecars."""
+        if self._qsrow is None:
+            self._qsrow = knn_quant.row_scales(self.qscale, len(self.slots))
+        return self._qsrow[:n]
+
+    def maskadd(self, n: int) -> np.ndarray:
+        """Additive validity mask (0.0 live / -inf dead) over rows [0:n] —
+        one vector add masks a score block, replacing a compare + ``np.where``
+        pair per block per batch (the same additive contract the device
+        mirrors carry). Keyed on ``mutations`` so any append/invalidate
+        rebuilds it."""
+        cached = self._maskadd
+        if cached is None or cached[0] != self.mutations or len(cached[1]) != n:
+            arr = np.where(
+                self.valid[:n], np.float32(0.0), np.float32(-np.inf)
+            ).astype(np.float32)
+            self._maskadd = cached = (self.mutations, arr)
+        return cached[1]
+
+    def negn(self, n: int) -> np.ndarray:
+        """Pre-fused ``maskadd - norms`` over rows [0:n] for the l2sq
+        quant epilogue: the norm subtraction and the validity mask collapse
+        into one vector add per block per batch. Bitwise-identical to the
+        unfused order (``0 - x`` is exact negation, adding 0 is a no-op,
+        -inf absorbs every finite add). Keyed on ``mutations`` + length,
+        exactly like :meth:`maskadd`."""
+        cached = self._negn
+        if cached is None or cached[0] != self.mutations or len(cached[1]) != n:
+            arr = (self.maskadd(n) - self.norms[:n]).astype(np.float32)
+            self._negn = cached = (self.mutations, arr)
+        return cached[1]
+
+    def _drop_quant_caches(self) -> None:
+        self._qf32 = None
+        self._qsrow = None
+
+    def _requantize_pages(self, pages: "range | np.ndarray") -> None:
+        """Re-derive codes + scale for exactly the named pages (append touched
+        them); untouched pages keep their existing codes bit-for-bit."""
+        cap = len(self.slots)
+        for p in pages:
+            lo, hi = p * PAGE, min((p + 1) * PAGE, cap)
+            s = knn_quant.page_scale(self.vecs[lo:hi])
+            self.qscale[p] = np.float32(s)
+            self.qvecs[lo:hi] = knn_quant.quantize_rows(self.vecs[lo:hi], s)
+        self._drop_quant_caches()
 
     def append(self, slots: np.ndarray, vecs: np.ndarray, norms: np.ndarray) -> int:
         """Append rows; returns the first position. Grows pow2 (the old
@@ -248,6 +351,17 @@ class _ClusterPages:
             new_valid[: self.n] = self.valid[: self.n]
             self.slots, self.vecs = new_slots, new_vecs
             self.norms, self.valid = new_norms, new_valid
+            if self.quant:
+                n_pages = max(1, cap // PAGE)
+                new_qvecs = np.zeros((cap, dim), dtype=np.int8)
+                new_qscale = np.ones(n_pages, dtype=np.float32)
+                new_qzero = np.zeros(n_pages, dtype=np.float32)
+                new_qvecs[: self.n] = self.qvecs[: self.n]
+                old_pages = len(self.qscale)
+                new_qscale[:old_pages] = self.qscale
+                new_qzero[:old_pages] = self.qzero
+                self.qvecs, self.qscale, self.qzero = new_qvecs, new_qscale, new_qzero
+                self._drop_quant_caches()
         first = self.n
         self.slots[first:need] = slots
         self.vecs[first:need] = vecs
@@ -256,6 +370,8 @@ class _ClusterPages:
         self.n = need
         self.n_live += len(slots)
         self.mutations += 1
+        if self.quant:
+            self._requantize_pages(range(first // PAGE, (need - 1) // PAGE + 1))
         return first
 
     def invalidate(self, pos: int) -> None:
@@ -270,18 +386,35 @@ class _ClusterPages:
 
     def to_blob(self) -> bytes:
         slots, vecs, norms = self.live_rows()
-        return pickle.dumps(
-            {"slots": slots, "vecs": vecs, "norms": norms},
-            protocol=pickle.HIGHEST_PROTOCOL,
-        )
+        payload = {"slots": slots, "vecs": vecs, "norms": norms}
+        if self.quant:
+            # spill only freezes COMPACT blocks (n == n_live), so the live
+            # rows ARE rows [0:n] in page order and the codes + sidecars
+            # serialize verbatim: the round-trip is bit-exact by copy, never
+            # by re-derivation (a recalibrated scale survives the freeze)
+            payload["qvecs"] = self.qvecs[: self.n]
+            payload["qscale"] = self.qscale
+            payload["qzero"] = self.qzero
+        return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
 
     @classmethod
-    def from_blob(cls, dim: int, blob: bytes) -> "_ClusterPages":
+    def from_blob(cls, dim: int, blob: bytes, *, quant: bool = False) -> "_ClusterPages":
         raw = pickle.loads(blob)
         n = len(raw["slots"])
-        block = cls(dim, cap=max(PAGE, n))
+        block = cls(dim, cap=max(PAGE, n), quant=quant)
         if n:
             block.append(raw["slots"], raw["vecs"], raw["norms"])
+        if quant and "qvecs" in raw:
+            # restore the serialized codes/sidecars bit-for-bit over the
+            # append-time re-derivation (identical unless a recalibration
+            # tightened the scales pre-freeze — then the blob wins); a blob
+            # written before quant was enabled simply keeps the re-derived
+            # codes, so a mode flip thaws cleanly
+            block.qvecs[:n] = raw["qvecs"]
+            pages = min(len(raw["qscale"]), len(block.qscale))
+            block.qscale[:pages] = raw["qscale"][:pages]
+            block.qzero[:pages] = raw["qzero"][:pages]
+            block._drop_quant_caches()
         return block
 
 
@@ -306,11 +439,13 @@ class TierManager:
         device: Any = None,
         spill_store: Any = None,
         spill_prefix: str = "ivf-spill",
+        quant: str = "off",
     ):
         self.dim = dim
         self.generation = generation
         self.budget_bytes = budget_bytes
         self.device = device
+        self.quant = quant
         self._cv = threading.Condition()
         self.pages: Dict[int, Optional[_ClusterPages]] = {}
         self.hot: Dict[int, Any] = {}  # cid -> device mirror (True on CPU)
@@ -375,14 +510,22 @@ class TierManager:
     def _device_mirror(self, block: _ClusterPages) -> Any:
         if jax.default_backend() == "cpu":
             return True  # zero-copy host==device; residency is bookkeeping
-        vecs = jnp.asarray(block.vecs)
-        norms = jnp.asarray(block.norms)
         mask = jnp.where(jnp.asarray(block.valid), 0.0, -jnp.inf).astype(jnp.float32)
+        if block.quant:
+            # the int8 mirror: codes + page-broadcast row scales + exact
+            # norms — exactly the payload ``nbytes`` prices against the hot
+            # budget (a 4-tuple; the fp32 mirror is a 3-tuple)
+            arrs: Tuple[Any, ...] = (
+                jnp.asarray(block.qvecs),
+                jnp.asarray(knn_quant.row_scales(block.qscale, len(block.slots))),
+                jnp.asarray(block.norms),
+                mask,
+            )
+        else:
+            arrs = (jnp.asarray(block.vecs), jnp.asarray(block.norms), mask)
         if self.device is not None:
-            vecs = jax.device_put(vecs, self.device)
-            norms = jax.device_put(norms, self.device)
-            mask = jax.device_put(mask, self.device)
-        return (vecs, norms, mask)
+            arrs = tuple(jax.device_put(a, self.device) for a in arrs)
+        return arrs
 
     def promote(self, cid: int) -> bool:
         """Stage ``cid`` hot (called by the prefetcher, or inline). Returns
@@ -497,7 +640,7 @@ class TierManager:
                 f"spill tier lost cluster {cid} (key {key!r}): the frozen "
                 "object store no longer serves it"
             )
-        loaded = _ClusterPages.from_blob(self.dim, blob)
+        loaded = _ClusterPages.from_blob(self.dim, blob, quant=self.quant == "int8")
         with self._cv:
             if self.pages.get(cid) is None and self.spilled.get(cid) == key:
                 self.pages[cid] = loaded
@@ -720,11 +863,22 @@ class TieredIvfKnnStore:
         hbm_budget_bytes: "int | None" = None,
         spill_store: Any = None,
         prefetch: "bool | None" = None,
+        quant: "str | None" = None,
     ):
         assert metric in ("l2sq", "cos", "ip")
         self.dim = dim
         self.metric = metric
         self.device = device
+        # quantized tower mode ("off" | "int8"); None reads PATHWAY_IVF_QUANT.
+        # Resolved ONCE at construction — a mid-life env flip must go through
+        # a rebuild (descriptor install refuses mode mismatches loudly).
+        self._quant = quant_mode(quant)
+        self._qblocks = self._quant == "int8"
+        # lazily-built int8 coarse-probe mirror of the centroids, padded to
+        # pow2 with |c|^2 = +inf rows; invalidated at EVERY site that moves
+        # self._cents (train/split/maintain/swap) because maintenance
+        # recenters rows IN PLACE — identity checks would miss it
+        self._qcents: "Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]" = None
         self.n_clusters = max(2, n_clusters)
         self.n_probe = min(n_probe, self.n_clusters)
         self._n_clusters_base = self.n_clusters
@@ -743,7 +897,10 @@ class TieredIvfKnnStore:
         # current generation
         self.generation = 0
         self._cents: Optional[np.ndarray] = None  # (C, dim) f32, host
-        self._where: Dict[int, tuple] = {}  # slot -> (cid, pos)
+        # slot -> (cid << 32) | pos, packed so the rescore epilogue can map a
+        # whole shortlist with one C-level fromiter(map(get, ...)) pass
+        # instead of a python loop over (cid, pos) tuples
+        self._where: Dict[int, int] = {}
         self._trained_sizes = np.zeros(0, dtype=np.int64)
         self._drift = np.zeros(0, dtype=np.int64)
         self._ewma = np.zeros(0, dtype=np.float64)
@@ -759,7 +916,7 @@ class TieredIvfKnnStore:
                 spill_store = DirSpillStore(spill_dir)
         self.tiers = TierManager(
             dim, 0, budget_bytes=self._budget_bytes, device=device,
-            spill_store=spill_store,
+            spill_store=spill_store, quant=self._quant,
         )
         self._prefetch_on = _prefetch_enabled() if prefetch is None else bool(prefetch)
         self._prefetcher = Prefetcher()
@@ -770,6 +927,8 @@ class TieredIvfKnnStore:
         # discipline)
         self._device_checked = False
         self._device_ok = True
+        self._qprobe_checked = False
+        self._rescore_hist = None  # cached handle; histogram() locks a registry
         # background rebuild state (shared with the rebuild worker)
         self._mu = threading.Lock()
         self._pending: Optional[_RebuildResult] = None
@@ -780,7 +939,8 @@ class TieredIvfKnnStore:
             "rebuilds": 0, "swaps": 0, "swaps_torn": 0, "splits": 0,
             "merges": 0, "compactions": 0, "spills": 0, "max_pause_s": 0.0,
             "prefetch_stall_s": 0.0, "probe_hot": 0, "probe_cold": 0,
-            "probe_spilled": 0,
+            "probe_spilled": 0, "quant_recalibrations": 0,
+            "quant_chaos_aborts": 0,
         }
 
     # -- ingest ---------------------------------------------------------------
@@ -859,8 +1019,9 @@ class TieredIvfKnnStore:
             cid = int(cid)
             block = self._block(cid, create=True)
             first = block.append(slots[sel], vecs[sel], norms[sel])
+            base = cid << 32
             for j, row in enumerate(sel):
-                self._where[int(slots[row])] = (cid, first + j)
+                self._where[int(slots[row])] = base | (first + j)
             self.tiers.install(cid, block)
             if cid < len(self._drift):
                 self._drift[cid] += len(sel)
@@ -877,7 +1038,7 @@ class TieredIvfKnnStore:
                 del self._untrained_slots[i]
                 del self._untrained_vecs[i]
             return
-        cid, pos = loc
+        cid, pos = loc >> 32, loc & 0xFFFFFFFF
         block = self._block(cid, create=False)
         if block is not None:
             block.invalidate(pos)
@@ -905,7 +1066,7 @@ class TieredIvfKnnStore:
             with self.tiers._cv:
                 block = self.tiers.pages.get(cid)
                 if block is None:
-                    block = _ClusterPages(self.dim)
+                    block = _ClusterPages(self.dim, quant=self._qblocks)
                     self.tiers.pages[cid] = block
                     self.tiers._cv.notify_all()
         return block
@@ -923,6 +1084,7 @@ class TieredIvfKnnStore:
         cap = self.n_clusters * _TRAIN_SAMPLE_PER_CLUSTER
         sample = vecs if len(vecs) <= cap else vecs[rng.choice(len(vecs), cap, replace=False)]
         self._cents = _train_centroids(sample, self.n_clusters, self.train_iters)
+        self._qcents = None
         self._grow_cluster_arrays(self.n_clusters)
         self._place_rows(slots, vecs)
         # splits bound the bucket width the probes pay for
@@ -980,18 +1142,19 @@ class TieredIvfKnnStore:
         new_cid = self.n_clusters
         self.n_clusters += 1
         self._grow_cluster_arrays(self.n_clusters)
-        keep_block = _ClusterPages(self.dim, cap=int((~g1).sum()))
+        keep_block = _ClusterPages(self.dim, cap=int((~g1).sum()), quant=self._qblocks)
         keep_block.append(slots[~g1], vecs[~g1], norms[~g1])
-        new_block = _ClusterPages(self.dim, cap=int(g1.sum()))
+        new_block = _ClusterPages(self.dim, cap=int(g1.sum()), quant=self._qblocks)
         new_block.append(slots[g1], vecs[g1], norms[g1])
         for j, s in enumerate(slots[~g1]):
-            self._where[int(s)] = (cid, j)
+            self._where[int(s)] = (cid << 32) | j
         for j, s in enumerate(slots[g1]):
-            self._where[int(s)] = (new_cid, j)
+            self._where[int(s)] = (new_cid << 32) | j
         cents = np.asarray(self._cents)
         new_cents = np.concatenate([cents, vecs[g1].mean(axis=0)[None, :]])
         new_cents[cid] = vecs[~g1].mean(axis=0)
         self._cents = new_cents
+        self._qcents = None
         self.tiers.install(cid, keep_block)
         self.tiers.install(new_cid, new_block)
         self._trained_sizes[cid] = keep_block.n_live
@@ -1007,6 +1170,10 @@ class TieredIvfKnnStore:
         block = self._block(cid, create=False)
         if block is None:
             return
+        # every branch below may move self._cents rows IN PLACE (recenter,
+        # dead-centroid, merge) — the int8 probe mirror cannot tell, so it
+        # drops up front
+        self._qcents = None
         if block.n_live < block.n // 2 and block.n >= PAGE:
             self._compact_cluster(cid, block)
             block = self._block(cid, create=False)
@@ -1042,6 +1209,59 @@ class TieredIvfKnnStore:
             self._split_cluster(cid)
         self._drift[cid] = 0
         self._trained_sizes[cid] = self._live_count(cid)
+        if self._qblocks:
+            block = self._block(cid, create=False)
+            if block is not None:
+                self._recalibrate_quant(cid, block)
+
+    def _recalibrate_quant(self, cid: int, block: _ClusterPages) -> None:
+        """Per-page scale recalibration on the maintenance path (churn hook):
+        removals can leave a page's scale pinned by rows that are now dead,
+        wasting code resolution on vectors the mask hides — recompute each
+        scale over the LIVE rows only and re-derive the codes.
+
+        The replacement codes + sidecars are computed entirely OFF to the
+        side and installed by plain reference swaps; the ``quant`` chaos op
+        fires BEFORE the install, so a kill mid-recalibration always leaves
+        the old scales serving intact (the ladder-recovery contract the
+        chaos test pins). Never stop-the-world: one cluster per call, riding
+        the same bounded maintenance pass as compaction."""
+        if not block.quant or block.n == 0:
+            return
+        from pathway_tpu.internals.chaos import get_chaos
+        from pathway_tpu.internals.config import get_pathway_config
+
+        cap = len(block.slots)
+        n_pages = max(1, cap // PAGE)
+        new_qvecs = np.zeros((cap, self.dim), dtype=np.int8)
+        new_qscale = np.ones(n_pages, dtype=np.float32)
+        new_qzero = np.zeros(n_pages, dtype=np.float32)
+        for p in range(n_pages):
+            lo, hi = p * PAGE, min((p + 1) * PAGE, cap)
+            live = block.valid[lo:hi]
+            rows = block.vecs[lo:hi]
+            s = knn_quant.page_scale(rows[live] if live.any() else rows)
+            new_qscale[p] = np.float32(s)
+            # dead rows quantize at the live scale too (they may clip): the
+            # validity mask hides them, and determinism beats their fidelity
+            new_qvecs[lo:hi] = knn_quant.quantize_rows(rows, s)
+        chaos = get_chaos()
+        if chaos is not None and chaos.index_fault(
+            "quant", get_pathway_config().process_id
+        ):
+            # injected mid-recalibration kill: the freshly computed sidecars
+            # are DISCARDED before anything re-points — old scales serve on
+            self.stats["quant_chaos_aborts"] += 1
+            telemetry.stage_add("index.quant.chaos_aborts")
+            _record_event("chaos_quant_kill", cluster=cid, generation=self.generation)
+            return
+        block.qvecs, block.qscale, block.qzero = new_qvecs, new_qscale, new_qzero
+        block._drop_quant_caches()
+        block.mutations += 1  # a mirror staged off the old codes must not install
+        self.tiers.install(cid, block)  # stale hot mirrors of the old codes drop
+        self.stats["quant_recalibrations"] += 1
+        telemetry.stage_add("index.quant.recalibrations")
+        _record_event("quant_swap", cluster=cid, generation=self.generation)
 
     def _move_rows(
         self,
@@ -1054,8 +1274,8 @@ class TieredIvfKnnStore:
         src = self._block(from_cid, create=False)
         for s in slots:
             loc = self._where.get(int(s))
-            if loc is not None and src is not None and loc[0] == from_cid:
-                src.invalidate(loc[1])
+            if loc is not None and src is not None and (loc >> 32) == from_cid:
+                src.invalidate(loc & 0xFFFFFFFF)
         order = np.argsort(dest, kind="stable")
         uniq, first_idx = np.unique(dest[order], return_index=True)
         bounds = np.append(first_idx, len(order))
@@ -1066,19 +1286,21 @@ class TieredIvfKnnStore:
             sel = order[bounds[g] : bounds[g + 1]]
             target = self._block(cid, create=True)
             first = target.append(slots[sel], vecs[sel], norms[sel])
+            base = cid << 32
             for j, row in enumerate(sel):
-                self._where[int(slots[row])] = (cid, first + j)
+                self._where[int(slots[row])] = base | (first + j)
             self.tiers.install(cid, target)
         if src is not None:
             self.tiers.install(from_cid, src)
 
     def _compact_cluster(self, cid: int, block: _ClusterPages) -> None:
         slots, vecs, norms = block.live_rows()
-        fresh = _ClusterPages(self.dim, cap=max(PAGE, len(slots)))
+        fresh = _ClusterPages(self.dim, cap=max(PAGE, len(slots)), quant=self._qblocks)
         if len(slots):
             fresh.append(slots, vecs, norms)
+        base = cid << 32
         for j, s in enumerate(slots):
-            self._where[int(s)] = (cid, j)
+            self._where[int(s)] = base | j
         self.tiers.install(cid, fresh)
         self.stats["compactions"] += 1
         telemetry.stage_add("index.compactions")
@@ -1216,7 +1438,7 @@ class TieredIvfKnnStore:
                     raise TieredIndexError(
                         f"rebuild snapshot lost frozen cluster blob {entry[1]!r}"
                     )
-                block = _ClusterPages.from_blob(self.dim, blob)
+                block = _ClusterPages.from_blob(self.dim, blob, quant=self._qblocks)
                 resolved.append(
                     (block.vecs, block.norms, block.slots,
                      block.valid[: block.n].copy(), block.n)
@@ -1255,10 +1477,14 @@ class TieredIvfKnnStore:
                 slots_c = np.concatenate([c[0] for c in chunks])
                 vecs_c = np.concatenate([c[1] for c in chunks])
                 norms_c = np.concatenate([c[2] for c in chunks])
-                block = _ClusterPages(self.dim, cap=max(PAGE, len(slots_c)))
+                block = _ClusterPages(
+                    self.dim, cap=max(PAGE, len(slots_c)), quant=self._qblocks
+                )
                 block.append(slots_c, vecs_c, norms_c)
                 pages[cid] = block
-            cents, pages = _rebuild_split_pass(cents, pages, self.dim, self._n_clusters_base)
+            cents, pages = _rebuild_split_pass(
+                cents, pages, self.dim, self._n_clusters_base, quant=self._qblocks
+            )
             where: Dict[int, tuple] = {}
             trained = np.zeros(len(cents), dtype=np.int64)
             for cid, block in pages.items():
@@ -1310,6 +1536,7 @@ class TieredIvfKnnStore:
         new_tiers = TierManager(
             self.dim, pending.generation, budget_bytes=self._budget_bytes,
             device=self.device, spill_store=self.tiers.spill_store,
+            quant=self._quant,
         )
         for cid, block in pending.pages.items():
             new_tiers.pages[cid] = block
@@ -1337,15 +1564,16 @@ class TieredIvfKnnStore:
                 cid = int(top2[i, 0])
                 block = new_tiers.pages.get(cid)
                 if block is None:
-                    block = _ClusterPages(self.dim)
+                    block = _ClusterPages(self.dim, quant=self._qblocks)
                     new_tiers.pages[cid] = block
                 pos = block.append(
                     np.asarray([slot]), vecs[i : i + 1], norms[i : i + 1]
                 )
-                where[slot] = (cid, pos)
+                where[slot] = (cid << 32) | pos
         # the swap: one engine-thread re-point (commit-boundary atomicity)
         old_tiers = self.tiers
         self._cents = cents
+        self._qcents = None
         self._where = where
         self.n_clusters = len(cents)
         self.tiers = new_tiers
@@ -1381,12 +1609,34 @@ class TieredIvfKnnStore:
         loc = self._where.get(slot)
         if loc is None:
             raise TieredIndexError(f"slot {slot} has no located vector")
-        block = self._block(loc[0], create=False)
+        cid = loc >> 32
+        block = self._block(cid, create=False)
         if block is None:
-            raise TieredIndexError(f"cluster {loc[0]} pages unavailable for slot {slot}")
-        return block.vecs[loc[1]]
+            raise TieredIndexError(f"cluster {cid} pages unavailable for slot {slot}")
+        return block.vecs[loc & 0xFFFFFFFF]
 
     # -- search ---------------------------------------------------------------
+
+    def _quant_cents(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The int8 coarse-probe mirror: per-centroid symmetric codes (a
+        centroid is a one-row page), exact fp32 ``|c|^2``, padded to a pow2
+        centroid count with ``cn = +inf`` rows so affinity on pads is -inf
+        and the device kernel's jit cache stays O(log) over (C, q) buckets."""
+        if self._qcents is None:
+            cents = np.asarray(self._cents, dtype=np.float32)
+            c_now = len(cents)
+            c_pad = next_pow2(max(8, c_now))
+            codes = np.zeros((c_pad, self.dim), dtype=np.int8)
+            scales = np.ones(c_pad, dtype=np.float32)
+            cn = np.full(c_pad, np.inf, dtype=np.float32)
+            m = np.max(np.abs(cents), axis=1)
+            scales[:c_now] = np.where(m > 0.0, m / 127.0, 1.0)
+            codes[:c_now] = np.clip(
+                np.rint(cents / scales[:c_now, None]), -127, 127
+            ).astype(np.int8)
+            cn[:c_now] = np.sum(cents * cents, axis=1)
+            self._qcents = (codes, scales, cn)
+        return self._qcents
 
     def _effective_n_probe(self) -> int:
         """Brownout-aware probe count (same contract as the untiered store)."""
@@ -1479,8 +1729,48 @@ class TieredIvfKnnStore:
         shift = get_brownout().nprobe_shift()
         n_probe = max(1, min(self.n_probe >> shift, self.n_clusters))
         cents = self._cents
-        cn = np.sum(cents * cents, axis=1)
-        aff = 2.0 * q @ cents.T - cn[None, :]
+        quant = self._qblocks
+        device_hot = jax.default_backend() != "cpu"
+        q_codes = q_scales = qf_codes = None
+        if quant:
+            # the quantized tower: int8 coarse probe + int8 page scoring
+            # build a shortlist; the exact fp32 rescore epilogue below is
+            # the ONLY thing that computes returned scores
+            q_codes, q_scales = knn_quant.quantize_queries(q)
+            qf_codes = q_codes.astype(np.float32)
+            qc_codes, qc_scales, qc_n = self._quant_cents()
+            aff = None
+            if device_hot and self._device_ok:
+                q_pad = next_pow2(max(8, nq))
+                pq = np.zeros((q_pad, self.dim), dtype=np.int8)
+                pq[:nq] = q_codes
+                ps = np.ones(q_pad, dtype=np.float32)
+                ps[:nq] = q_scales
+                aff = np.asarray(
+                    knn_quant.quant_probe_kernel(
+                        jnp.asarray(qc_codes), jnp.asarray(qc_scales),
+                        jnp.asarray(qc_n), jnp.asarray(pq), jnp.asarray(ps),
+                    )
+                )[:nq, : self.n_clusters]
+                if not self._qprobe_checked:
+                    # first-use parity vs the host twin: the int8 dot is
+                    # exact integers in f32, so any deviation is a backend
+                    # arithmetic lie — downgrade everything to host
+                    self._qprobe_checked = True
+                    host_aff = knn_quant.coarse_affinity(
+                        q_codes, q_scales, qc_codes, qc_scales, qc_n
+                    )[:, : self.n_clusters]
+                    if not np.array_equal(aff, host_aff):
+                        self._device_ok = False
+                        telemetry.stage_add("index.device_parity_rejects")
+                        aff = host_aff
+            if aff is None:
+                aff = knn_quant.coarse_affinity(
+                    q_codes, q_scales, qc_codes, qc_scales, qc_n
+                )[:, : self.n_clusters]
+        else:
+            cn = np.sum(cents * cents, axis=1)
+            aff = 2.0 * q @ cents.T - cn[None, :]
         if n_probe < self.n_clusters:
             probe = np.argpartition(aff, -n_probe, axis=1)[:, -n_probe:]
         else:
@@ -1545,7 +1835,6 @@ class TieredIvfKnnStore:
         fc, fq, fs = flatc[order], flatq[order], flats[order]
         uniq, first = np.unique(fc, return_index=True)
         bounds = np.append(first, len(fc))
-        device_hot = jax.default_backend() != "cpu"
         for g in range(len(uniq)):
             cid = int(uniq[g])
             block = blocks.get(cid)
@@ -1554,44 +1843,103 @@ class TieredIvfKnnStore:
             sel = slice(bounds[g], bounds[g + 1])
             qs, ds = fq[sel], fs[sel]
             n = block.n
+            # a cluster probed by EVERY query (always true for solo
+            # queries) needs no per-block gather: within a run qs ascends,
+            # so len(qs) == nq means qs == arange(nq) and the fancy-index
+            # copies are identity selections
+            if len(qs) == nq:
+                g_q, g_qn = q, qn
+                g_qf, g_qsc = qf_codes, q_scales
+            else:
+                g_q, g_qn = q[qs], qn[qs]
+                g_qf = qf_codes[qs] if quant else None
+                g_qsc = q_scales[qs] if quant else None
             mirror = None
             if device_hot and self._device_ok:
                 with self.tiers._cv:
                     mirror = self.tiers.hot.get(cid)
 
             def host_scores() -> np.ndarray:
-                s = q[qs] @ block.vecs[:n].T
-                if self.metric == "l2sq":
-                    s = 2.0 * s - block.norms[:n][None, :] - qn[qs][:, None]
-                elif self.metric == "cos":
-                    s = s / np.maximum(
-                        np.sqrt(qn[qs])[:, None]
-                        * np.sqrt(block.norms[:n])[None, :],
-                        1e-30,
-                    )
-                return np.where(block.valid[:n][None, :], s, -np.inf)
+                s = knn_quant.host_metric_scores(
+                    g_q, block.vecs[:n], block.norms[:n], g_qn, self.metric
+                )
+                s += block.maskadd(n)[None, :]
+                return s
 
-            if mirror is not None and mirror is not True:
-                sub = np.asarray(
-                    _score_block_kernel(
-                        mirror[0], mirror[1], mirror[2],
-                        jnp.asarray(q[qs]), self.metric,
+            def host_scores_quant() -> np.ndarray:
+                # approximate int8 affinities (shortlist only): exact
+                # integer dot via the cached f32 cast (BLAS), dequantized
+                # by the page scales, with the fused mask-norms epilogue.
+                # The l2sq body is inlined from knn_quant.approx_scores in
+                # bitwise lockstep — two python frames per block were a
+                # measurable share of solo-query latency
+                if (
+                    self.metric == "l2sq"
+                    and self.dim <= knn_quant._INT8_EXACT_DIM_LIMIT
+                ):
+                    dot = g_qf @ block.qvecs_f32()[:n].T
+                    dot *= (2.0 * g_qsc)[:, None] * block.qsrow(n)[None, :]
+                    dot += block.negn(n)[None, :]
+                    return dot
+                if self.metric == "l2sq":
+                    return knn_quant.approx_scores(
+                        g_qf, g_qsc, g_qn,
+                        block.qvecs_f32()[:n], block.qsrow(n),
+                        block.norms[:n], self.metric,
+                        negnorm=block.negn(n),
                     )
-                )[:, :n]
+                return knn_quant.approx_scores(
+                    g_qf, g_qsc, g_qn,
+                    block.qvecs_f32()[:n], block.qsrow(n), block.norms[:n],
+                    self.metric, maskadd=block.maskadd(n),
+                )
+
+            host_fn = host_scores_quant if quant else host_scores
+            if mirror is not None and mirror is not True:
+                if quant:
+                    g_n = len(qs)
+                    g_pad = next_pow2(max(8, g_n))
+                    gq = np.zeros((g_pad, self.dim), dtype=np.int8)
+                    gq[:g_n] = q_codes[qs]
+                    gs = np.ones(g_pad, dtype=np.float32)
+                    gs[:g_n] = q_scales[qs]
+                    gn = np.zeros(g_pad, dtype=np.float32)
+                    gn[:g_n] = qn[qs]
+                    sub = np.asarray(
+                        knn_quant.quant_score_block_kernel(
+                            mirror[0], mirror[1], mirror[2], mirror[3],
+                            jnp.asarray(gq), jnp.asarray(gs), jnp.asarray(gn),
+                            self.metric,
+                        )
+                    )[:g_n, :n]
+                else:
+                    sub = np.asarray(
+                        _score_block_kernel(
+                            mirror[0], mirror[1], mirror[2],
+                            jnp.asarray(q[qs]), self.metric,
+                        )
+                    )[:, :n]
                 if not self._device_checked:
-                    # first-use parity probe: the device GEMM must agree with
+                    # first-use parity probe: the device path must agree with
                     # the host path byte-for-byte or it never scores again
+                    # (under int8 the dots are exact integers in f32, so
+                    # parity is arithmetic — the probe just proves it)
                     self._device_checked = True
-                    if not np.array_equal(sub, host_scores()):
+                    if not np.array_equal(sub, host_fn()):
                         self._device_ok = False
                         telemetry.stage_add("index.device_parity_rejects")
-                        sub = host_scores()
+                        sub = host_fn()
             else:
-                sub = host_scores()
+                sub = host_fn()
             cols = ds[:, None] + np.arange(n)[None, :]
             buf_s[qs[:, None], cols] = sub
             buf_i[qs[:, None], cols] = np.where(block.valid[:n], block.slots[:n], -1)
-        scores, idx = topk_rows(buf_s, buf_i, k_eff)
+        if quant:
+            scores, idx = self._exact_rescore(
+                q, qn, buf_s, buf_i, blocks, k_eff, W, probe, col0
+            )
+        else:
+            scores, idx = topk_rows(buf_s, buf_i, k_eff)
         valid = np.isfinite(scores)
         # per-batch tier observability (hit rate, occupancy)
         from pathway_tpu.engine.profile import histogram
@@ -1604,6 +1952,142 @@ class TieredIvfKnnStore:
         histogram("pathway_ivf_tier_occupancy_ratio").observe(self.tiers.occupancy())
         return scores, idx, valid
 
+    def _exact_rescore(
+        self,
+        q: np.ndarray,
+        qn: np.ndarray,
+        buf_s: np.ndarray,
+        buf_i: np.ndarray,
+        blocks: Dict[int, _ClusterPages],
+        k_eff: int,
+        width: int,
+        probe: "np.ndarray | None" = None,
+        col0: "np.ndarray | None" = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """The exact fp32 rescore epilogue: take the int8 shortlist
+        ``max(k, PATHWAY_IVF_RESCORE_K)`` deep (clamped to the candidate
+        width), gather the fp32 source rows of every shortlisted slot, and
+        recompute their scores through :func:`knn_quant.rescore_pairs` — the
+        pinned epilogue. The top-k the caller sees ranks by EXACT scores
+        only; approximate scores never leave the store."""
+        nq = q.shape[0]
+        depth = min(width, max(k_eff, rescore_k()))
+        # shortlist SELECTION only — no sort: the exact scores below are the
+        # ranking, so a bare argpartition beats the full topk_rows contract
+        # (1-D plain fancy indexing for the solo case: take_along_axis
+        # builds index grids whose overhead is visible at these sizes)
+        if nq == 1:
+            part = np.argpartition(buf_s[0], -depth)[-depth:][None, :]
+            ap_i = buf_i[0][part[0]][None, :]
+        else:
+            part = np.argpartition(buf_s, -depth, axis=1)[:, -depth:]
+            ap_i = np.take_along_axis(buf_i, part, axis=1)
+        flat = ap_i.ravel()
+        if nq == 1 and probe is not None:
+            # solo fast path: a shortlist COLUMN maps to its owning
+            # (cluster, row) through the buffer layout itself — col0 holds
+            # each probed cluster's start column, so one searchsorted
+            # replaces any per-slot lookup (side="right" lands past every
+            # zero-width cluster sharing a start). Dead rows carry id -1 in
+            # buf_i; their cluster is forced to -1 so the gather skips them.
+            j = np.searchsorted(col0[0], part[0], side="right") - 1
+            cids = probe[0][j]
+            poss = part[0] - col0[0][j]
+            dead = flat < 0
+            if dead.any():
+                cids = np.where(dead, np.int64(-1), cids)
+        else:
+            # batch path: slot -> (cid, pos) in one C-level pass — _where
+            # packs both into one int ((cid << 32) | pos, -1 for a miss or
+            # a padding slot), so fromiter(map(get, ...)) replaces a python
+            # loop that was a measurable share of query latency; the
+            # arithmetic >> keeps -1 (miss) negative, and a miss's pos bits
+            # are never consumed (its run is skipped with sok cleared)
+            packed = np.fromiter(
+                map(self._where.get, flat.tolist(), _repeat(-1)),
+                dtype=np.int64, count=flat.size,
+            )
+            cids = packed >> 32
+            poss = packed & 0xFFFFFFFF
+        # group by owning cluster via one argsort, gather each run with a
+        # contiguous slice copy, and rescore IN SORTED ORDER — rescore_pairs
+        # is row-independent (pairwise einsum), so a final scatter restores
+        # shortlist order bit-for-bit while the per-cluster work drops from
+        # a boolean mask + fancy scatter to a slice assignment
+        order = np.argsort(cids, kind="stable")
+        sc, sp = cids[order], poss[order]
+        sok = sc >= 0
+        # np.empty, not zeros: rows of skipped runs stay garbage but their
+        # scores are forced to -inf below before anything ranks on them
+        svecs = np.empty((flat.size, self.dim), dtype=np.float32)
+        snorms = np.empty(flat.size, dtype=np.float32)
+        neq = np.empty(sc.size, dtype=bool)
+        neq[0] = True
+        np.not_equal(sc[1:], sc[:-1], out=neq[1:])
+        starts = np.flatnonzero(neq)
+        ends = np.append(starts[1:], sc.size)
+        for a, b in zip(starts.tolist(), ends.tolist()):
+            cid = int(sc[a])
+            if cid < 0:
+                continue
+            blk = blocks.get(cid)
+            if blk is None:
+                blk = self._block(cid, create=False)
+            if blk is None:
+                sok[a:b] = False
+                continue
+            rows = sp[a:b]
+            np.take(blk.vecs, rows, axis=0, out=svecs[a:b])
+            np.take(blk.norms, rows, out=snorms[a:b])
+        if nq == 1:
+            # solo query: every pair shares the one query row — np.repeat
+            # builds the contiguous copy ~2x faster than a fancy index of
+            # an all-zeros qis (and contiguity matters: einsum over a
+            # stride-0 broadcast view measured SLOWER than the copy)
+            qg = np.repeat(q, flat.size, axis=0)
+            qng = np.repeat(qn, flat.size)
+        else:
+            qis = np.repeat(np.arange(nq), depth)[order]
+            qg, qng = q[qis], qn[qis]
+        sexact = knn_quant.rescore_pairs(qg, svecs, snorms, qng, self.metric)
+        n_ok = int(sok.sum())
+        if n_ok < flat.size:
+            sexact = np.where(sok, sexact, np.float32(-np.inf))
+        exact = np.empty(flat.size, dtype=np.float32)
+        exact[order] = sexact
+        exact = exact.reshape(nq, depth)
+        hist = self._rescore_hist
+        if hist is None:
+            from pathway_tpu.engine.profile import histogram
+
+            hist = self._rescore_hist = histogram("pathway_ivf_quant_rescore_depth")
+        hist.observe(float(depth))
+        telemetry.stage_add_many({
+            "index.quant.batches": 1.0,
+            "index.quant.rescored_pairs": float(n_ok),
+        })
+        if depth < k_eff:
+            # starved shortlist (width < k): topk_rows pads to the contract
+            return topk_rows(exact, ap_i, k_eff)
+        # the common tail: depth >= k, arrays are (nq, depth) with depth
+        # small — a stable full argsort beats topk_rows' partition+sort
+        # ceremony at this size, and stability keeps the ranking a pure
+        # function of (exact scores, shortlist order), so residency moves
+        # (which leave both bitwise-identical) cannot reorder ties
+        if nq == 1:
+            e = exact[0]
+            top = np.argsort(-e, kind="stable")[:k_eff]
+            out_s = e[top][None, :]
+            out_i = ap_i[0][top].astype(np.int64, copy=False)[None, :]
+        else:
+            top = np.argsort(-exact, axis=1, kind="stable")[:, :k_eff]
+            out_s = np.take_along_axis(exact, top, axis=1)
+            out_i = np.take_along_axis(ap_i, top, axis=1).astype(
+                np.int64, copy=False
+            )
+        out_i[~np.isfinite(out_s)] = -1
+        return out_s, out_i
+
     # -- export / lifecycle ----------------------------------------------------
 
     def export_rows(self) -> Tuple[List[Any], np.ndarray]:
@@ -1615,9 +2099,7 @@ class TieredIvfKnnStore:
         if self._untrained_slots:
             keys.extend(self.key_of[s] for s in self._untrained_slots)
             parts.extend(v[None, :] for v in self._untrained_vecs)
-        seen_cids = set(
-            cid for cid, _pos in self._where.values()
-        )
+        seen_cids = set(loc >> 32 for loc in self._where.values())
         for cid in sorted(seen_cids):
             block = self._block(cid, create=False)
             if block is None:
@@ -1632,6 +2114,63 @@ class TieredIvfKnnStore:
             return keys, np.zeros((0, self.dim), dtype=np.float32)
         return keys, np.concatenate(parts)
 
+    @property
+    def quant(self) -> str:
+        """The resolved quantization mode ("off" | "int8")."""
+        return self._quant
+
+    def quant_state(self) -> Dict[str, Any]:
+        """Quantization descriptor payload for replication/checkpoint: the
+        mode plus every resident cluster's per-page scale/zero-point
+        sidecars (copies — the descriptor must not alias live arrays). A
+        replica installs this alongside ``export_rows`` so restore is exact:
+        same mode, same sidecars, bit-identical codes after re-append."""
+        if self._quant == "off":
+            return {"mode": "off"}
+        self._flush()
+        clusters: Dict[int, Dict[str, Any]] = {}
+        with self.tiers._cv:
+            pages = dict(self.tiers.pages)
+        for cid, block in pages.items():
+            if block is None or block.n == 0 or not block.quant:
+                continue
+            clusters[int(cid)] = {
+                "rows": int(block.n),
+                "qscale": block.qscale.copy(),
+                "qzero": block.qzero.copy(),
+            }
+        return {"mode": self._quant, "dtype": "int8", "clusters": clusters}
+
+    def quant_recall_audit(self, queries: Any, k: int = 10) -> float:
+        """The quantized-vs-exact honesty key: recall@k of the quantized
+        tower against a full exact fp32 scan of the live corpus (audit path,
+        never serving). Observed on the ``pathway_ivf_quant_recall_ratio``
+        histogram so /metrics carries it."""
+        q = np.asarray(queries, dtype=np.float32).reshape(-1, self.dim)
+        _scores, idx, valid = self.search_batch(q, k)
+        keys, vecs = self.export_rows()
+        if not keys:
+            return 1.0
+        norms = np.sum(vecs * vecs, axis=1)
+        qn = np.sum(q * q, axis=1)
+        exact = knn_quant.host_metric_scores(q, vecs, norms, qn, self.metric)
+        kk = min(k, len(keys))
+        hits = 0
+        for i in range(q.shape[0]):
+            top = np.argpartition(exact[i], -kk)[-kk:]
+            truth = {keys[j] for j in top}
+            got = {
+                self.key_of.get(int(s))
+                for s, v in zip(idx[i], valid[i]) if v and s >= 0
+            }
+            hits += len(truth & got)
+        ratio = hits / max(q.shape[0] * kk, 1)
+        from pathway_tpu.engine.profile import histogram
+
+        histogram("pathway_ivf_quant_recall_ratio").observe(ratio)
+        telemetry.stage_add("index.quant.recall_audits")
+        return ratio
+
     def attach_spill(self, store: Any, prefix: str = "ivf-spill") -> None:
         """Enable the frozen tier behind any persistence ``ObjectStore``."""
         with self.tiers._cv:
@@ -1644,6 +2183,7 @@ class TieredIvfKnnStore:
         out.update(counts)
         out["generation"] = self.generation
         out["n_clusters"] = self.n_clusters
+        out["quant"] = self._quant
         out["hot_bytes"] = self.tiers.hot_bytes
         out["budget_bytes"] = self._budget_bytes
         out["occupancy"] = self.tiers.occupancy()
@@ -1670,6 +2210,8 @@ def _rebuild_split_pass(
     pages: Dict[int, _ClusterPages],
     dim: int,
     base_clusters: int,
+    *,
+    quant: bool = False,
 ) -> Tuple[np.ndarray, Dict[int, _ClusterPages]]:
     """Split oversized clusters of a freshly-built generation (bounds the
     per-probe page budget like the untiered store's train-time splits)."""
@@ -1691,9 +2233,9 @@ def _rebuild_split_pass(
             if not g1.any() or g1.all():
                 continue
             new_cid = sum(c.shape[0] for c in cents_list)
-            keep = _ClusterPages(dim, cap=max(PAGE, int((~g1).sum())))
+            keep = _ClusterPages(dim, cap=max(PAGE, int((~g1).sum())), quant=quant)
             keep.append(slots[~g1], vecs[~g1], norms[~g1])
-            moved = _ClusterPages(dim, cap=max(PAGE, int(g1.sum())))
+            moved = _ClusterPages(dim, cap=max(PAGE, int(g1.sum())), quant=quant)
             moved.append(slots[g1], vecs[g1], norms[g1])
             pages[cid] = keep
             pages[new_cid] = moved

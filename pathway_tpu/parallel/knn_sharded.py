@@ -212,8 +212,15 @@ class ShardedIvfKnnStore:
         n_probe: int = 8,
         dtype: Any = None,
         tiered: bool = False,
+        quant: "str | None" = None,
     ):
         from pathway_tpu.ops.knn_ivf import IvfKnnStore
+        from pathway_tpu.ops.knn_quant import quant_mode
+
+        # quantized blocks live in the tiered sub-stores only — the flat
+        # per-shard IvfKnnStore path stays fp32, so the resolved mode must
+        # say so (descriptor mode checks compare against this property)
+        self._quant = quant_mode(quant) if tiered else "off"
 
         devices = _axis_devices(mesh, axis)
         self.mesh = mesh
@@ -241,6 +248,7 @@ class ShardedIvfKnnStore:
                     n_probe=n_probe,
                     device=dev,
                     hbm_budget_bytes=per_shard_budget,
+                    quant=self._quant,
                 )
                 for dev in devices
             ]
@@ -361,6 +369,26 @@ class ShardedIvfKnnStore:
             np.concatenate(parts_s, axis=1), np.concatenate(parts_i, axis=1), k_eff
         )
         return scores, idx, np.isfinite(scores)
+
+    @property
+    def quant(self) -> str:
+        return self._quant
+
+    def quant_state(self) -> Dict[str, Any]:
+        """Aggregated quantization sidecar snapshot across shards — each
+        sub-store's per-cluster scales keyed by ``"shard:cluster"`` so the
+        descriptor contract stays flat while shard-local recalibration
+        history survives the round-trip."""
+        if self._quant == "off" or not self.tiered:
+            return {"mode": "off"}
+        clusters: Dict[str, Any] = {}
+        for shard, store in enumerate(self.stores):
+            state = store.quant_state()
+            if state.get("mode") == "off":
+                continue
+            for cid, entry in state.get("clusters", {}).items():
+                clusters[f"{shard}:{cid}"] = entry
+        return {"mode": self._quant, "dtype": "int8", "clusters": clusters}
 
     def export_rows(self) -> Tuple[List[Any], np.ndarray]:
         """Every live (key, vector) pair across all shards — the rebuildable-

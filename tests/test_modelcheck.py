@@ -494,6 +494,95 @@ def test_tiered_stage_leak_bug_caught_and_replayable():
 
 
 # ---------------------------------------------------------------------------
+# quantized retrieval (scale recalibration install vs concurrent scoring)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.quant
+def test_quant_recalibration_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.quant_recalibration_model(), max_schedules=N_SCHEDULES, name="quant"
+    )
+    _BATTERY_SECONDS["quant"] = time.monotonic() - t0
+    assert result.ok, (
+        f"quant-recalibration invariant failed on schedule "
+        f"{result.failing_schedule}: {result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.quant
+def test_quant_recalibration_abort_holds_exhaustive():
+    # the chaos `quant` op aborts before the install: every interleaving must
+    # leave the old sidecars serving, bit-exact, with nothing published
+    result = explore(
+        pm.quant_recalibration_model(abort=True),
+        max_schedules=N_SCHEDULES,
+        name="quant-abort",
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+
+
+@pytest.mark.quant
+def test_quant_torn_install_bug_caught_with_seed():
+    # the reader must land between the two install acquisitions — deep in
+    # the tree, seeded walks reach it (same split as the tiered batteries)
+    result = sweep_seeds(
+        pm.quant_recalibration_model(bug="torn_install"),
+        n_seeds=300,
+        base_seed=7,
+        name="quant-torn",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the torn sidecar-install regression went undetected"
+    )
+    assert "torn sidecar read" in str(result.failure)
+    assert result.failing_seed is not None
+    with pytest.raises(InvariantViolation, match="torn sidecar read"):
+        run_once(
+            pm.quant_recalibration_model(bug="torn_install"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.quant
+def test_quant_stale_cast_bug_caught_and_replayable():
+    result = explore(
+        pm.quant_recalibration_model(bug="stale_cast"),
+        max_schedules=400,
+        name="quant-stale",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the stale-cached-cast regression went undetected"
+    )
+    assert "stale cached cast" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="stale cached cast"):
+        run_once(
+            pm.quant_recalibration_model(bug="stale_cast"),
+            choices=result.failing_schedule,
+        )
+
+
+@pytest.mark.quant
+def test_quant_install_after_abort_bug_caught_and_replayable():
+    result = explore(
+        pm.quant_recalibration_model(abort=True, bug="install_after_abort"),
+        max_schedules=400,
+        name="quant-abort-install",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the install-after-abort regression went undetected"
+    )
+    assert "published new scales" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="published new scales"):
+        run_once(
+            pm.quant_recalibration_model(abort=True, bug="install_after_abort"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
 # closed-loop autoscaler (controller <-> transition executor)
 # ---------------------------------------------------------------------------
 
@@ -686,6 +775,7 @@ def test_model_check_battery_within_budget():
     # documented <60 s budget must hold even under full-suite load
     if set(_BATTERY_SECONDS) != {
         "fence", "ckpt", "encsvc", "membership", "autoscaler", "tiered",
+        "quant",
     }:
         pytest.skip("acceptance batteries did not run in this session (-k selection)")
     total = sum(_BATTERY_SECONDS.values())
