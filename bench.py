@@ -2673,6 +2673,191 @@ def bench_autoscale() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_replicas() -> dict:
+    """Read-replica serving fleet: bootstrap cost, query scaling, feed tax.
+
+    All in-process (followers + HTTP servers + the client router), CPU-only —
+    honest on any host. Reports:
+
+    - bootstrap wall time + rows/s for a bounded-fragment cold start;
+    - the BITWISE honesty key: the replica's results at the same commit id
+      must equal the primary's exactly (keys AND float scores) — a replica
+      that drifts is worse than no replica;
+    - router queries/s at 1 vs 2 replicas (the independent-scaling claim);
+    - kill-invisibility: one replica server closed mid-load, zero client
+      errors (every query answered by the survivor or the primary);
+    - the feed tax: primary ingest commits/s with frame recording on vs off.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+    from pathway_tpu.parallel.replica import (
+        ReplicaFollower,
+        ReplicaRouter,
+        ReplicaServer,
+        default_index_factory,
+    )
+    from pathway_tpu.persistence.replica_feed import ReplicaFeed
+
+    dim = 64 if DEVICE_SCALE_DOWN else 128
+    n_rows = 4_000 if DEVICE_SCALE_DOWN else 40_000
+    n_queries = 64
+    load_s = 1.5 if DEVICE_SCALE_DOWN else 3.0
+    rng = np.random.default_rng(7)
+    rows = rng.normal(size=(n_rows, dim)).astype(np.float32)
+    queries = rng.normal(size=(n_queries, dim)).astype(np.float32)
+    keys = [f"d{i}" for i in range(n_rows)]
+
+    primary = BruteForceKnnIndex(dim)
+    primary.add_many(keys, rows)
+    primary.search_many(list(queries[:1]), [1])  # warm the kernel
+
+    tmp = tempfile.mkdtemp(prefix="pw-bench-replicas-")
+    res: dict = {}
+    servers = []
+    try:
+        feed = ReplicaFeed(os.path.join(tmp, "feed"))
+        t0 = time.perf_counter()
+        feed.export_bootstrap(1, primary, rows_per_fragment=4096)
+        res["replicas_export_s"] = round(time.perf_counter() - t0, 3)
+
+        followers = []
+        t0 = time.perf_counter()
+        for rid in range(2):
+            f = ReplicaFollower(
+                feed, default_index_factory, replica_id=rid, poll_s=0.02
+            )
+            f.bootstrap()
+            followers.append(f)
+        boot_s = time.perf_counter() - t0
+        res["replicas_bootstrap_s"] = round(boot_s / 2, 3)
+        res["replicas_bootstrap_rows_per_s"] = round(2 * n_rows / boot_s, 1)
+
+        # tail catch-up: 20 frames of 32 rows each
+        extra = rng.normal(size=(20 * 32, dim)).astype(np.float32)
+        for c in range(20):
+            feed.record_commit(
+                2 + c,
+                [f"t{c}_{j}" for j in range(32)],
+                extra[c * 32 : (c + 1) * 32],
+            )
+        primary.add_many(
+            [f"t{c}_{j}" for c in range(20) for j in range(32)], extra
+        )
+        t0 = time.perf_counter()
+        for f in followers:
+            f.poll_frames()
+        res["replicas_catchup_frames_per_s"] = round(
+            2 * 20 / (time.perf_counter() - t0), 1
+        )
+
+        # -- BITWISE honesty key: replica == primary at the same commit -----
+        k = 10
+        want = primary.search_many(list(queries), [k] * n_queries)
+        bitwise = True
+        for f in followers:
+            commit, got = f.search_many(list(queries), [k] * n_queries)
+            bitwise = bitwise and commit == 21 and got == want
+        res["replicas_bitwise_equal"] = bool(bitwise)
+
+        servers = [ReplicaServer(f) for f in followers]
+        endpoints = [f"http://127.0.0.1:{s.port}" for s in servers]
+
+        def primary_serve(vectors, kk, filters):
+            return 21, primary.search_many(
+                list(vectors), [kk] * len(vectors), filters
+            )
+
+        payload = [[float(x) for x in queries[0]]]
+
+        def hammer(router, duration_s, errors):
+            done = time.perf_counter() + duration_s
+            count = 0
+            while time.perf_counter() < done:
+                try:
+                    router.retrieve(payload, k)
+                    count += 1
+                except Exception:
+                    errors.append(1)
+            return count
+
+        def measure_qps(eps) -> float:
+            router = ReplicaRouter(eps, primary=primary_serve, timeout_s=10.0)
+            counts = []
+            errors: list = []
+            threads = [
+                threading.Thread(
+                    target=lambda: counts.append(
+                        hammer(router, load_s, errors)
+                    )
+                )
+                for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            return sum(counts) / load_s
+
+        qps_1 = measure_qps(endpoints[:1])
+        qps_2 = measure_qps(endpoints)
+        res["replicas_qps_n1"] = round(qps_1, 1)
+        res["replicas_qps_n2"] = round(qps_2, 1)
+        res["replicas_qps_scaling_x"] = round(qps_2 / max(qps_1, 1e-9), 2)
+
+        # -- kill-invisibility under load -----------------------------------
+        router = ReplicaRouter(
+            endpoints, primary=primary_serve, timeout_s=10.0
+        )
+        errors: list = []
+        counts: list = []
+        threads = [
+            threading.Thread(
+                target=lambda: counts.append(hammer(router, load_s, errors))
+            )
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(load_s / 3)
+        servers[0].close()  # half the fleet vanishes mid-load
+        for t in threads:
+            t.join()
+        res["replicas_kill_queries"] = int(sum(counts))
+        res["replicas_kill_client_errors"] = len(errors)  # honesty: must be 0
+        res["replicas_kill_failovers"] = int(router.stats["failovers"])
+
+        # -- the feed tax on primary ingest ---------------------------------
+        batch = rng.normal(size=(256, dim)).astype(np.float32)
+        bkeys = [f"f{j}" for j in range(256)]
+
+        def ingest(commits: int, with_feed: bool) -> float:
+            t0 = time.perf_counter()
+            for c in range(commits):
+                primary.add_many(bkeys, batch)  # upserts: steady-state size
+                if with_feed:
+                    feed.record_commit(100 + c, bkeys, batch)
+            return commits / (time.perf_counter() - t0)
+
+        commits = 20 if DEVICE_SCALE_DOWN else 60
+        ingest(3, False)  # warm
+        off = ingest(commits, False)
+        on = ingest(commits, True)
+        res["replicas_ingest_commits_per_s_feed_off"] = round(off, 1)
+        res["replicas_ingest_commits_per_s_feed_on"] = round(on, 1)
+        res["replicas_feed_tax_frac"] = round(max(0.0, 1.0 - on / off), 3)
+        return res
+    finally:
+        for s in servers:
+            s.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # -- section registry ---------------------------------------------------------
 #
 # One registration per section derives the runner table, the device-bound set,
@@ -2718,6 +2903,7 @@ _register_section("scale", lambda: bench_scale(), full=1500, small=420, device_b
 _register_section("rejoin", lambda: bench_rejoin(), full=420, small=300)
 _register_section("elastic", lambda: bench_elastic(), full=300, small=240)
 _register_section("autoscale", lambda: bench_autoscale(), full=360, small=300)
+_register_section("replicas", lambda: bench_replicas(), full=360, small=240)
 
 
 def _terminate_gently(proc: subprocess.Popen, grace: float = 15.0) -> None:

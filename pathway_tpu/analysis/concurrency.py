@@ -66,6 +66,7 @@ RUNTIME_MODULES: Tuple[str, ...] = (
     "pathway_tpu/parallel/supervisor.py",
     "pathway_tpu/parallel/membership.py",
     "pathway_tpu/parallel/autoscaler.py",
+    "pathway_tpu/parallel/replica.py",
     "pathway_tpu/parallel/threads.py",
     "pathway_tpu/engine/brownout.py",
     "pathway_tpu/models/embed_pipeline.py",

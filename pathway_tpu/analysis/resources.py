@@ -80,6 +80,7 @@ RESOURCE_MODULES: Tuple[str, ...] = RUNTIME_MODULES + (
     "pathway_tpu/engine/fusion.py",
     "pathway_tpu/persistence/engine.py",
     "pathway_tpu/persistence/backends.py",
+    "pathway_tpu/persistence/replica_feed.py",
     "pathway_tpu/io/http/_server.py",
     "pathway_tpu/internals/chaos.py",
 )

@@ -58,6 +58,23 @@ _RUNGS = (
 _RELEASE_RATIO = 0.7
 
 
+def retry_after_int(seconds: float) -> str:
+    """RFC-9110 ``Retry-After`` value: a base-10 NON-NEGATIVE INTEGER of
+    seconds (no float, no sign, no units). One home for every shed path —
+    REST overload, quiesce, and the replica staleness shed all format
+    through here, so the header stays parseable by strict clients. Rounds
+    UP (a client told to wait 0.3 s that retries at 0 s hammers the very
+    queue the shed protects) with a floor of 1."""
+    try:
+        value = float(seconds)
+    except (TypeError, ValueError):
+        value = 1.0
+    if value != value or value < 0:  # NaN / negative: shed "momentarily"
+        value = 1.0
+    value = min(value, 3600.0)  # a shed is a backoff hint, not a ban
+    return str(max(1, int(-(-value // 1))))
+
+
 class BrownoutState:
     """Thread-safe overload-degradation ladder (see module docstring)."""
 

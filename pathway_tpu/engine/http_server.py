@@ -29,6 +29,61 @@ def _format_value(value: float) -> str:
     return repr(float(value))
 
 
+def metrics_plane_lines() -> "list[str]":
+    """The process-wide half of the /metrics exposition: every stage counter
+    as a ``stage``-labeled counter family, per-operator totals, and every
+    registered log-bucketed histogram. Shared by the worker's
+    :meth:`ProberStats.to_openmetrics` and the replica serving endpoint
+    (``parallel/replica.py``) so both surfaces pass the same strict-grammar
+    tests — the renderer has ONE home. Returns lines WITHOUT the ``# EOF``
+    terminator (callers append their own run-level families first)."""
+    from pathway_tpu.engine import profile as _profile
+    from pathway_tpu.engine import telemetry as _telemetry
+
+    lines: "list[str]" = []
+    stages = _telemetry.stage_snapshot()
+    if stages:
+        lines.append(
+            "# HELP pathway_stage Cumulative in-process stage counters "
+            "(keys ending _s are seconds)"
+        )
+        lines.append("# TYPE pathway_stage counter")
+        for name in sorted(stages):
+            lines.append(
+                f'pathway_stage_total{{stage="{_escape_label(name)}"}} '
+                f"{_format_value(stages[name])}"
+            )
+    totals = _profile.get_profiler().operator_totals()
+    if totals:
+        for family, key, help_text in (
+            ("pathway_operator_seconds", "seconds", "Wall seconds per operator"),
+            ("pathway_operator_rows", "rows", "Delta rows emitted per operator"),
+            (
+                "pathway_operator_retractions",
+                "retractions",
+                "Retraction rows emitted per operator",
+            ),
+        ):
+            lines.append(f"# HELP {family} {help_text}")
+            lines.append(f"# TYPE {family} counter")
+            for entry in totals:
+                lines.append(
+                    f'{family}_total{{operator="{_escape_label(entry["name"])}"'
+                    f',kind="{_escape_label(entry["kind"])}"'
+                    f',node="{entry["node"]}"}} '
+                    f"{_format_value(entry[key])}"
+                )
+    hists = _profile.histograms()
+    for hist_name in sorted(hists):
+        hist = hists[hist_name]
+        if hist.count == 0:
+            continue
+        lines.extend(
+            hist.openmetrics_lines(hist_name, f"Log-bucketed {hist_name}")
+        )
+    return lines
+
+
 class ProberStats:
     """Shared run statistics, updated by the commit loop (reference ``graph.rs:554``)."""
 
@@ -101,49 +156,7 @@ class ProberStats:
                 "# TYPE commits counter",
                 f"commits_total {self.commits}",
             ]
-        from pathway_tpu.engine import profile as _profile
-        from pathway_tpu.engine import telemetry as _telemetry
-
-        stages = _telemetry.stage_snapshot()
-        if stages:
-            lines.append(
-                "# HELP pathway_stage Cumulative in-process stage counters "
-                "(keys ending _s are seconds)"
-            )
-            lines.append("# TYPE pathway_stage counter")
-            for name in sorted(stages):
-                lines.append(
-                    f'pathway_stage_total{{stage="{_escape_label(name)}"}} '
-                    f"{_format_value(stages[name])}"
-                )
-        totals = _profile.get_profiler().operator_totals()
-        if totals:
-            for family, key, help_text in (
-                ("pathway_operator_seconds", "seconds", "Wall seconds per operator"),
-                ("pathway_operator_rows", "rows", "Delta rows emitted per operator"),
-                (
-                    "pathway_operator_retractions",
-                    "retractions",
-                    "Retraction rows emitted per operator",
-                ),
-            ):
-                lines.append(f"# HELP {family} {help_text}")
-                lines.append(f"# TYPE {family} counter")
-                for entry in totals:
-                    lines.append(
-                        f'{family}_total{{operator="{_escape_label(entry["name"])}"'
-                        f',kind="{_escape_label(entry["kind"])}"'
-                        f',node="{entry["node"]}"}} '
-                        f"{_format_value(entry[key])}"
-                    )
-        hists = _profile.histograms()
-        for hist_name in sorted(hists):
-            hist = hists[hist_name]
-            if hist.count == 0:
-                continue
-            lines.extend(
-                hist.openmetrics_lines(hist_name, f"Log-bucketed {hist_name}")
-            )
+        lines.extend(metrics_plane_lines())
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 

@@ -89,6 +89,7 @@ STAGE_NAMESPACES: "tuple[str, ...]" = (
     "lint.",        # graph/runtime lint diagnostics
     "modelcheck.",  # deterministic schedule exploration
     "persist.",     # checkpoints, journal compaction
+    "replica.",     # read-replica fleet: feed, follow, serve/shed, failover
     "rest.",        # REST admission/shed plane
 )
 
@@ -103,6 +104,9 @@ FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
     "chaos_kill",
     "chaos_quant_kill",
     "chaos_rebuild_kill",
+    "chaos_replica_kill",
+    "chaos_replica_lag",
+    "chaos_replica_torn_bootstrap",
     "checkpoint",
     "checkpoint_deferred",
     "drained",
@@ -121,6 +125,9 @@ FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
     "quant_swap",
     "rejoin",
     "rejoin_installed",
+    "replica_bootstrap",
+    "replica_failover",
+    "replica_refused",
 })
 
 

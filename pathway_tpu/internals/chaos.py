@@ -34,6 +34,7 @@ Environment contract::
          "backend": {"put_error_prob": 0.5, "max_errors": 4},
          "checkpoint": [{"op": "post_snapshot_kill", "rank": 0, "run": 0, "at": 1}],
          "scale": [{"op": "scale_join_kill", "rank": 2, "run": 0, "at": 0}],
+         "replica": [{"op": "replica_kill", "replica": 1, "commit": 5}],
          "load": {"op": "oscillating_load", "period_s": 4.0,
                   "low": 50, "high": 400},
          "sched": {"seed": 7}}
@@ -122,6 +123,9 @@ class Chaos:
         self._index: List[Dict[str, Any]] = [
             dict(e) for e in (plan.get("index") or [])
         ]
+        self._replica: List[Dict[str, Any]] = [
+            dict(e) for e in (plan.get("replica") or [])
+        ]
         self._load: Dict[str, Any] = dict(plan.get("load") or {})
         self._streams: Dict[str, random.Random] = {}
         self._backend_errors_left = int(self._backend.get("max_errors", 3))
@@ -146,6 +150,7 @@ class Chaos:
             "checkpoint_faults": 0,
             "scale_faults": 0,
             "index_faults": 0,
+            "replica_faults": 0,
         }
 
     # -- streams -------------------------------------------------------------
@@ -380,6 +385,93 @@ class Chaos:
         except Exception:
             pass  # the kill must fire regardless
         os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- read-replica faults -----------------------------------------------------
+
+    def replica_fault(self, op: str, replica: int) -> bool:
+        """True when the plan schedules replica fault ``op`` for this replica
+        id (and restart count). Ops:
+
+        - ``replica_torn_bootstrap`` — tear a bootstrap-fragment read so the
+          checksum verification fails typed (the replica must refuse and stay
+          OUT of rotation, never serve from a torn install);
+        - ``replica_lag``  — matched via :meth:`replica_lag_s` (injected
+          apply delay, the deterministic staleness-shed scenario);
+        - ``replica_kill`` — matched via :meth:`maybe_replica_kill`.
+
+        ``run`` defaults to every incarnation (replica relaunches bump
+        PATHWAY_RESTART_COUNT — the cross-attempt key, same contract as
+        ``rejoin`` entries)."""
+        for entry in self._replica:
+            if entry.get("op") != op:
+                continue
+            if int(entry.get("replica", -1)) != replica:
+                continue
+            want_run = entry.get("run")
+            if want_run is not None and int(want_run) != self.run_count:
+                continue
+            self.stats["replica_faults"] += 1
+            self._record_injection(
+                f"chaos_{op}", replica=replica, run=self.run_count
+            )
+            return True
+        return False
+
+    def replica_lag_s(self, replica: int) -> float:
+        """Injected per-frame apply delay (seconds) for this replica, or 0.0.
+        A ``frames`` field bounds how many applies pay the delay (default:
+        every apply while the entry matches) — the bounded form lets a test
+        drive the replica stale past its bound and then watch it catch up."""
+        for entry in self._replica:
+            if entry.get("op") != "replica_lag":
+                continue
+            if int(entry.get("replica", -1)) != replica:
+                continue
+            want_run = entry.get("run")
+            if want_run is not None and int(want_run) != self.run_count:
+                continue
+            frames_left = entry.get("frames")
+            if frames_left is not None:
+                if int(frames_left) <= 0:
+                    continue
+                entry["frames"] = int(frames_left) - 1
+            self.stats["replica_faults"] += 1
+            self._record_injection(
+                "chaos_replica_lag", replica=replica, run=self.run_count
+            )
+            return float(entry.get("lag_s", 0.1))
+        return 0.0
+
+    def maybe_replica_kill(self, replica: int, commit_id: int) -> None:
+        """SIGKILL this replica process when a ``replica_kill`` entry matches
+        (``commit`` gates on the replica's APPLIED commit id — omitted fires
+        at the first applied frame). The router must route around the corpse:
+        no client-visible 5xx."""
+        for entry in self._replica:
+            if entry.get("op") != "replica_kill":
+                continue
+            if int(entry.get("replica", -1)) != replica:
+                continue
+            want_commit = entry.get("commit")
+            if want_commit is not None and int(want_commit) != commit_id:
+                continue
+            want_run = entry.get("run")
+            if want_run is not None and int(want_run) != self.run_count:
+                continue
+            self.stats["kills"] += 1
+            self.stats["replica_faults"] += 1
+            try:
+                from pathway_tpu.engine.profile import get_flight_recorder
+
+                recorder = get_flight_recorder()
+                recorder.record_event(
+                    "chaos_replica_kill", replica=replica, commit=commit_id,
+                    run=self.run_count,
+                )
+                recorder.dump("chaos_replica_kill")
+            except Exception:
+                pass  # the kill must fire regardless
+            os.kill(os.getpid(), signal.SIGKILL)
 
     # -- synthetic load profiles -----------------------------------------------
 

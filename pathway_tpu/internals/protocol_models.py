@@ -1516,6 +1516,167 @@ def autoscaler_model(
 
 
 # ---------------------------------------------------------------------------
+# read-replica bootstrap / follow / bounded-staleness serve (parallel/replica.py)
+# ---------------------------------------------------------------------------
+
+
+def replica_follow_model(
+    n_commits: int = 4,
+    n_clients: int = 2,
+    *,
+    lag_bound: int = 1,
+    torn: bool = False,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The read-replica follow protocol (``parallel/replica.py``), modeled
+    BEFORE the fleet was wired (the PR-9 discipline). Staleness is measured
+    in COMMITS (model time — no wall clock): a primary thread exports frames
+    1..``n_commits``; a bootstrap thread installs the snapshot (or refuses it
+    typed when ``torn``); TWO poller threads race the frame tail — the exact
+    race the exactly-once apply guard exists for; client threads each issue
+    one query with a ``lag_bound`` staleness bound and either serve at the
+    applied commit or shed.
+
+    Invariants over every interleaving: every frame is applied EXACTLY once
+    and in commit order; every serve happens at lag <= ``lag_bound`` at the
+    instant of serving; a torn bootstrap never serves a single query (the
+    replica refuses typed and stays out of rotation); every client query is
+    shed XOR answered; the follower converges to the feed tip; and the
+    protocol never deadlocks.
+
+    Planted bugs (each must be CAUGHT with a replayable schedule):
+    ``"double_apply"`` — the commit-id guard is dropped, so racing pollers
+    apply one frame twice (the regression class that breaks bitwise replica/
+    primary parity); ``"stale_serve"`` — the staleness bound is not checked
+    at serve time, so a lagging replica answers beyond the client's bound;
+    ``"torn_bootstrap_serve"`` — the torn-bootstrap refusal is swallowed and
+    the replica serves from a half-installed index."""
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("replica")
+        cv = sched.condition(lock, name="replica.cv")
+        state: Dict[str, Any] = {
+            "tip": 0,  # latest commit the primary exported a frame for
+            "done": False,  # primary finished exporting
+            "bootstrapped": False,
+            "refused": False,
+            "applied": 0,  # the follower's applied commit id
+            "applied_log": [],  # every frame application, in order
+            "serves": [],  # (served_commit, tip_at_serve)
+            "sheds": 0,
+            "outcomes": 0,  # terminal client outcomes (serve XOR shed)
+        }
+
+        def primary_body() -> None:
+            for commit in range(1, n_commits + 1):
+                with cv:
+                    state["tip"] = commit
+                    cv.notify_all()
+                sched.yield_point(f"export{commit}")
+            with cv:
+                state["done"] = True
+                cv.notify_all()
+
+        def bootstrap_body() -> None:
+            sched.yield_point("read_manifest")
+            with cv:
+                if torn and bug != "torn_bootstrap_serve":
+                    # checksum mismatch on a fragment: TYPED refusal, the
+                    # replica never enters rotation
+                    state["refused"] = True
+                else:
+                    # (with the planted bug, a torn export installs anyway)
+                    state["bootstrapped"] = True
+                cv.notify_all()
+
+        def poller_body(idx: int) -> None:
+            while True:
+                with cv:
+                    while True:
+                        if state["refused"]:
+                            return
+                        if state["bootstrapped"] and state["applied"] < state["tip"]:
+                            break
+                        if state["done"] and (
+                            state["bootstrapped"] or state["refused"]
+                        ):
+                            if state["applied"] >= state["tip"]:
+                                return
+                            break
+                        cv.wait()
+                    floor = state["applied"]
+                    frames = list(range(floor + 1, state["tip"] + 1))
+                # frames are READ outside the apply lock, one at a time — the
+                # window in which the other poller may already have applied them
+                for commit in frames:
+                    sched.yield_point(f"p{idx}.read{commit}")
+                    with cv:
+                        if bug != "double_apply" and commit <= state["applied"]:
+                            continue  # the exactly-once guard
+                        state["applied_log"].append(commit)
+                        state["applied"] = max(state["applied"], commit)
+                        cv.notify_all()
+
+        def client_body(q: int) -> None:
+            sched.yield_point(f"q{q}.arrive")
+            with cv:
+                while not (state["bootstrapped"] or state["refused"]):
+                    cv.wait()
+                if state["refused"]:
+                    # out of rotation: the router fails over — a shed outcome
+                    # from this replica's perspective, never an answer
+                    state["sheds"] += 1
+                    state["outcomes"] += 1
+                    cv.notify_all()
+                    return
+                lag = state["tip"] - state["applied"]
+                if lag > lag_bound and bug != "stale_serve":
+                    state["sheds"] += 1
+                else:
+                    state["serves"].append((state["applied"], state["tip"]))
+                state["outcomes"] += 1
+                cv.notify_all()
+
+        sched.spawn(primary_body, name="primary")
+        sched.spawn(bootstrap_body, name="bootstrap")
+        for i in range(2):
+            sched.spawn(poller_body, i, name=f"poller{i}")
+        for q in range(n_clients):
+            sched.spawn(client_body, q, name=f"client{q}")
+
+        def check() -> None:
+            log = state["applied_log"]
+            assert len(log) == len(set(log)), (
+                f"frame applied twice (bitwise parity broken): {log}"
+            )
+            assert log == sorted(log), f"frames applied out of order: {log}"
+            if torn:
+                assert not state["serves"], (
+                    "torn bootstrap served queries from a half-installed "
+                    f"index: {state['serves']}"
+                )
+            else:
+                assert state["applied"] == n_commits, (
+                    f"follower never converged to the feed tip: applied "
+                    f"{state['applied']} of {n_commits}"
+                )
+            for served_commit, tip_at in state["serves"]:
+                assert tip_at - served_commit <= lag_bound, (
+                    f"served {tip_at - served_commit} commit(s) stale, past "
+                    f"the bound {lag_bound} (serve at commit {served_commit} "
+                    f"with tip {tip_at})"
+                )
+            assert state["outcomes"] == n_clients, (
+                f"client query stranded with no outcome: "
+                f"{state['outcomes']}/{n_clients} terminal"
+            )
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
 # planted lock-order inversion (the PWA101 <-> model-check bridge)
 # ---------------------------------------------------------------------------
 

@@ -294,7 +294,7 @@ class RestServerSubject:
                     self.request_validator(payload)
                 except Exception as e:
                     return web.Response(status=400, text=str(e))
-            from pathway_tpu.engine.brownout import get_brownout
+            from pathway_tpu.engine.brownout import get_brownout, retry_after_int
 
             brownout = get_brownout()
             # quiesce window: a membership transition has the commit loop
@@ -308,7 +308,7 @@ class RestServerSubject:
                 telemetry.stage_add("rest.quiesce_shed")
                 return web.Response(
                     status=429,
-                    headers={"Retry-After": str(max(1, int(round(quiesce_s))))},
+                    headers={"Retry-After": retry_after_int(quiesce_s)},
                     text=(
                         "resharding in progress (cluster quiesced at a commit "
                         "boundary); retry after the indicated delay"
@@ -372,7 +372,7 @@ class RestServerSubject:
                 )
                 return web.Response(
                     status=429,
-                    headers={"Retry-After": str(max(1, int(round(retry_s))))},
+                    headers={"Retry-After": retry_after_int(retry_s)},
                     text=(
                         f"overloaded: {reason}; retry after the indicated delay"
                     ),

@@ -427,14 +427,58 @@ class BruteForceKnnIndex:
             desc["quant"] = quant_state()
         return desc
 
-    def install_rebuild_descriptor(self, desc: Dict[str, Any]) -> None:
-        """Rebuild this (fresh) index from a :meth:`rebuild_descriptor`
-        export: one bulk ingest, filter data restored alongside. A
-        descriptor whose quantization mode differs from this store's is a
-        typed refusal (``QuantConfigError``) — replicating fp32 geometry
-        into an int8 replica (or vice versa) must fail loudly, never serve
-        silently mismatched scores."""
-        quant = desc.get("quant")
+    def iter_rebuild_fragments(
+        self, rows_per_fragment: int
+    ) -> "Tuple[Dict[str, Any], Any]":
+        """Streaming form of :meth:`rebuild_descriptor` for the replica-feed
+        bootstrap: a small header (filter data + quant sidecars) plus an
+        iterator of bounded ``{"keys", "vectors"}`` row fragments, at most
+        ``rows_per_fragment`` rows each. Stores with a native page-walking
+        export (the tiered IVF store) stream without ever concatenating the
+        corpus; dense stores chunk one host gather."""
+        header: Dict[str, Any] = {
+            "filter_data": dict(self.filter_data),
+            # replica children construct their index FROM the header (they
+            # have no graph to read the dim off), so geometry rides along
+            "dim": int(getattr(self.store, "dim", 0)),
+            "metric": str(getattr(self.store, "metric", "l2sq")),
+        }
+        quant_state = getattr(self.store, "quant_state", None)
+        if quant_state is not None:
+            header["quant"] = quant_state()
+        stream = getattr(self.store, "iter_export_fragments", None)
+        if stream is not None:
+            def native() -> Any:
+                for keys, vecs in stream(rows_per_fragment):
+                    yield {"keys": keys, "vectors": vecs}
+
+            return header, native()
+        export = getattr(self.store, "export_rows", None)
+        if export is None:
+            raise RuntimeError(
+                "index store cannot export rows; replica bootstrap is refused "
+                "for device-opaque stores (same contract as rebuild_descriptor)"
+            )
+        keys, vecs = export()
+
+        def chunked() -> Any:
+            for lo in range(0, max(len(keys), 1), rows_per_fragment):
+                yield {
+                    "keys": list(keys[lo : lo + rows_per_fragment]),
+                    "vectors": np.asarray(
+                        vecs[lo : lo + rows_per_fragment], dtype=np.float32
+                    ),
+                }
+
+        return header, chunked()
+
+    def install_descriptor_header(self, header: Dict[str, Any]) -> None:
+        """Install the non-row half of a descriptor (filter data; quant mode
+        verification). A descriptor whose quantization mode differs from this
+        store's is a typed refusal (``QuantConfigError``) — replicating fp32
+        geometry into an int8 replica (or vice versa) must fail loudly, never
+        serve silently mismatched scores."""
+        quant = header.get("quant")
         if quant is not None:
             from pathway_tpu.ops.knn_quant import QuantConfigError
 
@@ -446,11 +490,24 @@ class BruteForceKnnIndex:
                     f"store runs {have!r}: replication across quantization "
                     "modes is refused (set PATHWAY_IVF_QUANT consistently)"
                 )
-        keys = list(desc.get("keys", []))
+        self.filter_data = dict(header.get("filter_data", {}))
+
+    def install_descriptor_rows(self, keys: List[Any], vectors: Any) -> None:
+        """Install one bounded row fragment (bulk append — quantized stores
+        regenerate their codes on append, bit-identically per the
+        ``quant_state`` contract)."""
+        keys = list(keys)
         if keys:
-            vectors = np.asarray(desc["vectors"], dtype=np.float32)
-            self.store.add_many(keys, vectors)
-        self.filter_data = dict(desc.get("filter_data", {}))
+            self.store.add_many(keys, np.asarray(vectors, dtype=np.float32))
+
+    def install_rebuild_descriptor(self, desc: Dict[str, Any]) -> None:
+        """Rebuild this (fresh) index from a :meth:`rebuild_descriptor`
+        export: one bulk ingest, filter data restored alongside (the
+        monolithic form of the header + fragment install pair above)."""
+        self.install_descriptor_header(desc)
+        self.install_descriptor_rows(
+            list(desc.get("keys", [])), desc.get("vectors")
+        )
 
     def search(self, query_vector: Any, limit: int, filter_expr: Any = None) -> List[tuple]:
         return self.search_many([query_vector], [limit], [filter_expr])[0]

@@ -64,7 +64,7 @@ import queue
 import threading
 import time
 from itertools import repeat as _repeat
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -2113,6 +2113,57 @@ class TieredIvfKnnStore:
         if not parts:
             return keys, np.zeros((0, self.dim), dtype=np.float32)
         return keys, np.concatenate(parts)
+
+    def iter_export_fragments(
+        self, max_rows: int
+    ) -> "Iterator[Tuple[List[Any], np.ndarray]]":
+        """Bounded-memory export: yield ``(keys, vectors)`` chunks of at most
+        ``max_rows`` rows, walking untrained staging and then the cluster
+        pages WITHOUT concatenating the corpus — peak memory is one fragment
+        plus one resident page, however large the index (the replica-feed
+        bootstrap contract; spill-tier pages fault in one at a time through
+        ``_block`` exactly like a cold probe would)."""
+        self._flush()
+        max_rows = max(1, int(max_rows))
+        keys: List[Any] = []
+        parts: List[np.ndarray] = []
+        n_buf = 0
+
+        def drain() -> Tuple[List[Any], np.ndarray]:
+            nonlocal keys, parts, n_buf
+            out = (
+                keys,
+                np.concatenate(parts)
+                if parts
+                else np.zeros((0, self.dim), dtype=np.float32),
+            )
+            keys, parts, n_buf = [], [], 0
+            return out
+
+        if self._untrained_slots:
+            for s, v in zip(self._untrained_slots, self._untrained_vecs):
+                keys.append(self.key_of[s])
+                parts.append(np.asarray(v, dtype=np.float32)[None, :])
+                n_buf += 1
+                if n_buf >= max_rows:
+                    yield drain()
+        seen_cids = set(loc >> 32 for loc in self._where.values())
+        for cid in sorted(seen_cids):
+            block = self._block(cid, create=False)
+            if block is None:
+                continue
+            slots, vecs, _norms = block.live_rows()
+            for j, s in enumerate(slots):
+                key = self.key_of.get(int(s))
+                if key is None:
+                    continue
+                keys.append(key)
+                parts.append(vecs[j : j + 1])
+                n_buf += 1
+                if n_buf >= max_rows:
+                    yield drain()
+        if n_buf:
+            yield drain()
 
     @property
     def quant(self) -> str:

@@ -84,6 +84,15 @@ def autoscale_enabled() -> bool:
     )
 
 
+def replica_autoscale_enabled() -> bool:
+    """The read-replica fleet (``parallel/replica.py``) scales through the
+    SAME damped controller, gated separately — query capacity and ingest
+    capacity are independent axes."""
+    return os.environ.get("PATHWAY_REPLICA_AUTOSCALE", "off").lower() in (
+        "on", "1", "true", "yes",
+    )
+
+
 class AutoscaleRefusedError(RuntimeError):
     """A controller-issued scale-up was REFUSED by the cluster's preflight
     capability vote (non-reshardable graph state). Typed so supervisor
@@ -136,6 +145,39 @@ class AutoscalePolicy:
             flap_window_s=_env_float("PATHWAY_AUTOSCALE_FLAP_WINDOW_S", 300.0),
             flap_reversals=int(_env_float("PATHWAY_AUTOSCALE_FLAP_REVERSALS", 3)),
             shed_first_s=_env_float("PATHWAY_AUTOSCALE_SHED_FIRST_S", 3.0),
+        )
+
+    @classmethod
+    def replica_from_env(cls) -> "AutoscalePolicy":
+        """Replica-fleet flavor of the same controller: ``rows_per_worker``
+        reads as target QUERIES/s per replica, and the cooldowns are short —
+        launching a replica is a cheap cold start from the feed, not a
+        reshard pause, and a staleness shed is its overload signal (so
+        ``shed_first_s`` is 0: a shedding fleet scales immediately)."""
+        return cls(
+            min_workers=int(_env_float("PATHWAY_REPLICA_AUTOSCALE_MIN", 1)),
+            max_workers=int(_env_float("PATHWAY_REPLICA_AUTOSCALE_MAX", 4)),
+            rows_per_worker=_env_float("PATHWAY_REPLICA_AUTOSCALE_QPS", 200.0),
+            sample_period_s=_env_float("PATHWAY_REPLICA_AUTOSCALE_SAMPLE_S", 1.0),
+            band=_env_float("PATHWAY_REPLICA_AUTOSCALE_BAND", 0.25),
+            up_samples=int(_env_float("PATHWAY_REPLICA_AUTOSCALE_UP_SAMPLES", 3)),
+            down_samples=int(
+                _env_float("PATHWAY_REPLICA_AUTOSCALE_DOWN_SAMPLES", 8)
+            ),
+            up_cooldown_s=_env_float("PATHWAY_REPLICA_AUTOSCALE_UP_COOLDOWN_S", 5.0),
+            down_cooldown_s=_env_float(
+                "PATHWAY_REPLICA_AUTOSCALE_DOWN_COOLDOWN_S", 30.0
+            ),
+            refusal_backoff_s=_env_float(
+                "PATHWAY_REPLICA_AUTOSCALE_REFUSAL_BACKOFF_S", 60.0
+            ),
+            flap_window_s=_env_float(
+                "PATHWAY_REPLICA_AUTOSCALE_FLAP_WINDOW_S", 120.0
+            ),
+            flap_reversals=int(
+                _env_float("PATHWAY_REPLICA_AUTOSCALE_FLAP_REVERSALS", 3)
+            ),
+            shed_first_s=_env_float("PATHWAY_REPLICA_AUTOSCALE_SHED_FIRST_S", 0.0),
         )
 
 

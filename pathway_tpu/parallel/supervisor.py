@@ -194,6 +194,8 @@ class Supervisor:
         scale_plan: "List[dict] | None" = None,
         control_port: "int | None" = None,
         autoscale: "bool | None" = None,
+        replicas: "int | None" = None,
+        replica_feed: "str | None" = None,
     ):
         if restart_mode not in ("surgical", "all"):
             raise ValueError(
@@ -288,6 +290,16 @@ class Supervisor:
         self._last_autoscale_sample = 0.0
         self._autoscaler_flap_logged = False
         self._autoscaler_written_gen = -1
+        # read-replica serving fleet (parallel/replica.py): query-plane
+        # processes launched NEXT TO the ingest ranks, following the replica
+        # feed. A replica death is an event the fleet heals from its own
+        # relaunch budget — it never consumes the ingest restart budget and
+        # never fails the cluster
+        if replicas is None:
+            replicas = int(_env_float("PATHWAY_REPLICAS", 0))
+        self.replicas = int(replicas)
+        self.replica_feed = replica_feed or os.environ.get("PATHWAY_REPLICA_FEED")
+        self.replica_fleet: "Optional[Any]" = None
 
     def _surgical_enabled(self) -> bool:
         # n == 1 has no survivors to keep alive — surgical degenerates to
@@ -708,6 +720,17 @@ class Supervisor:
                     pass
                 handle.wait()
 
+    def _drive_replica_fleet(self) -> None:
+        """One replica-fleet tick inside the watch loop: reap+relaunch dead
+        replicas (their post-mortems print immediately — a replica death is
+        handled, not fatal) and drive the fleet's own autoscaler."""
+        fleet = self.replica_fleet
+        if fleet is None:
+            return
+        for line in fleet.watch_once():
+            self._log(f"replica fleet: {line}")
+        fleet.autoscale_tick()
+
     def _watch(self) -> "Optional[tuple]":
         """Block until the cluster finishes or fails.
 
@@ -721,6 +744,7 @@ class Supervisor:
             self._last_statuses = statuses
             up_for = time.monotonic() - self._launched_at
             self._drive_autoscaler(statuses)
+            self._drive_replica_fleet()
             self._poll_scale_requests(statuses)
             wedged_transition = self._watch_transition(statuses)
             if wedged_transition is not None:
@@ -994,6 +1018,28 @@ class Supervisor:
                     f"{type(ctrl.last_refusal).__name__}: {ctrl.last_refusal}"
                 )
             self._log("  post-mortem autoscaler: " + ", ".join(bits))
+        if self.replica_fleet is not None:
+            # replica-kind processes are attributed DISTINCTLY from ranks:
+            # exit cause, last applied commit, staleness at death — and their
+            # flight dumps were preserved past supervise-dir cleanup
+            fleet = self.replica_fleet
+            fleet.watch_once()
+            for line in fleet.post_mortems:
+                self._log(f"  post-mortem {line}")
+            for rid, st in sorted(fleet.statuses().items()):
+                staleness = st.get("staleness_s")
+                self._log(
+                    f"  post-mortem replica {rid}: {st.get('state')}, "
+                    f"applied commit {st.get('applied_commit')}, staleness "
+                    + (
+                        "unknown"
+                        if staleness is None
+                        else f"{float(staleness):.3f}s"
+                    )
+                )
+            scaler = fleet.autoscaler_line()
+            if scaler is not None:
+                self._log(f"  post-mortem {scaler}")
         self._log(f"not restarting: {why_final}")
 
     # -- entry point -----------------------------------------------------------
@@ -1004,6 +1050,23 @@ class Supervisor:
         try:
             self._start_control_endpoint()
             self._launch()
+            if self.replicas > 0 and self.replica_feed:
+                from pathway_tpu.parallel.replica import ReplicaFleet
+
+                self.replica_fleet = ReplicaFleet(
+                    feed_root=self.replica_feed,
+                    supervise_dir=self._supervise_dir,
+                    run_id=self._run_id,
+                    n=self.replicas,
+                    base_env=self.env_base,
+                )
+                self.replica_fleet.start()
+            elif self.replicas > 0:
+                self._log(
+                    f"--replicas {self.replicas} requested but no replica "
+                    "feed is configured (PATHWAY_REPLICA_FEED); the fleet "
+                    "would have nothing to bootstrap from — not launching"
+                )
             while True:
                 failure = self._watch()
                 if failure is None:
@@ -1092,6 +1155,13 @@ class Supervisor:
                 )
                 self._launch()
         finally:
+            if self.replica_fleet is not None:
+                # flight dumps are preserved to the temp dir inside stop(),
+                # BEFORE the supervise dir (their home) is rmtree'd below
+                try:
+                    self.replica_fleet.stop()
+                except Exception as exc:
+                    self._log(f"replica fleet: stop failed during teardown: {exc}")
             self._terminate_all()
             if self._control_listener is not None:
                 try:

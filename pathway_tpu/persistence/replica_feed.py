@@ -1,0 +1,425 @@
+"""Replica feed: the durable handoff lane between a primary and its read
+replicas.
+
+The read-replica fleet (``parallel/replica.py``) never joins the ingest mesh —
+it bootstraps from a *bounded-fragment* export of the primary's index rebuild
+descriptor and then follows a compact row-delta journal tail. This module owns
+that on-disk contract; everything above it (HTTP serving, staleness bounds,
+routing) lives in ``parallel/replica.py``.
+
+Layout under one feed root (a filesystem directory, typically
+``<persistence root>/replica-feed`` or ``PATHWAY_REPLICA_FEED``)::
+
+    bootstrap-{commit:010d}/header.pkl        # filter data + quant sidecars
+    bootstrap-{commit:010d}/fragment-{k:06d}.pkl
+    bootstrap-{commit:010d}.json              # manifest, committed LAST
+    frames/{commit:010d}.frame                # per-commit row deltas > commit
+
+Three disciplines carried over from the checkpoint manifests
+(``persistence/engine.py``):
+
+- **versioned, torn-proof bootstraps** — fragments and header land first, the
+  manifest JSON is written atomically last and READ BACK before the export
+  counts; a torn export of bootstrap N never destroys bootstrap N-1 (readers
+  take the newest manifest whose fragment set verifies);
+- **checksummed fragments** — every fragment (and the header) carries its
+  sha256 in the manifest; a mismatch on the replica is
+  :class:`ReplicaBootstrapError`, a typed refusal that keeps the replica OUT
+  of rotation instead of serving wrong bytes;
+- **bounded peak memory** — fragments hold at most
+  ``PATHWAY_REPLICA_FRAGMENT_ROWS`` rows (default 4096), so replica-bootstrap
+  memory stays flat as the index grows (PAPERS.md: memory-efficient
+  redistribution through bounded collective steps); the writer streams them
+  from ``BruteForceKnnIndex.iter_rebuild_fragments`` without materializing the
+  corpus twice.
+
+Frames are atomic (tmp + rename) with a checksummed pickle payload; a frame
+that fails verification is treated as *not yet visible* (the follower stops
+before it and retries), never applied torn.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+_BOOTSTRAP_DIR_FMT = "bootstrap-{commit:010d}"
+_BOOTSTRAP_MANIFEST_FMT = "bootstrap-{commit:010d}.json"
+_BOOTSTRAP_MANIFEST_RE = re.compile(r"^bootstrap-(\d{10})\.json$")
+_FRAGMENT_FMT = "fragment-{idx:06d}.pkl"
+_HEADER_NAME = "header.pkl"
+_FRAMES_DIR = "frames"
+_FRAME_FMT = "{commit:010d}.frame"
+_FRAME_RE = re.compile(r"^(\d{10})\.frame$")
+#: feed format version — a replica refuses a feed written by an incompatible
+#: later layout instead of guessing at it
+_FEED_VERSION = 1
+
+
+def fragment_rows_from_env() -> int:
+    """Rows per bootstrap fragment (``PATHWAY_REPLICA_FRAGMENT_ROWS``)."""
+    try:
+        return max(1, int(os.environ.get("PATHWAY_REPLICA_FRAGMENT_ROWS", "4096")))
+    except ValueError:
+        return 4096
+
+
+class ReplicaFeedError(RuntimeError):
+    """Base class for replica-feed contract violations."""
+
+
+class ReplicaBootstrapError(ReplicaFeedError):
+    """Torn or mismatched bootstrap state: missing fragments, checksum
+    mismatch, commit disagreement between manifest and payload, or an injected
+    ``replica_torn_bootstrap`` chaos fault. The replica must refuse to serve
+    (stay out of rotation) — wrong bytes are worse than no replica."""
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class ReplicaFeed:
+    """One feed root: primary-side writer AND replica-side reader (the two
+    sides share the path/format constants by sharing the class)."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+
+    # -- primary side: bootstrap export ------------------------------------
+
+    def export_bootstrap(
+        self,
+        commit_id: int,
+        index: Any,
+        *,
+        rows_per_fragment: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Export ``index`` (a ``BruteForceKnnIndex`` or subclass) at
+        ``commit_id`` as a bounded-fragment bootstrap. Fragments + header land
+        first; the manifest commits LAST, atomically, and is read back and
+        re-verified before the export counts (the read-back-verified manifest
+        discipline). Returns the manifest dict. Older bootstraps and frames
+        at/below ``commit_id`` are pruned AFTER the new manifest verifies —
+        one previous bootstrap is kept so a torn export never strands the
+        fleet."""
+        rows = rows_per_fragment or fragment_rows_from_env()
+        commit_id = int(commit_id)
+        bdir = os.path.join(self.root, _BOOTSTRAP_DIR_FMT.format(commit=commit_id))
+        os.makedirs(bdir, exist_ok=True)
+        os.makedirs(os.path.join(self.root, _FRAMES_DIR), exist_ok=True)
+        header, fragments = iter_rebuild_fragments(index, rows)
+        header_blob = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
+        _atomic_write(os.path.join(bdir, _HEADER_NAME), header_blob)
+        frag_entries: List[Dict[str, Any]] = []
+        total_rows = 0
+        for idx, frag in enumerate(fragments):
+            blob = pickle.dumps(frag, protocol=pickle.HIGHEST_PROTOCOL)
+            name = _FRAGMENT_FMT.format(idx=idx)
+            _atomic_write(os.path.join(bdir, name), blob)
+            n = len(frag["keys"])
+            total_rows += n
+            frag_entries.append({"name": name, "sha256": _sha256(blob), "rows": n})
+        manifest = {
+            "version": _FEED_VERSION,
+            "commit": commit_id,
+            "header_sha256": _sha256(header_blob),
+            "fragments": frag_entries,
+            "rows": total_rows,
+        }
+        mpath = os.path.join(
+            self.root, _BOOTSTRAP_MANIFEST_FMT.format(commit=commit_id)
+        )
+        _atomic_write(
+            mpath, json.dumps(manifest, sort_keys=True).encode("utf-8")
+        )
+        # read-back verification: the export only counts if a fresh reader
+        # accepts it end to end (catches torn fragments AND manifest bugs)
+        readback = self.latest_bootstrap()
+        if readback is None or int(readback["commit"]) != commit_id:
+            raise ReplicaFeedError(
+                f"replica bootstrap {commit_id} failed read-back verification "
+                f"(latest readable: {readback and readback['commit']})"
+            )
+        self._prune(commit_id)
+        return manifest
+
+    def _prune(self, newest_commit: int) -> None:
+        """Drop bootstraps older than the previous one and frames at/below the
+        OLDER kept bootstrap (frames above it must survive: a replica booting
+        from the previous bootstrap still needs its tail)."""
+        commits = sorted(self._bootstrap_commits())
+        keep = set(commits[-2:])
+        for c in commits:
+            if c in keep:
+                continue
+            try:
+                os.unlink(
+                    os.path.join(self.root, _BOOTSTRAP_MANIFEST_FMT.format(commit=c))
+                )
+            except OSError:
+                pass
+            bdir = os.path.join(self.root, _BOOTSTRAP_DIR_FMT.format(commit=c))
+            try:
+                for name in os.listdir(bdir):
+                    try:
+                        os.unlink(os.path.join(bdir, name))
+                    except OSError:
+                        pass
+                os.rmdir(bdir)
+            except OSError:
+                pass
+        floor = min(keep) if keep else newest_commit
+        for commit, path in self._frame_paths():
+            if commit <= floor:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    # -- primary side: journal tail ----------------------------------------
+
+    def record_commit(
+        self,
+        commit_id: int,
+        keys: List[Any],
+        vectors: Any,
+        *,
+        removals: Optional[List[Any]] = None,
+        filter_data: Optional[Dict[Any, Any]] = None,
+    ) -> str:
+        """Append one commit's row deltas as an atomic, checksummed frame.
+        ``vectors`` rows align with ``keys`` (upserts); ``removals`` are keys
+        deleted this commit. Returns the frame path."""
+        commit_id = int(commit_id)
+        frames_dir = os.path.join(self.root, _FRAMES_DIR)
+        os.makedirs(frames_dir, exist_ok=True)
+        payload = pickle.dumps(
+            {
+                "commit": commit_id,
+                "keys": list(keys),
+                "vectors": np.asarray(vectors, dtype=np.float32)
+                if len(keys)
+                else np.zeros((0, 0), dtype=np.float32),
+                "removals": list(removals or []),
+                "filter_data": dict(filter_data or {}),
+            },
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        blob = _sha256(payload).encode("ascii") + b"\n" + payload
+        path = os.path.join(frames_dir, _FRAME_FMT.format(commit=commit_id))
+        _atomic_write(path, blob)
+        return path
+
+    # -- replica side: discovery + verified loads ---------------------------
+
+    def _bootstrap_commits(self) -> List[int]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            m = _BOOTSTRAP_MANIFEST_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_bootstrap(self) -> Optional[Dict[str, Any]]:
+        """The newest bootstrap manifest whose manifest JSON parses and whose
+        fragment files all EXIST (cheap structural check; byte verification
+        happens fragment-by-fragment during :meth:`load_bootstrap`). Torn or
+        partial exports are skipped — newest valid wins, same as
+        ``load_cluster_manifest``."""
+        for commit in reversed(self._bootstrap_commits()):
+            mpath = os.path.join(
+                self.root, _BOOTSTRAP_MANIFEST_FMT.format(commit=commit)
+            )
+            try:
+                with open(mpath, "rb") as f:
+                    manifest = json.loads(f.read().decode("utf-8"))
+            except (OSError, ValueError):
+                continue
+            if int(manifest.get("version", -1)) != _FEED_VERSION:
+                continue
+            if int(manifest.get("commit", -1)) != commit:
+                continue
+            bdir = os.path.join(
+                self.root, _BOOTSTRAP_DIR_FMT.format(commit=commit)
+            )
+            names = set()
+            try:
+                names = set(os.listdir(bdir))
+            except OSError:
+                continue
+            if _HEADER_NAME not in names:
+                continue
+            if any(e["name"] not in names for e in manifest.get("fragments", [])):
+                continue
+            return manifest
+        return None
+
+    def load_bootstrap(
+        self,
+        *,
+        replica_id: int = 0,
+        install_header: Callable[[Dict[str, Any]], None],
+        install_fragment: Callable[[List[Any], np.ndarray], None],
+        manifest: Optional[Dict[str, Any]] = None,
+    ) -> int:
+        """Stream the newest verified bootstrap into an index, one bounded
+        fragment at a time (peak memory: one fragment, never the corpus).
+        Every byte is checksum-verified before install; any mismatch, missing
+        file, or injected ``replica_torn_bootstrap`` fault raises
+        :class:`ReplicaBootstrapError` — the caller must treat that as
+        out-of-rotation, not retryable-by-serving. Returns the bootstrap's
+        commit id."""
+        manifest = manifest or self.latest_bootstrap()
+        if manifest is None:
+            raise ReplicaBootstrapError(
+                f"no verifiable replica bootstrap under {self.root!r}"
+            )
+        commit = int(manifest["commit"])
+        bdir = os.path.join(self.root, _BOOTSTRAP_DIR_FMT.format(commit=commit))
+        torn = self._torn_bootstrap_injected(replica_id)
+        header_blob = self._read_verified(
+            os.path.join(bdir, _HEADER_NAME), manifest["header_sha256"], torn=torn
+        )
+        install_header(pickle.loads(header_blob))
+        for entry in manifest.get("fragments", []):
+            blob = self._read_verified(
+                os.path.join(bdir, entry["name"]), entry["sha256"], torn=torn
+            )
+            frag = pickle.loads(blob)
+            install_fragment(frag["keys"], frag["vectors"])
+        return commit
+
+    @staticmethod
+    def _torn_bootstrap_injected(replica_id: int) -> bool:
+        from pathway_tpu.internals.chaos import get_chaos
+
+        chaos = get_chaos()
+        return chaos is not None and chaos.replica_fault(
+            "replica_torn_bootstrap", replica_id
+        )
+
+    @staticmethod
+    def _read_verified(path: str, want_sha: str, *, torn: bool = False) -> bytes:
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            raise ReplicaBootstrapError(
+                f"bootstrap fragment unreadable: {path!r} ({exc})"
+            ) from exc
+        if torn:
+            # injected torn read: drop the tail so the checksum below fails
+            # the same way a real torn/partial install would
+            blob = blob[: max(0, len(blob) - 8)]
+        if _sha256(blob) != want_sha:
+            raise ReplicaBootstrapError(
+                f"bootstrap fragment checksum mismatch: {path!r} "
+                "(torn or mismatched export; refusing to serve from it)"
+            )
+        return blob
+
+    def _frame_paths(self) -> List[Tuple[int, str]]:
+        frames_dir = os.path.join(self.root, _FRAMES_DIR)
+        try:
+            names = os.listdir(frames_dir)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            m = _FRAME_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), os.path.join(frames_dir, name)))
+        return sorted(out)
+
+    def frames_after(self, commit_id: int) -> List[Tuple[int, str]]:
+        """(commit, path) for every tail frame strictly above ``commit_id``,
+        ascending — the follower's poll primitive."""
+        return [(c, p) for c, p in self._frame_paths() if c > int(commit_id)]
+
+    def read_frame(self, path: str) -> Dict[str, Any]:
+        """Verified frame payload; :class:`ReplicaFeedError` on a torn or
+        checksum-failing frame (the follower stops BEFORE it and retries —
+        an atomically-renamed frame should never tear, so persistent failure
+        here is a real contract violation, surfaced loudly)."""
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            raise ReplicaFeedError(f"frame unreadable: {path!r} ({exc})") from exc
+        sha, _, payload = blob.partition(b"\n")
+        if _sha256(payload) != sha.decode("ascii", "replace"):
+            raise ReplicaFeedError(
+                f"frame checksum mismatch: {path!r} (torn write?)"
+            )
+        return pickle.loads(payload)
+
+    def latest_frame_commit(self) -> Optional[int]:
+        frames = self._frame_paths()
+        return frames[-1][0] if frames else None
+
+
+# -- descriptor fragmenting (shared with ops/knn.py) ---------------------------
+
+
+def iter_rebuild_fragments(
+    index: Any, rows_per_fragment: int
+) -> Tuple[Dict[str, Any], Iterable[Dict[str, Any]]]:
+    """Split an index's rebuild descriptor into a header (filter data + quant
+    sidecars + geometry) and an iterator of bounded row fragments. Prefers the
+    index's own streaming export (``iter_rebuild_fragments`` — the tiered
+    store walks pages without concatenating the corpus); falls back to
+    chunking the monolithic ``rebuild_descriptor``."""
+    stream = getattr(index, "iter_rebuild_fragments", None)
+    if stream is not None:
+        return stream(rows_per_fragment)
+    desc = index.rebuild_descriptor()
+    if desc is None:
+        raise ReplicaFeedError(
+            "index store cannot export a rebuild descriptor (no export_rows); "
+            "replica bootstrap is refused for device-opaque stores"
+        )
+    header = {k: v for k, v in desc.items() if k not in ("keys", "vectors")}
+    keys, vectors = desc["keys"], desc["vectors"]
+
+    def chunks() -> Iterable[Dict[str, Any]]:
+        for lo in range(0, len(keys), rows_per_fragment) or [0]:
+            yield {
+                "keys": list(keys[lo : lo + rows_per_fragment]),
+                "vectors": np.asarray(
+                    vectors[lo : lo + rows_per_fragment], dtype=np.float32
+                ),
+            }
+
+    return header, chunks()
+
+
+def default_feed_root(persistence_root: Optional[str]) -> Optional[str]:
+    """Where the feed lives when ``PATHWAY_REPLICA_FEED`` is unset: beside the
+    persistence journal for fs backends, else a run-scoped tempdir fallback
+    chosen by the caller."""
+    env = os.environ.get("PATHWAY_REPLICA_FEED")
+    if env:
+        return env
+    if persistence_root:
+        return os.path.join(str(persistence_root), "replica-feed")
+    return None

@@ -717,6 +717,111 @@ def test_autoscaler_no_shed_first_bug_caught_and_replayable():
 
 
 # ---------------------------------------------------------------------------
+# read-replica follow / bounded-staleness serve
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.replicas
+def test_replica_follow_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.replica_follow_model(), max_schedules=N_SCHEDULES, name="replica"
+    )
+    _BATTERY_SECONDS["replica"] = time.monotonic() - t0
+    assert result.ok, (
+        f"replica-follow invariant failed on schedule "
+        f"{result.failing_schedule}: {result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.replicas
+def test_replica_follow_invariants_hold_seeded():
+    result = sweep_seeds(
+        pm.replica_follow_model(), n_seeds=100, base_seed=29,
+        name="replica-seeded",
+    )
+    assert result.ok, f"seed {result.failing_seed}: {result.failure}"
+    assert result.distinct_schedules == 100
+
+
+@pytest.mark.replicas
+def test_replica_torn_bootstrap_refuses_exhaustive():
+    # a torn bootstrap is a typed refusal: out of rotation, zero serves,
+    # every client query still reaches a terminal outcome (router failover)
+    result = explore(
+        pm.replica_follow_model(torn=True),
+        max_schedules=N_SCHEDULES,
+        name="replica-torn",
+    )
+    assert result.ok, f"{result.failing_schedule}: {result.failure}"
+
+
+@pytest.mark.replicas
+def test_replica_double_apply_bug_caught_with_seed():
+    # the double apply needs BOTH pollers to list the same frame before
+    # either applies it — deep in the tree, where seeded walks reach faster
+    # than root-systematic DFS (same split as the membership and autoscaler
+    # deep-race batteries); a small instance keeps the walk dense
+    result = sweep_seeds(
+        pm.replica_follow_model(2, 1, bug="double_apply"),
+        n_seeds=300,
+        base_seed=37,
+        name="replica-double-apply",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the double-apply regression went undetected"
+    )
+    assert "applied twice" in str(result.failure)
+    assert result.failing_seed is not None
+    # the SEED alone reproduces the double apply (deterministic walk)
+    with pytest.raises(InvariantViolation, match="applied twice"):
+        run_once(
+            pm.replica_follow_model(2, 1, bug="double_apply"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.replicas
+def test_replica_stale_serve_bug_caught_with_seed():
+    result = sweep_seeds(
+        pm.replica_follow_model(bug="stale_serve"),
+        n_seeds=300,
+        base_seed=31,
+        name="replica-stale-serve",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the stale-serve-past-bound regression went undetected"
+    )
+    assert "past the bound" in str(result.failure)
+    assert result.failing_seed is not None
+    # the SEED alone reproduces the stale serve (deterministic walk)
+    with pytest.raises(InvariantViolation, match="past the bound"):
+        run_once(
+            pm.replica_follow_model(bug="stale_serve"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.replicas
+def test_replica_torn_bootstrap_serve_bug_caught_and_replayable():
+    result = explore(
+        pm.replica_follow_model(torn=True, bug="torn_bootstrap_serve"),
+        max_schedules=400,
+        name="replica-torn-serve",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the torn-bootstrap-serve regression went undetected"
+    )
+    assert "half-installed" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="half-installed"):
+        run_once(
+            pm.replica_follow_model(torn=True, bug="torn_bootstrap_serve"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
 # PWA101 <-> model check: the same inversion caught both ways
 # ---------------------------------------------------------------------------
 
@@ -775,7 +880,7 @@ def test_model_check_battery_within_budget():
     # documented <60 s budget must hold even under full-suite load
     if set(_BATTERY_SECONDS) != {
         "fence", "ckpt", "encsvc", "membership", "autoscaler", "tiered",
-        "quant",
+        "quant", "replica",
     }:
         pytest.skip("acceptance batteries did not run in this session (-k selection)")
     total = sum(_BATTERY_SECONDS.values())

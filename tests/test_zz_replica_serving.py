@@ -1,0 +1,285 @@
+"""Replica serving plane: strict OpenMetrics + the Retry-After audit.
+
+``zz``-parked like ``test_zz_brownout_serving.py``: these tests start live
+HTTP servers (the replica serving endpoint) whose handler threads are
+daemons — running them LAST keeps any lingering accept loop from shadowing
+earlier modules' socket assertions. Nothing here is slow; it is ordering
+hygiene, not cost.
+
+Two satellites live here:
+
+- **strict OpenMetrics over ``replica.*``** — the live replica ``/metrics``
+  exposition passes the same strict grammar validator the worker plane
+  does, including the ``replica.*`` stage-counter family and the
+  ``pathway_replica_staleness_seconds`` / ``pathway_replica_failover_seconds``
+  histograms (observations forced first, so the families are PRESENT, not
+  vacuously absent);
+- **the Retry-After audit** — every shed path in the tree (REST overload,
+  quiesce, replica staleness) formats its ``Retry-After`` through
+  ``engine/brownout.py:retry_after_int`` and the result parses as an
+  RFC-9110 base-10 non-negative integer under adversarial inputs.
+"""
+
+import json
+import os
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.brownout import BrownoutState, retry_after_int
+from pathway_tpu.ops.knn import BruteForceKnnIndex
+from pathway_tpu.parallel.replica import (
+    ReplicaFollower,
+    ReplicaRouter,
+    ReplicaServer,
+    default_index_factory,
+)
+from pathway_tpu.persistence.replica_feed import ReplicaFeed
+
+from .utils import validate_openmetrics
+
+pytestmark = [pytest.mark.replicas, pytest.mark.telemetry]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIM = 8
+
+_INTEGER = re.compile(r"[0-9]+")
+
+
+# -- satellite: the Retry-After audit ------------------------------------------
+
+
+def test_retry_after_int_is_rfc9110_integer():
+    """Adversarial sweep: whatever a shed-path estimator produces, the
+    header value is a base-10 non-negative integer (no float, no sign, no
+    units), at least 1 (a 0 invites an instant re-hammer), at most 3600 (a
+    shed is a backoff hint, not a ban)."""
+    adversarial = [
+        0, 0.0, -0.0, 0.0001, 0.3, 0.999, 1, 1.0, 1.2, 2, 7.5, 59.01,
+        3599.2, 3600, 3600.5, 1e9, float("inf"), float("nan"), -5, -0.3,
+        None, "garbage", "12.5",
+    ]
+    for value in adversarial:
+        out = retry_after_int(value)
+        assert isinstance(out, str)
+        assert _INTEGER.fullmatch(out), f"{value!r} -> {out!r}"
+        assert 1 <= int(out) <= 3600, f"{value!r} -> {out!r}"
+    # rounds UP, never down: a client told 0.3s that retries at 0s hammers
+    # the very queue the shed protects
+    assert retry_after_int(0.3) == "1"
+    assert retry_after_int(1.0) == "1"
+    assert retry_after_int(1.2) == "2"
+    assert retry_after_int(59.01) == "60"
+    assert retry_after_int("12.5") == "13"
+    # degenerate estimators shed "momentarily", capped estimators stay sane
+    for bad in (float("nan"), -5, None, "garbage"):
+        assert retry_after_int(bad) == "1"
+    for huge in (1e9, float("inf"), 3601):
+        assert retry_after_int(huge) == "3600"
+
+
+def test_every_retry_after_header_routes_through_the_one_formatter():
+    """Source audit: every ``"Retry-After":`` header CONSTRUCTION in
+    ``pathway_tpu/`` calls ``retry_after_int`` on the same line — there is
+    exactly one formatter, so a new shed path cannot silently ship a float
+    or negative header. (Reads of the header — the router parsing a shed
+    response — are exempt.)"""
+    sites = []
+    for dirpath, _, filenames in os.walk(os.path.join(REPO, "pathway_tpu")):
+        for filename in filenames:
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            with open(path, "r", encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if '"Retry-After":' in line:
+                        sites.append((os.path.relpath(path, REPO), lineno, line))
+    assert sites, "the shed paths vanished? expected Retry-After emitters"
+    offenders = [
+        (path, lineno)
+        for path, lineno, line in sites
+        if "retry_after_int(" not in line
+    ]
+    assert not offenders, (
+        f"Retry-After headers built without retry_after_int: {offenders} — "
+        "route them through engine/brownout.py:retry_after_int"
+    )
+    # all three shed paths are represented: REST (overload + quiesce), replica
+    files = {path for path, _, _ in sites}
+    assert any("io/http/_server.py" in p for p in files)
+    assert any("parallel/replica.py" in p for p in files)
+
+
+def test_each_shed_path_estimate_parses_as_integer(tmp_path):
+    """Per-path leg of the audit: drive each shed path's LIVE estimator
+    (quiesce remaining-pause, REST overload retry callable, replica
+    staleness backlog) through the formatter and parse the result."""
+    # 1. quiesce: a membership transition's expected remaining pause
+    brownout = BrownoutState(enabled=True)
+    brownout.enter_quiesce(expected_s=2.5)
+    quiesce_s = brownout.quiesce_retry_after()
+    assert quiesce_s is not None and quiesce_s > 0
+    assert _INTEGER.fullmatch(retry_after_int(quiesce_s))
+    brownout.exit_quiesce()
+    assert brownout.quiesce_retry_after() is None
+
+    # 2. REST overload: whatever the pipeline's retry callable estimates
+    # (including the degenerate "estimator raised -> 1.0s" fallback)
+    for estimate in (0.05, 3.7, 120.0):
+        assert _INTEGER.fullmatch(retry_after_int(estimate))
+
+    # 3. replica staleness: poll cadence x pending backlog
+    primary = BruteForceKnnIndex(DIM)
+    primary.add_many(["a", "b"], np.eye(2, DIM, dtype=np.float32))
+    feed = ReplicaFeed(str(tmp_path / "feed"))
+    feed.export_bootstrap(1, primary)
+    follower = ReplicaFollower(feed, default_index_factory, poll_s=0.07)
+    follower.bootstrap()
+    for commit in (2, 3, 4, 5):
+        feed.record_commit(
+            commit, [f"c{commit}"], np.ones((1, DIM), dtype=np.float32)
+        )
+    estimate = follower.retry_estimate_s()
+    assert estimate == pytest.approx(0.07 * 5)
+    assert _INTEGER.fullmatch(retry_after_int(estimate))
+
+
+# -- satellite: strict OpenMetrics over the replica plane ----------------------
+
+
+def test_live_replica_metrics_pass_strict_openmetrics(tmp_path):
+    """Serve, shed, fail over — then scrape the LIVE replica ``/metrics``
+    through the strict validator and assert the replica families and the
+    ``replica.*`` stage counters are present with the traffic just driven."""
+
+    class Clock:
+        t = 100.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    rng = np.random.default_rng(0)
+    primary = BruteForceKnnIndex(DIM)
+    primary.add_many(
+        [f"k{i}" for i in range(8)],
+        rng.normal(size=(8, DIM)).astype(np.float32),
+    )
+    feed = ReplicaFeed(str(tmp_path / "feed"))
+    feed.export_bootstrap(1, primary)
+    follower = ReplicaFollower(feed, default_index_factory, clock=clock)
+    follower.bootstrap()
+    # a poll observes the staleness histogram; a frame bumps frames_applied
+    feed.record_commit(2, ["z"], rng.normal(size=(1, DIM)).astype(np.float32))
+    assert follower.poll_frames() == 1
+    server = ReplicaServer(follower)
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        query = {"vectors": [[0.0] * DIM], "k": 2}
+
+        def post(payload):
+            req = urllib.request.Request(
+                f"{url}/v1/retrieve",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        assert post(query)["commit"] == 2  # replica.serve
+        clock.t += 9.0
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            post({**query, "max_staleness_s": 0.5})  # replica.shed_stale
+        assert exc_info.value.code == 429
+        assert _INTEGER.fullmatch(exc_info.value.headers["Retry-After"])
+
+        # a router walk over one dead endpoint observes the failover
+        # histogram and the replica.router.* counters
+        router = ReplicaRouter(
+            ["http://127.0.0.1:9", url], timeout_s=10.0
+        )
+        router._rr = 0  # start on the dead endpoint: forced failover
+        commit, _ = router.retrieve(query["vectors"], 2)
+        assert commit == 2
+        assert router.stats["failovers"] == 1
+
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text"
+            )
+            text = resp.read().decode()
+    finally:
+        server.close()
+
+    families = validate_openmetrics(text)
+    # replica-level gauges/counters with the traffic just driven
+    assert families["pathway_replica_applied_commit"]["type"] == "gauge"
+    assert families["pathway_replica_applied_commit"]["samples"][0][2] == 2.0
+    assert families["pathway_replica_staleness_current_seconds"]["type"] == "gauge"
+    assert families["pathway_replica_served"]["samples"][0][0].endswith("_total")
+    assert families["pathway_replica_served"]["samples"][0][2] >= 1.0
+    assert families["pathway_replica_shed"]["samples"][0][2] >= 1.0
+    # the shared metrics plane carries the replica.* stage family
+    stages = {
+        labels["stage"]: value
+        for name, labels, value in families["pathway_stage"]["samples"]
+    }
+    for stage in (
+        "replica.bootstraps",
+        "replica.serve",
+        "replica.shed_stale",
+        "replica.frames_applied",
+        "replica.polls",
+        "replica.router.served",
+        "replica.router.failover",
+        "replica.router.unhealthy",
+    ):
+        assert stages.get(stage, 0.0) >= 1.0, f"stage {stage} missing: {sorted(stages)}"
+    # both replica histograms are live OpenMetrics histogram families
+    for hist in (
+        "pathway_replica_staleness_seconds",
+        "pathway_replica_failover_seconds",
+    ):
+        family = families[hist]
+        assert family["type"] == "histogram", hist
+        names = {name for name, _, _ in family["samples"]}
+        assert f"{hist}_bucket" in names
+        assert f"{hist}_count" in names and f"{hist}_sum" in names
+        count = [
+            value
+            for name, _, value in family["samples"]
+            if name == f"{hist}_count"
+        ][0]
+        assert count >= 1.0, f"{hist} never observed"
+
+
+def test_healthz_staleness_tracks_the_metrics_gauge(tmp_path):
+    """The ``/healthz`` JSON and the ``/metrics`` gauge are two views of ONE
+    snapshot: same applied commit, consistent staleness."""
+    rng = np.random.default_rng(1)
+    primary = BruteForceKnnIndex(DIM)
+    primary.add_many(["a", "b", "c"], rng.normal(size=(3, DIM)).astype(np.float32))
+    feed = ReplicaFeed(str(tmp_path / "feed"))
+    feed.export_bootstrap(4, primary)
+    follower = ReplicaFollower(feed, default_index_factory)
+    follower.bootstrap()
+    server = ReplicaServer(follower)
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{url}/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+            families = validate_openmetrics(resp.read().decode())
+        assert health["applied_commit"] == 4
+        assert (
+            families["pathway_replica_applied_commit"]["samples"][0][2] == 4.0
+        )
+        gauge = families["pathway_replica_staleness_current_seconds"]["samples"][0][2]
+        assert gauge >= 0.0 and gauge < 60.0  # fresh, finite
+        assert health["staleness_s"] is not None
+    finally:
+        server.close()
