@@ -2305,30 +2305,31 @@ pw.run(persistence_config=cfg, monitoring_level=pw.MonitoringLevel.NONE)
 """
 
 
-def bench_elastic() -> dict:
-    """Elastic-membership headline: one n=2 -> 4 -> 2 scale cycle under live
-    ingestion. Measures the reshard pause (per-rank transition duration, the
-    window the commit loop spends inside MEMBERSHIP_CHANGE), the ingest
-    throughput dip around the transitions, rows handed off per second, and
-    the manifest+tail honesty key: every joiner must catch up from the
-    membership manifest + handoff fragments with a near-empty journal tail
-    (never a full-history replay). CPU-only (localhost cluster) — honest on
-    any host; feed scales down on fallback like the other sections."""
+def _elastic_cycle(
+    prog_text: str,
+    prefix: str,
+    *,
+    feed_total_s: float,
+    rows_per_file: int,
+    port_base: int,
+    scale_plan: list,
+) -> dict:
+    """One spawn n=2 -> 4 -> 2 scale cycle under live ingestion for
+    ``prog_text``; returns ``{prefix}_*`` keys (pause p50/max, rows handed
+    off/s, throughput dip, exactness + joiner-catch-up honesty keys)."""
     import re
     import shutil
     import statistics
     import tempfile
 
-    feed_total_s = 10.0 if DEVICE_SCALE_DOWN else 18.0
-    rows_per_file = 40 if DEVICE_SCALE_DOWN else 80
-    tmp = tempfile.mkdtemp(prefix="pw-bench-elastic-")
+    tmp = tempfile.mkdtemp(prefix=f"pw-bench-{prefix}-")
     res: dict = {}
     proc = None
     try:
         os.makedirs(os.path.join(tmp, "in"))
         prog = os.path.join(tmp, "prog.py")
         with open(prog, "w") as f:
-            f.write(_ELASTIC_PROG)
+            f.write(prog_text)
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
@@ -2340,11 +2341,9 @@ def bench_elastic() -> dict:
         env["PATHWAY_HEARTBEAT_INTERVAL_S"] = "0.2"
         env["PATHWAY_BARRIER_TIMEOUT_S"] = "120"
         env["PATHWAY_MEMBERSHIP_DEADLINE_S"] = "90"
-        env["PATHWAY_SCALE_PLAN"] = json.dumps(
-            [{"after_commit": 8, "n": 4}, {"after_commit": 30, "n": 2}]
-        )
+        env["PATHWAY_SCALE_PLAN"] = json.dumps(scale_plan)
         _REJOIN_PORT_SALT[0] += 1
-        first_port = 29200 + (os.getpid() * 16 + _REJOIN_PORT_SALT[0] * 4) % 2600
+        first_port = port_base + (os.getpid() * 16 + _REJOIN_PORT_SALT[0] * 4) % 2600
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "pathway_tpu.cli", "spawn",
@@ -2422,12 +2421,12 @@ def bench_elastic() -> dict:
         if not pauses:
             raise RuntimeError(f"no completed transitions in stderr:\n{err[-2000:]}")
         all_pauses = pauses + drains
-        res["elastic_reshard_pause_p50_s"] = round(
+        res[f"{prefix}_reshard_pause_p50_s"] = round(
             statistics.median(all_pauses), 3
         )
-        res["elastic_reshard_pause_max_s"] = round(max(all_pauses), 3)
-        res["elastic_rows_handed_off"] = int(sum(handed))
-        res["elastic_rows_handed_off_per_s"] = round(
+        res[f"{prefix}_reshard_pause_max_s"] = round(max(all_pauses), 3)
+        res[f"{prefix}_rows_handed_off"] = int(sum(handed))
+        res[f"{prefix}_rows_handed_off_per_s"] = round(
             sum(handed) / max(1e-9, sum(all_pauses)), 1
         )
         # throughput dip: delivered-rows/s in the worst 2 s window vs the
@@ -2442,24 +2441,24 @@ def bench_elastic() -> dict:
                 rates.append((samples[b][1] - samples[a][1]) / dt)
         steady = statistics.median(rates) if rates else 0.0
         worst = min(rates) if rates else 0.0
-        res["elastic_throughput_dip_pct"] = (
+        res[f"{prefix}_throughput_dip_pct"] = (
             round(100.0 * (1.0 - worst / steady), 1) if steady > 0 else None
         )
-        res["elastic_ingest_rows_per_s"] = round(steady, 1)
+        res[f"{prefix}_ingest_rows_per_s"] = round(steady, 1)
         # honesty keys: both transitions completed, joiners caught up from
         # manifest + fragments with a near-empty tail, and never a restart
-        res["elastic_transitions_complete"] = (
+        res[f"{prefix}_transitions_complete"] = (
             "membership change complete: cluster is n=4" in err
             and "membership change complete: cluster is n=2" in err
         )
-        res["elastic_join_tail_frames_max"] = max(tails) if tails else None
-        res["elastic_join_no_replay"] = bool(
+        res[f"{prefix}_join_tail_frames_max"] = max(tails) if tails else None
+        res[f"{prefix}_join_no_replay"] = bool(
             tails
             and max(tails) <= 2
             and err.count("no journal replay") >= 2
             and "restarting the cluster" not in err
         )
-        res["elastic_exact"] = _total() == fed
+        res[f"{prefix}_exact"] = _total() == fed
         return res
     finally:
         if proc is not None:
@@ -2469,6 +2468,192 @@ def bench_elastic() -> dict:
                 pass
             proc.communicate()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+_ELASTIC_JOINDEDUP_PROG = """
+import json, os
+import pathway_tpu as pw
+
+tmp = os.environ["PW_BENCH_TMP"]
+pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+class WordSchema(pw.Schema):
+    word: str
+
+t = pw.io.fs.read(
+    os.path.join(tmp, "in"), format="csv", schema=WordSchema, mode="streaming"
+)
+counts = t.groupby(t.word).reduce(t.word, total=pw.reducers.count())
+joined = t.join(counts, t.word == counts.word).select(t.word, total=counts.total)
+best = joined.deduplicate(
+    value=joined.total, instance=joined.word, acceptor=lambda new, old: new >= old
+)
+final = best.with_id_from(best.word)
+
+out_path = os.path.join(tmp, f"out_{pid}.json")
+rows = {}
+def on_change(key, row, time, is_addition):
+    if is_addition:
+        rows[repr(key)] = {"word": row["word"], "total": int(row["total"])}
+    else:
+        rows.pop(repr(key), None)
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(list(rows.values()), f)
+    os.replace(out_path + ".tmp", out_path)
+
+pw.io.subscribe(final, on_change)
+cfg = pw.persistence.Config(
+    pw.persistence.Backend.filesystem(os.path.join(tmp, "store"))
+)
+pw.run(persistence_config=cfg, monitoring_level=pw.MonitoringLevel.NONE)
+"""
+
+
+def _bench_handoff_rss_sweep() -> dict:
+    """Peak-handoff-memory honesty key: partition the same join+dedup graph's
+    state at 1x / 2x / 4x size through BOTH transports and report the peak
+    transport allocation (tracemalloc, donor side, state excluded via
+    reset_peak). The chunked schedule must stay flat (<= 1.5x across the 4x
+    sweep) while the gather baseline grows ~linearly with state — in-process
+    and CPU-only, honest on any host."""
+    import pickle as _pickle
+    import tracemalloc
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals import parse_graph as pg
+    from pathway_tpu.parallel.membership import (
+        build_fragment_chunks,
+        build_fragments,
+        compute_reshard_plan,
+    )
+
+    # production-shaped rows: a few-hundred-byte payload per row, so state is
+    # payload-dominated (the regime the chunked transport bounds); the O(rows)
+    # int owner metadata the exporters scan is second-order and amortizes
+    # under the chunk budget
+    # (the budget scales with the profile: state must exceed several chunks
+    # at the smallest sweep point or the sweep never leaves the 1-chunk
+    # regime and measures nothing)
+    chunk_bytes = 1 << 18 if DEVICE_SCALE_DOWN else 1 << 20
+    payload = "x" * 400
+
+    def runner_with_rows(n_rows: int) -> GraphRunner:
+        pg.G.clear()
+        left = pw.debug.table_from_rows(
+            pw.schema_builder({"k": int, "a": int, "p": str}),
+            [(i, i * 3, payload + str(i)) for i in range(n_rows)],
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_builder({"k": int, "b": int}),
+            [(i, i * 7) for i in range(n_rows)],
+        )
+        joined = left.join(right, left.k == right.k).select(
+            left.a, left.p, right.b
+        )
+        best = joined.deduplicate(
+            value=joined.b, instance=joined.a, acceptor=lambda new, old: new >= old
+        )
+        pw.io.subscribe(best, lambda *a, **kw: None)
+        runner = GraphRunner(pg.G._current)
+        runner.lint_exempt = True
+        runner.run(monitoring_level=pw.MonitoringLevel.NONE, max_commits=3)
+        return runner
+
+    base_rows = 1500 if DEVICE_SCALE_DOWN else 4000
+    sizes = [base_rows, base_rows * 2, base_rows * 4]
+    chunk_peaks: list = []
+    gather_peaks: list = []
+    for n_rows in sizes:
+        runner = runner_with_rows(n_rows)
+        for node in runner._nodes:
+            ev = runner.evaluators[node.id]
+            ev._cluster_policies = tuple(
+                ev.cluster_input_policy(i) for i in range(len(node.inputs))
+            )
+        plan = compute_reshard_plan(runner)
+        if not plan.ok:
+            raise RuntimeError(f"reshard plan refused: {plan.refusals}")
+        tracemalloc.start()
+        try:
+            # chunked: the donor only ever holds open chunks + one pickle
+            tracemalloc.reset_peak()
+            chunk_iter, _stats = build_fragment_chunks(
+                runner, plan, 2, commit=3, generation=1, chunk_bytes=chunk_bytes
+            )
+            for _dest, chunk in chunk_iter:
+                _pickle.dumps(chunk, protocol=_pickle.HIGHEST_PROTOCOL)
+            chunk_peaks.append(tracemalloc.get_traced_memory()[1])
+            # gather baseline: every destination's full fragment materializes
+            # at once before any write
+            tracemalloc.reset_peak()
+            frags, _stats = build_fragments(runner, plan, 2, commit=3, generation=1)
+            for _dest, frag in sorted(frags.items()):
+                _pickle.dumps(frag, protocol=_pickle.HIGHEST_PROTOCOL)
+            del frags
+            gather_peaks.append(tracemalloc.get_traced_memory()[1])
+        finally:
+            tracemalloc.stop()
+        pg.G.clear()
+    return {
+        "elastic_handoff_state_rows": sizes,
+        "elastic_handoff_chunk_bytes": chunk_bytes,
+        "elastic_handoff_chunked_peak_mb": [
+            round(p / 1e6, 2) for p in chunk_peaks
+        ],
+        "elastic_handoff_gather_peak_mb": [
+            round(p / 1e6, 2) for p in gather_peaks
+        ],
+        "elastic_chunk_peak_growth_x": round(
+            chunk_peaks[-1] / max(1, chunk_peaks[0]), 2
+        ),
+        "elastic_gather_peak_growth_x": round(
+            gather_peaks[-1] / max(1, gather_peaks[0]), 2
+        ),
+        # the honesty key: chunked flat across a 4x state sweep, gather is not
+        "elastic_chunk_peak_flat": bool(
+            chunk_peaks[-1] <= 1.5 * chunk_peaks[0]
+            and gather_peaks[-1] >= 2.0 * gather_peaks[0]
+        ),
+    }
+
+
+def bench_elastic() -> dict:
+    """Elastic-membership headline: n=2 -> 4 -> 2 scale cycles under live
+    ingestion, for a groupby pipeline AND a join+dedup-heavy pipeline (the
+    graphs the preflight refused before universal reshardability). Measures
+    the reshard pause (per-rank transition duration, the window the commit
+    loop spends inside MEMBERSHIP_CHANGE), the ingest throughput dip around
+    the transitions, rows handed off per second, and two honesty families:
+    every joiner catches up from the membership manifest + handoff fragments
+    with a near-empty journal tail (never a full-history replay), and the
+    chunked transport's peak handoff memory stays FLAT across a 4x
+    state-size sweep while the gather baseline grows ~linearly. CPU-only
+    (localhost cluster) — honest on any host; feed scales down on fallback
+    like the other sections."""
+    res = _elastic_cycle(
+        _ELASTIC_PROG,
+        "elastic",
+        feed_total_s=10.0 if DEVICE_SCALE_DOWN else 18.0,
+        rows_per_file=40 if DEVICE_SCALE_DOWN else 80,
+        port_base=29200,
+        scale_plan=[{"after_commit": 8, "n": 4}, {"after_commit": 30, "n": 2}],
+    )
+    res.update(
+        _elastic_cycle(
+            _ELASTIC_JOINDEDUP_PROG,
+            "elastic_joindedup",
+            feed_total_s=8.0 if DEVICE_SCALE_DOWN else 12.0,
+            rows_per_file=30 if DEVICE_SCALE_DOWN else 60,
+            port_base=30100,
+            scale_plan=[
+                {"after_commit": 8, "n": 4},
+                {"after_commit": 24, "n": 2},
+            ],
+        )
+    )
+    res.update(_bench_handoff_rss_sweep())
+    return res
 
 
 def bench_autoscale() -> dict:
@@ -2901,7 +3086,7 @@ _register_section("vsfloor", lambda: bench_vs_floor(), full=300, small=300)
 _register_section("sharded", lambda: bench_sharded(), full=660, small=660)
 _register_section("scale", lambda: bench_scale(), full=1500, small=420, device_bound=True)
 _register_section("rejoin", lambda: bench_rejoin(), full=420, small=300)
-_register_section("elastic", lambda: bench_elastic(), full=300, small=240)
+_register_section("elastic", lambda: bench_elastic(), full=480, small=360)
 _register_section("autoscale", lambda: bench_autoscale(), full=360, small=300)
 _register_section("replicas", lambda: bench_replicas(), full=360, small=240)
 

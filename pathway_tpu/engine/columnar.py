@@ -356,6 +356,33 @@ class StateTable:
             )
         return out
 
+    def reshard_partition_chunks(
+        self, owner_of: Any, max_rows: int
+    ) -> "Any":
+        """Bounded-memory variant of :meth:`reshard_partition`: yields
+        ``(dest, (keys, diffs, columns))`` pieces of at most ``max_rows``
+        rows, copying one piece at a time instead of snapshotting the whole
+        table — the streamed-handoff path's peak is O(piece), not O(state).
+        Pieces for one dest are disjoint row ranges; a fresh table rebuilds
+        from them via incremental ``apply`` in any order."""
+        step = max(1, int(max_rows))
+        slots = np.nonzero(self._valid)[0]
+        if len(slots) == 0:
+            return
+        owners = np.asarray(owner_of(self._keys[slots]))
+        for dest in np.unique(owners):
+            dslots = slots[owners == dest]
+            for s in range(0, len(dslots), step):
+                piece = dslots[s : s + step]
+                yield int(dest), (
+                    self._keys[piece].copy(),
+                    np.ones(len(piece), dtype=np.int64),
+                    {
+                        name: self._columns[name][piece].copy()
+                        for name in self.column_names
+                    },
+                )
+
     def state_blob(self) -> bytes:
         """Compact picklable snapshot (live rows only) for operator checkpoints."""
         import pickle

@@ -483,7 +483,85 @@ class FilterEvaluator(Evaluator):
         return delta.select(filter_mask_to_bool(mask))
 
 
-class ReindexEvaluator(Evaluator):
+class _DerivedKeyMixin(Evaluator):
+    """Provenance machinery for key-DERIVING evaluators (reindex, flatten,
+    concat-reindex).
+
+    These nodes change the row key without an exchange, so an output row
+    resides wherever its INPUT row lived — the membership planner composes
+    their owner function as ``upstream_owner(prov[out_key])``. The provenance
+    map is tracked only under a cluster and is monotonic: derivation is
+    deterministic, so a retracted-then-re-added row maps identically, and
+    keeping retired entries lets late retractions route to the rank that
+    still holds the matching downstream state. Growth is bounded by the
+    number of DISTINCT derived keys ever produced on this rank.
+    """
+
+    def __init__(self, node: pg.Node, runner: Any):
+        super().__init__(node, runner)
+        self._reshard_prov: Dict[bytes, bytes] = {}
+
+    def _track_prov(self, out_keys: Any, in_keys: Any) -> None:
+        if getattr(self.runner, "_cluster", None) is None:
+            return
+        prov = self._reshard_prov
+        for j in range(len(out_keys)):
+            kb = out_keys[j].tobytes()
+            if kb not in prov:
+                prov[kb] = in_keys[j].tobytes()
+
+    # -- elastic membership handoff: the provenance map itself partitions by
+    # the DERIVED key's (composed) owner so the new topology can re-plan later
+
+    def reshard_check(self) -> "str | None":
+        return None
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        out: Dict[int, Any] = {}
+        for kb, src in self._reshard_prov.items():
+            keys = np.frombuffer(kb, dtype=KEY_DTYPE)
+            dest = int(np.asarray(owner_of(keys))[0])
+            out.setdefault(dest, {"prov": {}})["prov"][kb] = src
+        memo = Evaluator.reshard_export(self, owner_of, new_n)
+        for dest, payload in memo.items():
+            out.setdefault(dest, {"prov": {}})["_udf_memo"] = payload["_udf_memo"]
+        return out
+
+    def reshard_export_parts(
+        self, owner_of: Any, new_n: int, budget_rows: int
+    ) -> "Iterable[tuple]":
+        # streamed: never materialize the full per-dest export — buffer at
+        # most ``budget_rows`` provenance entries per open destination, so the
+        # donor's peak is O(budget x dests), not O(prov map)
+        step = max(1, int(budget_rows))
+        memo = Evaluator.reshard_export(self, owner_of, new_n)
+        extras: Dict[int, dict] = {
+            dest: {"_udf_memo": payload["_udf_memo"]}
+            for dest, payload in memo.items()
+        }
+        open_parts: Dict[int, dict] = {}
+        for kb, src in self._reshard_prov.items():
+            keys = np.frombuffer(kb, dtype=KEY_DTYPE)
+            dest = int(np.asarray(owner_of(keys))[0])
+            part = open_parts.get(dest)
+            if part is None:
+                part = open_parts[dest] = {"prov": {}}
+                part.update(extras.pop(dest, {}))
+            part["prov"][kb] = src
+            if len(part["prov"]) >= step:
+                yield dest, open_parts.pop(dest)
+        for dest in sorted(open_parts):
+            yield dest, open_parts[dest]
+        for dest in sorted(extras):
+            # a dest owed memo state but no provenance rows
+            yield dest, {"prov": {}, **extras[dest]}
+
+    def reshard_import(self, payload: Any) -> None:
+        self._reshard_prov.update((payload or {}).get("prov", {}))
+        Evaluator.reshard_import(self, payload)
+
+
+class ReindexEvaluator(_DerivedKeyMixin):
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
         if len(delta) == 0:
@@ -494,10 +572,11 @@ class ReindexEvaluator(Evaluator):
         keys = pointers_to_keys(
             [p if isinstance(p, Pointer) else pointer_from(p) for p in new_ids]
         )
+        self._track_prov(keys, delta.keys)
         return Delta(keys, delta.diffs, dict(delta.columns))
 
 
-class ConcatEvaluator(Evaluator):
+class ConcatEvaluator(_DerivedKeyMixin):
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         # net live multiplicity per key: concat is a DISJOINT union, so a key
@@ -517,6 +596,7 @@ class ConcatEvaluator(Evaluator):
                 for j in range(len(delta)):
                     p = pointer_from(Pointer(int(delta.keys[j]["hi"]), int(delta.keys[j]["lo"])), i)
                     new_keys[j]["hi"], new_keys[j]["lo"] = p.hi, p.lo
+                self._track_prov(new_keys, delta.keys)
                 delta = Delta(new_keys, delta.diffs, delta.columns)
             else:
                 for j in range(len(delta)):
@@ -545,6 +625,23 @@ class ConcatEvaluator(Evaluator):
             else:
                 self.live.pop(kb, None)
         return Delta.concat(parts, self.output_columns)
+
+    # -- elastic membership handoff: the collision tracker is keyed by the
+    # OUTPUT key in both modes (pass-through or derived), so it partitions
+    # under the same (possibly composed) owner function as the provenance map
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        out = _DerivedKeyMixin.reshard_export(self, owner_of, new_n)
+        for kb, cnt in self.live.items():
+            keys = np.frombuffer(kb, dtype=KEY_DTYPE)
+            dest = int(np.asarray(owner_of(keys))[0])
+            out.setdefault(dest, {"prov": {}}).setdefault("live", {})[kb] = cnt
+        return out
+
+    def reshard_import(self, payload: Any) -> None:
+        for kb, cnt in (payload or {}).get("live", {}).items():
+            self.live[kb] = self.live.get(kb, 0) + cnt
+        _DerivedKeyMixin.reshard_import(self, payload)
 
 
 def _col_neq(old: np.ndarray, new: np.ndarray) -> np.ndarray:
@@ -986,21 +1083,37 @@ class GroupbyEvaluator(Evaluator):
 class DeduplicateEvaluator(Evaluator):
     # state is per INSTANCE: route rows to their instance's owner process
     # (within-commit arrival order across processes is rank-merged, the same
-    # nondeterminism timely's exchange has)
+    # nondeterminism timely's exchange has). The route key IS the instance's
+    # OUTPUT row key (``pointer_from(inst, "dedup")``), so the rank owning an
+    # instance's state also owns its emitted rows — the reshard planner
+    # treats this "custom" exchange as plain ``bykey`` (RESHARD_ROUTE_BYKEY).
     CLUSTER_POLICIES = {0: "custom"}
+    RESHARD_ROUTE_BYKEY = True
+
+    @staticmethod
+    def _instance_out_key(inst: Any) -> Pointer:
+        return pointer_from(
+            inst if not isinstance(inst, np.void) else int(inst["lo"]), "dedup"
+        )
 
     def cluster_route_keys(self, idx: int, delta: Delta) -> np.ndarray:
         instance_e = self.node.config.get("instance")
         if instance_e is None:
-            # global dedup: a single logical instance — one owner (process of key 0)
-            return broadcast_key(pointer_from(), len(delta))
+            # global dedup: a single logical instance — one owner (the
+            # process owning the output key of instance 0)
+            return broadcast_key(self._instance_out_key(0), len(delta))
         resolver = self._resolver_for(self.node.inputs[0], delta)
         instances = ee.evaluate(instance_e, len(delta), resolver)
-        return keys_from_values([instances])
+        return pointers_to_keys(
+            [self._instance_out_key(inst) for inst in instances]
+        )
 
     def __init__(self, node: pg.Node, runner: Any):
         super().__init__(node, runner)
         self.current: Dict[bytes, Tuple[np.void, dict, Any]] = {}  # instance -> (key,row,value)
+        # instance -> output row-key bytes: the reshard partition key for
+        # ``current`` (an instance repr is not invertible)
+        self._okeys: Dict[bytes, bytes] = {}
 
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
@@ -1018,7 +1131,14 @@ class DeduplicateEvaluator(Evaluator):
             if instance_e is not None
             else np.zeros(n, dtype=object)
         )
-        out_keys, out_diffs, out_rows = [], [], []
+        # emission is consolidated PER INSTANCE per call: several accepted rows
+        # for one instance in a single delta must not emit chained retract/add
+        # pairs for the same output key — StateTable.apply replays retractions
+        # before insertions, so an intra-delta chain would retract a row whose
+        # add rides the very same delta. One retraction of the pre-call row
+        # (if any) plus one add of the final winner keeps every retraction
+        # pointing at an already-applied row.
+        pre: Dict[bytes, Any] = {}  # ib -> (ikey, pre-call entry | None)
         for i in range(n):
             if delta.diffs[i] < 0:
                 continue  # append-only semantics (reference deduplicate is streaming-only)
@@ -1033,22 +1153,103 @@ class DeduplicateEvaluator(Evaluator):
                 accept = bool(acceptor(val, cur[2])) if acceptor is not None else True
             if not accept:
                 continue
-            ikey = pointer_from(inst if not isinstance(inst, np.void) else int(inst["lo"]), "dedup")
+            ikey = self._instance_out_key(inst)
+            if ib not in pre:
+                pre[ib] = (ikey, cur)
+            self.current[ib] = (delta.keys[i], row, val)
+            if ib not in self._okeys:
+                self._okeys[ib] = pointers_to_keys([ikey])[0].tobytes()
+        if not pre:
+            return Delta.empty(self.output_columns)
+        out_keys, out_diffs, out_rows = [], [], []
+        for ib, (ikey, cur) in pre.items():
             if cur is not None:
                 out_keys.append(ikey)
                 out_diffs.append(-1)
                 out_rows.append(cur[1])
             out_keys.append(ikey)
             out_diffs.append(1)
-            out_rows.append(row)
-            self.current[ib] = (delta.keys[i], row, val)
-        if not out_keys:
-            return Delta.empty(self.output_columns)
+            out_rows.append(self.current[ib][1])
         columns = {
             name: ee._tidy(objarray([r[name] for r in out_rows]))
             for name in self.output_columns
         }
         return Delta(pointers_to_keys(out_keys), np.array(out_diffs, dtype=np.int64), columns)
+
+    # -- elastic membership handoff: instances partition by OUTPUT key -------
+
+    def reshard_check(self) -> "str | None":
+        if self.__dict__.get("_udf_memo"):
+            return (
+                "DeduplicateEvaluator holds a non-deterministic replay memo "
+                "keyed by pre-exchange row keys — re-partitioning by instance "
+                "output key cannot place it"
+            )
+        if len(self._okeys) < len(self.current):
+            # a pre-upgrade checkpoint restored `current` without the output
+            # key sidecar: those instances cannot be placed — refuse loudly
+            return (
+                "DeduplicateEvaluator state predates output-key tracking "
+                f"({len(self.current) - len(self._okeys)} instance(s) without "
+                "a recorded output key) — cannot re-partition this checkpoint"
+            )
+        return None
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        reason = self.reshard_check()
+        if reason is not None:
+            from pathway_tpu.parallel.membership import MembershipUnsupportedError
+
+            raise MembershipUnsupportedError(reason)
+        if not self.current:
+            return {}
+        from pathway_tpu.internals.keys import KEY_DTYPE
+
+        out: Dict[int, Any] = {}
+        for ib, entry in self.current.items():
+            kb = self._okeys[ib]
+            dest = int(np.asarray(owner_of(np.frombuffer(kb, dtype=KEY_DTYPE)))[0])
+            bucket = out.setdefault(dest, {"current": {}, "okeys": {}})
+            bucket["current"][ib] = entry
+            bucket["okeys"][ib] = kb
+        return out
+
+    def reshard_export_parts(
+        self, owner_of: Any, new_n: int, budget_rows: int
+    ) -> "Iterable[tuple]":
+        # streamed: never materialize the full per-dest export — buffer at
+        # most ``budget_rows`` instances per open destination, so the donor's
+        # peak is O(budget x dests), not O(instances)
+        reason = self.reshard_check()
+        if reason is not None:
+            from pathway_tpu.parallel.membership import MembershipUnsupportedError
+
+            raise MembershipUnsupportedError(reason)
+        from pathway_tpu.internals.keys import KEY_DTYPE
+
+        step = max(1, int(budget_rows))
+        open_parts: Dict[int, dict] = {}
+        for ib, entry in self.current.items():
+            kb = self._okeys[ib]
+            dest = int(np.asarray(owner_of(np.frombuffer(kb, dtype=KEY_DTYPE)))[0])
+            part = open_parts.setdefault(dest, {"current": {}, "okeys": {}})
+            part["current"][ib] = entry
+            part["okeys"][ib] = kb
+            if len(part["current"]) >= step:
+                yield dest, open_parts.pop(dest)
+        for dest in sorted(open_parts):
+            yield dest, open_parts[dest]
+
+    def reshard_import(self, payload: Any) -> None:
+        cur = (payload or {}).get("current", {})
+        overlap = self.current.keys() & cur.keys()
+        if overlap:
+            raise RuntimeError(
+                "dedup reshard import found an instance already present — "
+                "handoff fragments overlap"
+            )
+        self.current.update(cur)
+        self._okeys.update((payload or {}).get("okeys", {}))
 
 
 class _JoinSide:
@@ -1143,6 +1344,61 @@ class _JoinSide:
             self.cols[c] = set_cells(self.cols[c], slots, values[c])
         return slots
 
+    def reshard_export(self, owner_of: Any) -> Dict[int, dict]:
+        """Partition the live arrangement by the JOIN key's new owner:
+        per-dest parallel arrays (row keys, join keys, value columns) a fresh
+        side rebuilds from via :meth:`reshard_import`. Complete — includes
+        the rows this rank keeps."""
+        keys, slots = self.row_index.items()
+        if not len(keys):
+            return {}
+        jk = self.jk[slots]
+        owners = np.asarray(owner_of(jk))
+        out: Dict[int, dict] = {}
+        for dest in np.unique(owners):
+            sel = slots[owners == dest]
+            out[int(dest)] = {
+                "keys": self.keys[sel].copy(),
+                "jk": self.jk[sel].copy(),
+                "cols": {c: self.cols[c][sel].copy() for c in self.names},
+            }
+        return out
+
+    def reshard_export_chunks(
+        self, owner_of: Any, budget_rows: int
+    ) -> "Iterable[tuple]":
+        """Bounded variant of :meth:`reshard_export`: yields ``(dest, piece)``
+        with ≤``budget_rows`` rows per piece, copying only one piece at a
+        time (the O(rows) owner metadata is ints, never row payload)."""
+        keys, slots = self.row_index.items()
+        if not len(keys):
+            return
+        owners = np.asarray(owner_of(self.jk[slots]))
+        step = max(1, int(budget_rows))
+        for dest in np.unique(owners):
+            sel = slots[owners == dest]
+            for s in range(0, len(sel), step):
+                sl = sel[s : s + step]
+                yield int(dest), {
+                    "keys": self.keys[sl].copy(),
+                    "jk": self.jk[sl].copy(),
+                    "cols": {c: self.cols[c][sl].copy() for c in self.names},
+                }
+
+    def reshard_import(self, payload: dict) -> None:
+        keys = payload.get("keys")
+        if keys is None or not len(keys):
+            return
+        present = self.row_index.lookup(keys)
+        if (present >= 0).any():
+            # two old ranks both claimed a row key: the partitions were not
+            # disjoint — corrupt handoff, never merge silently
+            raise RuntimeError(
+                "join-side reshard import found a row key already present — "
+                "handoff fragments overlap"
+            )
+        self.insert_batch(keys, payload["jk"], payload["cols"])
+
     def remove_batch(self, row_keys: np.ndarray) -> np.ndarray:
         """Slots removed per key (-1 when the key was absent)."""
         from pathway_tpu.engine.index import _NativeKeyIndex, _NativeMultiMap
@@ -1204,6 +1460,58 @@ class JoinEvaluator(Evaluator):
                 "clear the persistence directory and re-run"
             )
 
+    # -- elastic membership handoff: arrangements partition by JOIN key ------
+    #
+    # In cluster mode both input sides exchange by join key, so this rank's
+    # arrangements hold exactly the rows whose join key it owns — they
+    # re-partition under shard_of(join_key, new_n). The join's OUTPUT is
+    # exchanged by output row key (see process), so the planner treats the
+    # node as "bykey": the owner function it hands reshard_export is the
+    # plain new-topology hash, which this export applies to JOIN keys.
+
+    def reshard_check(self) -> "str | None":
+        if self.__dict__.get("_udf_memo"):
+            return (
+                "JoinEvaluator holds a non-deterministic replay memo keyed by "
+                "pre-exchange row keys — re-partitioning by join key cannot "
+                "place it"
+            )
+        return None
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        reason = self.reshard_check()
+        if reason is not None:
+            from pathway_tpu.parallel.membership import MembershipUnsupportedError
+
+            raise MembershipUnsupportedError(reason)
+        out: Dict[int, Any] = {}
+        for side_name, side in (("left", self.left), ("right", self.right)):
+            for dest, payload in side.reshard_export(owner_of).items():
+                out.setdefault(dest, {})[side_name] = payload
+        return out
+
+    def reshard_export_parts(
+        self, owner_of: Any, new_n: int, budget_rows: int
+    ) -> "Iterable[tuple]":
+        """Bounded-transport export: the same partitions as
+        :meth:`reshard_export`, sliced into ≤``budget_rows``-row pieces so the
+        chunked fragment stream never materializes a whole side at once.
+        Pieces merge on import (insert_batch is incremental)."""
+        reason = self.reshard_check()
+        if reason is not None:
+            from pathway_tpu.parallel.membership import MembershipUnsupportedError
+
+            raise MembershipUnsupportedError(reason)
+        for side_name, side in (("left", self.left), ("right", self.right)):
+            for dest, piece in side.reshard_export_chunks(owner_of, budget_rows):
+                yield dest, {side_name: piece}
+
+    def reshard_import(self, payload: Any) -> None:
+        for side_name, side in (("left", self.left), ("right", self.right)):
+            p = (payload or {}).get(side_name)
+            if p:
+                side.reshard_import(p)
+
     def _join_keys(self, side: str, delta: Delta) -> np.ndarray:
         table = self.node.inputs[0 if side == "left" else 1]
         exprs = self.node.config["left_on" if side == "left" else "right_on"]
@@ -1241,9 +1549,22 @@ class JoinEvaluator(Evaluator):
             if part is not None and len(part):
                 parts.append(part)
         if not parts:
-            return Delta.empty(self.output_columns)
-        out = Delta.concat(parts, self.output_columns)
-        return out.consolidated()
+            out = Delta.empty(self.output_columns)
+        else:
+            out = Delta.concat(parts, self.output_columns).consolidated()
+        if cluster is not None and self.runner._persistence is not None:
+            # replies re-route by OUTPUT row key: post-join rows land on their
+            # output key's owner, so this node's materialized output and every
+            # downstream key-preserving chain is plain "bykey" state for the
+            # reshard planner — the join's arrangements (keyed by join key)
+            # are the only state that partitions by shard_of(join_key)
+            # (all-to-all barrier; runs even when empty). Only reshard-capable
+            # runs (persistence on — membership handoffs write through it)
+            # need the invariant; ephemeral runs keep rows where the join-key
+            # exchange computed them and skip the extra hop.
+            tag = f"{self.runner.current_time}:{self.node.id}:out".encode()
+            out = cluster.exchange_delta(tag, out, out.keys)
+        return out
 
     def _run_side(
         self, delta: Delta, side_name: str, *, skip_arrange: bool = False
@@ -1725,6 +2046,10 @@ class HavingEvaluator(Evaluator):
 
     _NON_STATE_ATTRS = Evaluator._NON_STATE_ATTRS + ("indexers",)
 
+    # custom routes carry the base ROW KEY itself (the pointer value each
+    # indexer row asserts), so state partitions exactly under shard_of(row key)
+    RESHARD_ROUTE_BYKEY = True
+
     def cluster_input_policy(self, idx: int) -> str | None:
         # indexer rows route by the POINTER VALUE they carry (the key whose
         # presence they assert), meeting the base row they reference
@@ -1785,6 +2110,46 @@ class HavingEvaluator(Evaluator):
             [o[0] for o in out], [o[1] for o in out], [o[2] for o in out], self.output_columns
         )
 
+    # -- elastic membership handoff: base rows and indexer reference counts
+    # are both keyed by the base row key, so they partition exactly
+
+    def reshard_check(self) -> "str | None":
+        return None
+
+    def reshard_export(self, owner_of: Any, new_n: int) -> Dict[int, Any]:
+        out: Dict[int, Any] = {}
+
+        def bucket(dest: int) -> dict:
+            return out.setdefault(
+                dest,
+                {"base": None, "counts": [dict() for _ in self.indexers]},
+            )
+
+        for dest, part in self.base.reshard_partition(owner_of).items():
+            bucket(dest)["base"] = part
+        for idx, cnt in enumerate(self.counts):
+            for kb, c in cnt.items():
+                if not c:
+                    continue
+                keys = np.frombuffer(kb, dtype=KEY_DTYPE)
+                dest = int(np.asarray(owner_of(keys))[0])
+                bucket(dest)["counts"][idx][kb] = c
+        memo = Evaluator.reshard_export(self, owner_of, new_n)
+        for dest, payload in memo.items():
+            bucket(dest)["_udf_memo"] = payload["_udf_memo"]
+        return out
+
+    def reshard_import(self, payload: Any) -> None:
+        part = payload.get("base")
+        if part is not None:
+            keys, diffs, columns = part
+            self.base.apply(Delta(keys, diffs, columns))
+        for idx, cnt in enumerate(payload.get("counts", ())):
+            if idx < len(self.counts):
+                for kb, c in cnt.items():
+                    self.counts[idx][kb] += c
+        Evaluator.reshard_import(self, payload)
+
 
 class WithUniverseOfEvaluator(Evaluator):
     """Runtime enforcement of the promised universe equality (the reference's
@@ -1832,14 +2197,14 @@ class WithUniverseOfEvaluator(Evaluator):
             )
 
 
-class FlattenEvaluator(Evaluator):
+class FlattenEvaluator(_DerivedKeyMixin):
     def process(self, input_deltas: List[Delta]) -> Delta:
         (delta,) = input_deltas
         if len(delta) == 0:
             return Delta.empty(self.output_columns)
         flat_name = self.node.config["flat_name"]
         origin_id = self.node.config.get("origin_id")
-        out_keys, out_diffs, out_rows = [], [], []
+        out_keys, out_diffs, out_rows, in_idx = [], [], [], []
         ptrs = keys_to_pointers(delta.keys)
         for i in range(len(delta)):
             value = delta.columns[flat_name][i]
@@ -1852,12 +2217,11 @@ class FlattenEvaluator(Evaluator):
                 out_keys.append(pointer_from(ptrs[i], j, "flatten"))
                 out_diffs.append(int(delta.diffs[i]))
                 out_rows.append(row)
-        return _delta_from_rows(
-            pointers_to_keys(out_keys) if out_keys else [],
-            out_diffs,
-            out_rows,
-            self.output_columns,
-        )
+                in_idx.append(i)
+        keys = pointers_to_keys(out_keys) if out_keys else []
+        if len(keys):
+            self._track_prov(keys, delta.keys[np.asarray(in_idx, dtype=np.int64)])
+        return _delta_from_rows(keys, out_diffs, out_rows, self.output_columns)
 
 
 def _iter_flatten(value: Any) -> list:

@@ -100,6 +100,9 @@ class GraphRunner:
         self._member_all_ready = False
         self._member_done_gen = -1  # newest applied/refused/failed generation
         self._member_refused: "tuple | None" = None  # (gen, reason)
+        # structured per-node preflight refusals ({"node","kind","reason"})
+        # from the last plan this rank computed — /healthz + status file
+        self._member_refusal_nodes: "list[dict]" = []
         self._member_committed_gen: "int | None" = None  # rank-0 manifest marker
         self._member_attempts = 0  # transient-abort retries of the pending gen
         self._member_in_flight = False  # transition running (no surgical rejoin)
@@ -1270,6 +1273,7 @@ class GraphRunner:
                     "target_workers",
                     "membership_committed",
                     "membership_refused",
+                    "membership_refusals",
                     "manifest_workers",
                     "autoscale",
                 )
@@ -1583,6 +1587,7 @@ class GraphRunner:
             ),
             "membership_committed": self._member_committed_gen,
             "membership_refused": self._member_refused,
+            "membership_refusals": self._member_refusal_nodes,
             "manifest_workers": self._mismatch_workers,
             # autoscale observability: this rank's published load signals and
             # the mirrored controller state (flap-lock visible in /healthz)
@@ -1791,7 +1796,12 @@ class GraphRunner:
             #    of its state? Any refusal aborts BEFORE anything mutates.
             plan = ms.compute_reshard_plan(self)
             refusals = list(plan.refusals)
-            refusals.extend(ms.preflight_sources(self, new_n, self._rank))
+            refusal_nodes = list(plan.refused_nodes)
+            for sref in ms.preflight_sources(self, new_n, self._rank):
+                refusals.append(sref)
+                refusal_nodes.append(
+                    {"node": None, "kind": "input", "reason": sref}
+                )
             if self._chaos is not None and self._chaos.scale_fault(
                 "scale_refused", self._rank
             ):
@@ -1801,6 +1811,23 @@ class GraphRunner:
                 refusals.append(
                     "chaos: injected preflight refusal (scale_refused)"
                 )
+                refusal_nodes.append(
+                    {"node": None, "kind": "chaos", "reason": "scale_refused"}
+                )
+            # refusal observability: per-node reasons on /healthz + the
+            # status file, a counter, and a flight event naming the kinds
+            self._member_refusal_nodes = refusal_nodes
+            if refusals:
+                telemetry.stage_add("cluster.preflight_refuse")
+                if self._recorder is not None:
+                    self._recorder.record_event(
+                        "preflight_refuse",
+                        generation=gen,
+                        kinds=sorted(
+                            {str(r.get("kind")) for r in refusal_nodes}
+                        ),
+                        refusals=len(refusals),
+                    )
             ok_votes = cluster.allgather(
                 f"member:ready:{gen}:{commit}".encode(),
                 refusals[0] if refusals else None,
@@ -1810,18 +1837,35 @@ class GraphRunner:
                 self._membership_abort(directive, bad[0], permanent=True)
                 return
             # 2. handoff fragments: the reshard as an array redistribution —
-            #    every keyed state array gathered by shard_of(key, new_n)
-            #    and written per new owner, read-back verified
+            #    every keyed state array partitioned by its owner function
+            #    and written per new owner, read-back verified. The default
+            #    CHUNKED transport streams bounded mini-fragments (composed
+            #    collective steps), keeping a donor's peak handoff memory
+            #    O(chunk x peers); PATHWAY_RESHARD_TRANSPORT=gather restores
+            #    the whole-fragment path (escape hatch + bench baseline).
             status = "ok"
             stats: Dict[str, int] = {"rows_handed_off": 0}
             frag_bytes = 0
+            transport = (
+                os.environ.get("PATHWAY_RESHARD_TRANSPORT", "chunked")
+                .strip()
+                .lower()
+            )
             try:
-                fragments, stats = ms.build_fragments(
-                    self, plan, new_n, commit, gen
-                )
-                frag_bytes = self._persistence.dump_reshard_fragments(
-                    self._graph_sig, commit, fragments
-                )
+                if transport == "gather":
+                    fragments, stats = ms.build_fragments(
+                        self, plan, new_n, commit, gen
+                    )
+                    frag_bytes = self._persistence.dump_reshard_fragments(
+                        self._graph_sig, commit, fragments
+                    )
+                else:
+                    chunk_iter, stats = ms.build_fragment_chunks(
+                        self, plan, new_n, commit, gen
+                    )
+                    frag_bytes = self._persistence.dump_reshard_chunks(
+                        self._graph_sig, commit, chunk_iter
+                    )
             except (ConnectionError, OSError, ValueError) as exc:
                 status = f"transient: {exc}"
             acks = cluster.allgather(f"member:ack:{gen}".encode(), status)

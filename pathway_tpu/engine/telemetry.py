@@ -122,6 +122,7 @@ FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
     "membership_left",
     "modelcheck",
     "peer_stale",
+    "preflight_refuse",
     "quant_swap",
     "rejoin",
     "rejoin_installed",

@@ -85,6 +85,46 @@ class ChaosBackendError(ConnectionError):
     """Injected transient object-store failure (retryable by design)."""
 
 
+#: every NAMED plan op per plan key — one registry, greppable, and the
+#: source of truth the CHAOS.md drift audit checks BOTH ways (every op here
+#: has a documented row; every documented op exists here). Plan keys whose
+#: entries carry no ``op`` field (kill/frames/rejoin/backend/sched) gate on
+#: their own fields and are documented as whole sections instead.
+PLAN_OPS: Dict[str, tuple] = {
+    "checkpoint": (
+        "pre_snapshot_kill",
+        "post_snapshot_kill",
+        "torn_manifest",
+        "snapshot_error",
+    ),
+    "scale": (
+        "scale_join_kill",
+        "scale_drain_kill",
+        "handoff_torn",
+        "join_handoff_torn",
+        "dedup_install_kill",
+        "chunk_stream_kill",
+        "dropped_scale_handshake",
+        "scale_refused",
+    ),
+    "index": (
+        "rebuild_kill",
+        "tier_swap_torn",
+        "quant",
+    ),
+    "replica": (
+        "replica_kill",
+        "replica_lag",
+        "replica_torn_bootstrap",
+    ),
+    "load": (
+        "load_spike",
+        "oscillating_load",
+        "noisy_neighbor",
+    ),
+}
+
+
 class _FrameAction:
     """One injection decision for an outgoing exchange frame."""
 
@@ -269,6 +309,15 @@ class Chaos:
         - ``handoff_torn``     — tear a handoff-fragment write (the read-back
           verification must fail the attempt's ack barrier, previous state
           stands, the transition retries);
+        - ``join_handoff_torn`` — tear ONLY a handoff chunk carrying join
+          arrangement state (chunked transport; read-back verification fails
+          the ack barrier exactly like ``handoff_torn``);
+        - ``dedup_install_kill`` — SIGKILL the importer right before it
+          applies a chunk carrying dedup instance state (the install barrier
+          fails, the previous topology's state stands, the ladder replays);
+        - ``chunk_stream_kill`` — SIGKILL the donor after its FIRST chunk
+          write: the stream has no chunk manifest yet, so the half-written
+          stream reads as absent (complete-or-abort);
         - ``dropped_scale_handshake`` — drop a joiner's membership hello so
           its wiring fails typed and the supervisor escalates;
         - ``scale_refused``    — inject a preflight-vote refusal (the runner

@@ -629,6 +629,7 @@ class _ModelMember:
         self.epoch = 0
         self.owned = set(owned)  # slots this member serves rows for
         self.tokens: Dict[int, "set[str]"] = {}  # slot -> row tokens held here
+        self.emitted: Dict[int, bool] = {}  # slot -> join match already emitted
         self.inbox: List[tuple] = []  # (frame_epoch, slot, token)
         self.parked: List[tuple] = []  # future-epoch frames
         self.delivered: List[tuple] = []  # (frame_epoch, epoch_at_delivery, slot)
@@ -669,12 +670,23 @@ def membership_model(
     new map (epoch-stamped frames park at not-yet-installed receivers, the
     real mesh's future-epoch discipline).
 
+    Universal-reshard extension: each slot additionally holds JOIN-side
+    state — a build-side token (``jleft``), a probe-side token (``jright``)
+    and per-slot match bookkeeping. Donors emit each slot's match exactly
+    once pre-cut; the bookkeeping rides the fragments so the new owner does
+    NOT re-emit after install. Fragments themselves travel as a CHUNKED
+    stream per (donor, dest) pair — two bounded chunks followed by a chunk
+    manifest naming the chunk count — and an installer imports a stream
+    only when its manifest matches (complete-or-abort).
+
     Invariants over every interleaving: every slot owned by exactly one live
-    member at the final epoch (and by the mapped owner); the row-token set is
-    preserved across the handoff (no row lost or duplicated) and resides with
-    the slot's owner; no stale-epoch delivery and no row delivered to a
-    non-owner; leavers fully drained (fragments durable) before release; no
-    deadlock.
+    member at the final epoch (and by the mapped owner); the row-token set
+    INCLUDING both join sides is preserved across the handoff (no row lost
+    or duplicated) and resides with the slot's owner; every slot's match is
+    emitted exactly once (never replayed across the cut); chunk streams are
+    complete-or-abort (a manifest never overstates its chunks); no
+    stale-epoch delivery and no row delivered to a non-owner; leavers fully
+    drained (fragments durable) before release; no deadlock.
 
     Planted bugs (each must be CAUGHT with a replayable schedule):
     ``"double_owner"`` — a donor keeps serving slots it handed off (two
@@ -683,7 +695,16 @@ def membership_model(
     ``"release_before_drain"`` — a leaver releases before writing its
     fragments (its rows are lost); ``"epoch_before_install"`` — the epoch is
     bumped and traffic resumes before the ownership map installs, so rows
-    route to ranks that no longer own the slot."""
+    route to ranks that no longer own the slot; ``"join_row_orphan"`` — one
+    moved slot's probe-side join rows are left out of its fragment (the
+    arrangement re-keys under the new map but the probe side is gone);
+    ``"double_match"`` — match bookkeeping is dropped from the fragments, so
+    the new owner re-emits matches the donor already emitted;
+    ``"torn_chunk_install"`` — a donor tears one chunk stream (chunk written,
+    no manifest) yet still acks, and the installer imports the partial
+    stream instead of aborting it; ``"owner_map_stale"`` — a donor partitions
+    its fragments with a stale ownership map, landing rows on ranks that do
+    not own them under the committed map."""
 
     grow = new_n >= old_n
     members_after = list(range(new_n))
@@ -704,9 +725,14 @@ def membership_model(
         cv = sched.condition(lock, name="store.cv")
         store: Dict[str, Any] = {
             "ready": set(),
-            "fragments": {},  # (donor, dest) -> {slot: tokens}; durable once written
+            # (donor, dest) -> [chunk, ...]; each chunk is
+            # {"slots": {slot: tokens}, "emitted": {slot: bool}} and is
+            # durable once appended (the bounded-transport stream)
+            "chunks": {},
+            "chunk_manifest": {},  # (donor, dest) -> promised chunk count
             "acks": set(),
             "manifests": [],
+            "matches": [],  # every join match ever emitted, in order
             "misrouted": [],  # rows routed to a released leaver (lost)
             "traffic_done": 0,  # new-topology members done sending
         }
@@ -721,40 +747,125 @@ def membership_model(
             members[j] = _ModelMember(sched, j, set())
         for m in range(old_n):
             for s in init_owned[m]:
-                members[m].tokens[s] = {f"row{s}a", f"row{s}b"}
+                # two plain rows + the join arrangement's build and probe
+                # sides — all four must survive the cut together
+                members[m].tokens[s] = {
+                    f"row{s}a", f"row{s}b", f"jleft{s}", f"jright{s}"
+                }
 
         def notify_everyone() -> None:
             for mm in members.values():
                 with mm.cv:
                     mm.cv.notify_all()
 
+        def emit_matches(m: int) -> None:
+            """Join matches: emit each owned slot's match exactly once (the
+            bookkeeping is per-slot state and rides the handoff fragments)."""
+            me = members[m]
+            with me.cv:
+                slots = sorted(me.owned)
+            for slot in slots:
+                with me.cv:
+                    have = me.tokens.get(slot, set())
+                    both = any(t.startswith("jleft") for t in have) and any(
+                        t.startswith("jright") for t in have
+                    )
+                    if not both or me.emitted.get(slot):
+                        continue
+                    me.emitted[slot] = True
+                with cv:
+                    store["matches"].append(f"match{slot}")
+                    cv.notify_all()
+
         def write_fragments(m: int) -> None:
+            """Chunked handoff: per destination the donor streams TWO bounded
+            chunks, then commits a chunk manifest naming the count — the
+            installer's complete-or-abort basis."""
             me = members[m]
             skipped = False
+            streams: Dict[int, list] = {}
+            with me.cv:
+                owned_slots = sorted(me.owned)
+            for slot in owned_slots:
+                dest = new_owner(slot)
+                if bug == "owner_map_stale" and m == 0 and slot in moved:
+                    # a stale (prior-attempt) ownership map partitions the
+                    # fragment: rows land on ranks the committed map does
+                    # not assign the slot to
+                    dest = (new_owner(slot) + 1) % new_n
+                if dest == m:
+                    continue  # kept slots stay in place
+                if bug == "orphan_range" and m == 0 and slot in moved and not skipped:
+                    skipped = True  # this key range's fragment never lands
+                    continue
+                toks = sorted(me.tokens.get(slot, set()))
+                if (
+                    bug == "join_row_orphan" and m == 0 and slot in moved
+                    and not skipped
+                ):
+                    # the probe-side join rows are left out of the fragment
+                    skipped = True
+                    toks = [t for t in toks if not t.startswith("jright")]
+                half = (len(toks) + 1) // 2
+                st = streams.setdefault(dest, [
+                    {"slots": {}, "emitted": {}},
+                    {"slots": {}, "emitted": {}},
+                ])
+                st[0]["slots"][slot] = set(toks[:half])
+                st[1]["slots"][slot] = set(toks[half:])
+                if bug != "double_match":
+                    # match bookkeeping rides the SECOND chunk (torn streams
+                    # must not leave it half-installed either)
+                    st[1]["emitted"][slot] = bool(me.emitted.get(slot))
+            torn_dest = min(streams) if streams else None
+            for dest in sorted(streams):
+                c0, c1 = streams[dest]
+                with cv:
+                    store["chunks"].setdefault((m, dest), []).append(c0)
+                    cv.notify_all()
+                sched.yield_point(f"chunk0-durable-d{dest}")
+                if bug == "torn_chunk_install" and m == 0 and dest == torn_dest:
+                    # torn stream: the second chunk and the manifest never
+                    # land, yet this donor still acks below
+                    continue
+                with cv:
+                    store["chunks"][(m, dest)].append(c1)
+                    cv.notify_all()
+                sched.yield_point(f"chunk1-durable-d{dest}")
+                with cv:
+                    store["chunk_manifest"][(m, dest)] = 2
+                    cv.notify_all()
+
+        def read_imports(m: int) -> tuple:
+            """Assemble this rank's imports from the chunk streams addressed
+            to it. Complete-or-abort: a stream whose manifest is missing or
+            overstates its chunks contributes NOTHING (the buggy installer
+            under ``torn_chunk_install`` trusts partial streams instead)."""
+            imports: Dict[int, "set[str]"] = {}
+            imported_emitted: Dict[int, bool] = {}
             with cv:
-                for slot in sorted(me.owned):
-                    dest = new_owner(slot)
-                    if dest == m:
-                        continue  # kept slots stay in place
-                    if bug == "orphan_range" and m == 0 and slot in moved and not skipped:
-                        skipped = True  # this key range's fragment never lands
+                for (donor, dest), chunks in store["chunks"].items():
+                    if dest != m:
                         continue
-                    frag = store["fragments"].setdefault((m, dest), {})
-                    frag[slot] = set(me.tokens.get(slot, set()))
-                cv.notify_all()
+                    promised = store["chunk_manifest"].get((donor, dest))
+                    if promised is None or len(chunks) < promised:
+                        if bug != "torn_chunk_install":
+                            continue  # abort the incomplete stream atomically
+                    for chunk in chunks:
+                        for slot, toks in chunk["slots"].items():
+                            imports.setdefault(slot, set()).update(toks)
+                        for slot, em in chunk.get("emitted", {}).items():
+                            imported_emitted[slot] = (
+                                imported_emitted.get(slot, False) or em
+                            )
+            return imports, imported_emitted
 
         def install(m: int) -> None:
             """Adopt epoch + ownership map + imported fragments atomically
             (purging parked future frames into the live inbox)."""
             me = members[m]
             target = {s for s in range(n_slots) if new_owner(s) == m}
-            with cv:
-                imports = {
-                    slot: set(toks)
-                    for (donor, dest), frag in store["fragments"].items()
-                    if dest == m
-                    for slot, toks in frag.items()
-                }
+            imports, imported_emitted = read_imports(m)
             with me.cv:
                 me.epoch = new_epoch
                 if bug == "epoch_before_install" and m == 0:
@@ -765,6 +876,7 @@ def membership_model(
                     me.owned = me.owned | target  # never releases donated slots
                     for slot, toks in imports.items():
                         me.tokens.setdefault(slot, set()).update(toks)
+                    me.emitted.update(imported_emitted)
                 else:
                     for slot in list(me.owned - target):
                         me.owned.discard(slot)
@@ -772,6 +884,7 @@ def membership_model(
                     me.owned = set(target)
                     for slot, toks in imports.items():
                         me.tokens.setdefault(slot, set()).update(toks)
+                    me.emitted.update(imported_emitted)
                 keep = [(e, s, t) for (e, s, t) in me.parked if e == new_epoch]
                 me.stale_dropped += len(me.parked) - len(keep)
                 me.inbox.extend(keep)
@@ -783,13 +896,7 @@ def membership_model(
             already ran at the new epoch."""
             me = members[m]
             target = {s for s in range(n_slots) if new_owner(s) == m}
-            with cv:
-                imports = {
-                    slot: set(toks)
-                    for (donor, dest), frag in store["fragments"].items()
-                    if dest == m
-                    for slot, toks in frag.items()
-                }
+            imports, imported_emitted = read_imports(m)
             with me.cv:
                 for slot in list(me.owned - target):
                     me.owned.discard(slot)
@@ -797,6 +904,7 @@ def membership_model(
                 me.owned = set(target)
                 for slot, toks in imports.items():
                     me.tokens.setdefault(slot, set()).update(toks)
+                me.emitted.update(imported_emitted)
                 me.cv.notify_all()
 
         def traffic(m: int) -> None:
@@ -846,6 +954,9 @@ def membership_model(
 
         def old_member_body(m: int) -> None:
             me = members[m]
+            # 0. pre-cut serving: the join emits each owned slot's match
+            #    (bookkeeping recorded, to ride the fragments)
+            emit_matches(m)
             # 1. quiesce: every old member votes ready at the commit boundary
             with cv:
                 store["ready"].add(m)
@@ -893,9 +1004,11 @@ def membership_model(
                     me.tokens.clear()
                 notify_everyone()
                 return
-            # 6. survivors install (epoch + map + imports, atomically), then
-            #    run post-install traffic and drain
+            # 6. survivors install (epoch + map + imports, atomically),
+            #    re-check the join (imported bookkeeping suppresses
+            #    re-emission), then run post-install traffic and drain
             install(m)
+            emit_matches(m)
             traffic(m)
             if bug == "epoch_before_install" and m == 0:
                 late_map_fix(m)
@@ -909,6 +1022,7 @@ def membership_model(
                 while not store["manifests"]:
                     cv.wait()
             install(j)
+            emit_matches(j)
             traffic(j)
             drain(j)
 
@@ -932,9 +1046,25 @@ def membership_model(
                     f"slot {slot} owned by rank {owners[0]}, expected "
                     f"{new_owner(slot)}"
                 )
-            # no row lost or duplicated across the handoff
+            # rows reside ONLY with their slot's owner under the committed
+            # map (a stale partition map lands them elsewhere)
+            for mm in members.values():
+                if mm.released:
+                    continue
+                for slot, toks in mm.tokens.items():
+                    base = {t for t in toks if not t.startswith("routed")}
+                    assert not base or mm.rank == new_owner(slot), (
+                        f"slot {slot} rows reside on rank {mm.rank} but the "
+                        f"committed map owns it to rank {new_owner(slot)} "
+                        "(stale owner map at partition time?)"
+                    )
+            # no row lost or duplicated across the handoff — including both
+            # join arrangement sides
             for slot in range(n_slots):
-                want = {f"row{slot}a", f"row{slot}b"}
+                want = {
+                    f"row{slot}a", f"row{slot}b",
+                    f"jleft{slot}", f"jright{slot}",
+                }
                 held: "set[str]" = set()
                 for mm in members.values():
                     if mm.released:
@@ -972,6 +1102,22 @@ def membership_model(
             assert (
                 len([x for x in store["manifests"] if x[0] == "member"]) == 1
             ), "membership manifest committed more than once (or never)"
+            # every join match emitted exactly once — the bookkeeping riding
+            # the fragments must suppress re-emission after install
+            for slot in range(n_slots):
+                n_emitted = store["matches"].count(f"match{slot}")
+                assert n_emitted == 1, (
+                    f"slot {slot} match emitted {n_emitted} time(s) — the "
+                    "join replayed (or lost) a match across the cut"
+                )
+            # chunk streams complete-or-abort: a committed manifest never
+            # overstates the chunks that actually landed
+            for (donor, dest), promised in store["chunk_manifest"].items():
+                got = len(store["chunks"].get((donor, dest), []))
+                assert got == promised, (
+                    f"chunk stream {donor}->{dest} committed a manifest for "
+                    f"{promised} chunk(s) but {got} landed"
+                )
 
         return check
 

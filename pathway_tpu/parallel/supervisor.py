@@ -995,6 +995,19 @@ class Supervisor:
                     )
                 elif status.get("persistence"):
                     parts.append("no cluster checkpoint (full-journal recovery)")
+                # a refused scale names WHAT refused: the node kind(s) the
+                # preflight could not re-partition, not a generic mismatch
+                refused_nodes = status.get("membership_refusals") or []
+                if refused_nodes:
+                    kinds = sorted(
+                        {str(r.get("kind", "?")) for r in refused_nodes}
+                    )
+                    first = refused_nodes[0].get("reason", "")
+                    parts.append(
+                        "preflight refused node kind(s) "
+                        + "/".join(kinds)
+                        + (f": {first}" if first else "")
+                    )
             else:
                 parts.append("no status report")
             flight = self._flight_dump_line(rank)

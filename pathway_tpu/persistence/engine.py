@@ -672,15 +672,61 @@ class PersistenceManager:
     def _fragment_name(self, commit_id: int, dest: int) -> str:
         return f"{self._reshard_dir(commit_id)}/frag-{dest:05d}.pkl"
 
+    def _chunk_name(self, commit_id: int, dest: int, idx: int) -> str:
+        return f"{self._reshard_dir(commit_id)}/frag-{dest:05d}.c{idx:04d}.pkl"
+
+    def _chunk_manifest_name(self, commit_id: int, dest: int) -> str:
+        return f"{self._reshard_dir(commit_id)}/frag-{dest:05d}.mf"
+
+    def _write_frag_blob(self, name: str, payload: bytes) -> bytes:
+        """Durably write one handoff blob under this rank's shard and return
+        the bytes a fresh reader sees (the read-back the verifications run
+        on). Raises on a memory-only store — membership handoffs need a
+        durable backend."""
+        if self._object_store is not None:
+            key = f"{self._object_prefix}{name}"
+            self._object_store.put(key, payload)
+            back = self._object_store.get(key)
+            return b"" if back is None else back
+        if self._memory:
+            raise OSError(
+                "membership handoff needs a durable persistence backend"
+            )
+        path = os.path.join(self.root, name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _read_donor_blob(self, donor: int, name: str) -> "bytes | None":
+        if self._object_store is not None:
+            return self._object_store.get(f"process-{donor}/{name}")
+        if self._memory or self._base_root is None:
+            return None
+        # membership transitions only exist for sharded stores
+        # (spawn -n >= 2), so donor shards are always process-<r>/
+        shard = os.path.join(str(self._base_root), f"process-{donor}")
+        try:
+            with open(os.path.join(shard, name), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
     def dump_reshard_fragments(
         self, graph_sig: str, commit_id: int, fragments: Dict[int, dict]
     ) -> int:
-        """Write this rank's handoff fragments (one per new rank) under its
-        own shard, then READ EACH BACK and verify it unpickles — a torn
-        fragment must fail the transition's ack barrier, not poison a later
-        import. Returns total bytes written. Raises ``ConnectionError``/
-        ``OSError``/``ValueError`` on failure (incl. injected chaos faults);
-        the caller acks "transient" and the transition aborts cleanly."""
+        """Gather-transport handoff dump: write this rank's fragments (one
+        per new rank) under its own shard, then READ EACH BACK and verify it
+        unpickles — a torn fragment must fail the transition's ack barrier,
+        not poison a later import. Returns total bytes written. Raises
+        ``ConnectionError``/``OSError``/``ValueError`` on failure (incl.
+        injected chaos faults); the caller acks "transient" and the
+        transition aborts cleanly."""
         from pathway_tpu.internals.chaos import get_chaos
 
         chaos = get_chaos()
@@ -694,25 +740,7 @@ class PersistenceManager:
             ):
                 payload = payload[: max(1, len(payload) // 2)]  # torn write
             name = self._fragment_name(commit_id, dest)
-            if self._object_store is not None:
-                key = f"{self._object_prefix}{name}"
-                self._object_store.put(key, payload)
-                back = self._object_store.get(key)
-            elif self._memory:
-                raise OSError(
-                    "membership handoff needs a durable persistence backend"
-                )
-            else:
-                path = os.path.join(self.root, name)
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = path + f".tmp.{os.getpid()}"
-                with open(tmp, "wb") as f:
-                    f.write(payload)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)
-                with open(path, "rb") as f:
-                    back = f.read()
+            back = self._write_frag_blob(name, payload)
             try:
                 got = pickle.loads(back)
             except Exception as exc:
@@ -730,28 +758,156 @@ class PersistenceManager:
             total += len(payload)
         return total
 
+    def dump_reshard_chunks(
+        self, graph_sig: str, commit_id: int, chunk_iter: Any
+    ) -> int:
+        """Streamed (chunked-transport) handoff dump: consume ``(dest,
+        chunk)`` mini-fragments one at a time, write each read-back
+        verified, then commit one CHUNK MANIFEST per destination naming the
+        complete stream (chunk count + per-chunk crc32). A reader treats a
+        stream whose manifest is missing, or whose chunks are fewer or fail
+        their checksums, as ABSENT — complete-or-abort, never a partial
+        install. Peak memory here is one pickled chunk, which is what keeps
+        a donor's handoff RSS flat as state grows. Returns total bytes
+        written."""
+        import zlib
+
+        from pathway_tpu.internals.chaos import get_chaos
+
+        chaos = get_chaos()
+        rank = self._rank_id()
+        total = 0
+        per_dest: Dict[int, List[dict]] = {}
+        first_written = False
+        for dest, chunk in chunk_iter:
+            idx = len(per_dest.setdefault(dest, []))
+            payload = pickle.dumps(
+                {"sig": graph_sig, **chunk}, protocol=pickle.HIGHEST_PROTOCOL
+            )
+            if chaos is not None and (
+                chaos.scale_fault("handoff_torn", rank)
+                or (
+                    "join" in (chunk.get("kinds") or ())
+                    and chaos.scale_fault("join_handoff_torn", rank)
+                )
+            ):
+                payload = payload[: max(1, len(payload) // 2)]  # torn write
+            name = self._chunk_name(commit_id, dest, idx)
+            back = self._write_frag_blob(name, payload)
+            try:
+                got = pickle.loads(back)
+            except Exception as exc:
+                raise ValueError(
+                    f"handoff chunk {name!r} failed read-back verification "
+                    "(torn write) — aborting this membership attempt"
+                ) from exc
+            if got.get("sig") != graph_sig or got.get("from_rank") != chunk.get(
+                "from_rank"
+            ):
+                raise ValueError(
+                    f"handoff chunk {name!r} read back inconsistent — "
+                    "aborting this membership attempt"
+                )
+            per_dest[dest].append(
+                {"bytes": len(payload), "crc32": zlib.crc32(payload)}
+            )
+            total += len(payload)
+            if chaos is not None and not first_written:
+                first_written = True
+                # chunk_stream_kill: donor dies with a half-written stream —
+                # no manifest exists yet, so the stream reads as absent and
+                # the recovery ladder replays the attempt from scratch
+                chaos.maybe_scale_kill(
+                    rank, "chunk_stream_kill", commit=int(commit_id)
+                )
+        for dest, entries in sorted(per_dest.items()):
+            meta = {
+                "sig": graph_sig,
+                "from_rank": rank,
+                "commit": int(commit_id),
+                "count": len(entries),
+                "chunks": entries,
+            }
+            payload = json.dumps(meta, sort_keys=True).encode()
+            name = self._chunk_manifest_name(commit_id, dest)
+            back = self._write_frag_blob(name, payload)
+            try:
+                got = json.loads(back)
+            except ValueError as exc:
+                raise ValueError(
+                    f"handoff chunk manifest {name!r} failed read-back "
+                    "verification (torn write) — aborting this membership "
+                    "attempt"
+                ) from exc
+            if got.get("count") != len(entries) or got.get("sig") != graph_sig:
+                raise ValueError(
+                    f"handoff chunk manifest {name!r} read back inconsistent "
+                    "— aborting this membership attempt"
+                )
+            total += len(payload)
+        return total
+
     def load_reshard_fragments(
         self, graph_sig: str, commit_id: int, dest: int, from_n: int
     ) -> List[dict]:
-        """Every donor rank's fragment addressed to ``dest`` for the
-        transition at ``commit_id``. Loud on a missing or unreadable
-        fragment: the membership manifest promised the complete set."""
+        """Every donor rank's handoff addressed to ``dest`` for the
+        transition at ``commit_id``, as a list of fragment/chunk dicts. Per
+        donor the CHUNKED stream is preferred (chunk manifest + verified
+        chunks — complete-or-abort); a donor without a chunk manifest falls
+        back to the legacy single gather fragment. Loud on anything missing,
+        torn, or incomplete: the membership manifest promised the complete
+        set."""
+        import zlib
+
         out: List[dict] = []
         for donor in range(from_n):
-            name = self._fragment_name(commit_id, dest)
-            if self._object_store is not None:
-                payload = self._object_store.get(f"process-{donor}/{name}")
-            elif self._memory or self._base_root is None:
-                payload = None
-            else:
-                # membership transitions only exist for sharded stores
-                # (spawn -n >= 2), so donor shards are always process-<r>/
-                shard = os.path.join(str(self._base_root), f"process-{donor}")
+            mf_raw = self._read_donor_blob(
+                donor, self._chunk_manifest_name(commit_id, dest)
+            )
+            if mf_raw is not None:
                 try:
-                    with open(os.path.join(shard, name), "rb") as f:
-                        payload = f.read()
-                except OSError:
-                    payload = None
+                    mf = json.loads(mf_raw)
+                except ValueError as exc:
+                    raise ValueError(
+                        f"handoff chunk manifest from rank {donor} for rank "
+                        f"{dest} at commit {commit_id} is unreadable"
+                    ) from exc
+                if mf.get("sig") != graph_sig:
+                    raise ValueError(
+                        "handoff fragment was written by a different "
+                        "dataflow graph; clear the persistence directory"
+                    )
+                entries = mf.get("chunks") or []
+                if int(mf.get("count", -1)) != len(entries):
+                    raise ValueError(
+                        f"handoff chunk manifest from rank {donor} for rank "
+                        f"{dest} at commit {commit_id} is self-inconsistent"
+                    )
+                for idx, entry in enumerate(entries):
+                    raw = self._read_donor_blob(
+                        donor, self._chunk_name(commit_id, dest, idx)
+                    )
+                    if raw is None or zlib.crc32(raw) != int(
+                        entry.get("crc32", -1)
+                    ):
+                        raise ValueError(
+                            f"handoff chunk {idx} from rank {donor} for rank "
+                            f"{dest} at commit {commit_id} is missing or "
+                            "fails its checksum; the chunk manifest promised "
+                            "the complete stream — restore the store or "
+                            "clear the persistence directory"
+                        )
+                    frag = pickle.loads(raw)
+                    if frag.get("sig") != graph_sig:
+                        raise ValueError(
+                            "handoff fragment was written by a different "
+                            "dataflow graph; clear the persistence directory"
+                        )
+                    out.append(frag)
+                continue
+            payload = self._read_donor_blob(
+                donor, self._fragment_name(commit_id, dest)
+            )
             if payload is None:
                 raise ValueError(
                     f"handoff fragment from rank {donor} for rank {dest} at "

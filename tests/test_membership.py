@@ -263,10 +263,10 @@ def test_health_payload_exposes_membership_fields(tmp_path):
     assert status["membership_committed"] == 2
 
 
-def test_reshard_plan_refuses_join_graphs():
-    """Join arrangements are keyed by a non-output exchange key — this build
-    refuses to reshard them (typed, loud, the run continues at the old
-    size). The refusal is the ROADMAP follow-on marker."""
+def test_reshard_plan_accepts_join_graphs():
+    """Join arrangements now export by join key and join OUTPUT rows are
+    re-exchanged by their output row key, so a join graph plans clean —
+    the refusal that used to live here is gone (ROADMAP item closed)."""
     from pathway_tpu.engine.runner import GraphRunner
     from pathway_tpu.parallel.membership import compute_reshard_plan
 
@@ -290,8 +290,43 @@ def test_reshard_plan_refuses_join_graphs():
             ev.cluster_input_policy(i) for i in range(len(node.inputs))
         )
     plan = compute_reshard_plan(runner)
+    assert plan.ok, plan.refusals
+    join_nids = [n.id for n in runner._nodes if n.kind == "join"]
+    assert join_nids and all(plan.policies[nid] == "bykey" for nid in join_nids)
+    G.clear()
+
+
+def test_reshard_plan_refusal_is_typed_and_structured():
+    """A genuine refusal (join evaluator holding a populated UDF replay memo,
+    which is keyed by pre-exchange row keys) surfaces as BOTH a formatted
+    string and a structured {node, kind, reason} record for /healthz."""
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.parallel.membership import compute_reshard_plan
+
+    G.clear()
+    left = pw.debug.table_from_rows(
+        pw.schema_builder({"k": int, "a": int}), [(1, 10)]
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_builder({"k": int, "b": int}), [(1, 100)]
+    )
+    joined = left.join(right, left.k == right.k).select(left.a, right.b)
+    pw.io.subscribe(joined, lambda *a, **k: None)
+    runner = GraphRunner(G._current)
+    runner.lint_exempt = True
+    runner.run(monitoring_level=pw.MonitoringLevel.NONE, max_commits=3)
+    for node in runner._nodes:
+        ev = runner.evaluators[node.id]
+        ev._cluster_policies = tuple(
+            ev.cluster_input_policy(i) for i in range(len(node.inputs))
+        )
+    join_nid = next(n.id for n in runner._nodes if n.kind == "join")
+    runner.evaluators[join_nid]._udf_memo = {b"stale": 1}
+    plan = compute_reshard_plan(runner)
     assert not plan.ok
-    assert any("join" in r for r in plan.refusals)
+    assert any("memo" in r for r in plan.refusals)
+    assert plan.refused_nodes and plan.refused_nodes[0]["kind"] == "join"
+    assert plan.refused_nodes[0]["node"] == join_nid
     G.clear()
 
 

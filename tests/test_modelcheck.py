@@ -392,6 +392,119 @@ def test_membership_epoch_before_install_bug_caught_and_replayable():
 
 
 # ---------------------------------------------------------------------------
+# universal reshard: join-side state + chunked fragment streams ride the
+# same membership transition (match bookkeeping, complete-or-abort chunks)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.elastic
+@pytest.mark.reshard
+def test_membership_reshard_extension_invariants_hold_exhaustive():
+    # the universal-reshard extension: join build/probe tokens, match
+    # bookkeeping and chunked fragment streams all ride the transition — a
+    # wider slot space forces multi-stream, multi-chunk interleavings
+    t0 = time.monotonic()
+    result = explore(
+        pm.membership_model(2, 3, n_slots=8),
+        max_schedules=N_SCHEDULES,
+        name="member-reshard",
+    )
+    _BATTERY_SECONDS["reshard"] = time.monotonic() - t0
+    assert result.ok, (
+        f"reshard-extension invariant failed on schedule "
+        f"{result.failing_schedule}: {result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.elastic
+@pytest.mark.reshard
+def test_membership_join_row_orphan_bug_caught_and_replayable():
+    # one moved slot's probe-side join rows never make the fragment: the
+    # arrangement re-keys under the new map with its probe side gone
+    result = explore(
+        pm.membership_model(2, 3, bug="join_row_orphan"),
+        max_schedules=300,
+        name="member-join-orphan",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the orphaned join-side rows went undetected"
+    )
+    assert "rows lost" in str(result.failure)
+    assert "jright" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="rows lost"):
+        run_once(
+            pm.membership_model(2, 3, bug="join_row_orphan"),
+            choices=result.failing_schedule,
+        )
+
+
+@pytest.mark.elastic
+@pytest.mark.reshard
+def test_membership_double_match_bug_caught_and_replayable():
+    # match bookkeeping dropped from the fragments: the new owner re-emits
+    # matches the donor already emitted pre-cut
+    result = explore(
+        pm.membership_model(2, 3, bug="double_match"),
+        max_schedules=300,
+        name="member-double-match",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the replayed join match went undetected"
+    )
+    assert "match emitted" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="match emitted"):
+        run_once(
+            pm.membership_model(2, 3, bug="double_match"),
+            choices=result.failing_schedule,
+        )
+
+
+@pytest.mark.elastic
+@pytest.mark.reshard
+def test_membership_torn_chunk_install_bug_caught_with_seed():
+    # a torn chunk stream (chunk durable, manifest never lands) imported by
+    # an installer that skips the complete-or-abort check: rows vanish
+    result = sweep_seeds(
+        pm.membership_model(2, 3, bug="torn_chunk_install"),
+        n_seeds=200,
+        base_seed=61,
+        name="member-torn-chunk",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the torn-chunk partial install went undetected"
+    )
+    assert "rows lost" in str(result.failure)
+    assert result.failing_seed is not None
+    with pytest.raises(InvariantViolation, match="rows lost"):
+        run_once(
+            pm.membership_model(2, 3, bug="torn_chunk_install"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.elastic
+@pytest.mark.reshard
+def test_membership_owner_map_stale_bug_caught_and_replayable():
+    # a donor partitioning with a stale (prior-attempt) ownership map: rows
+    # land on ranks the committed map does not own them to
+    result = explore(
+        pm.membership_model(2, 3, bug="owner_map_stale"),
+        max_schedules=300,
+        name="member-stale-map",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the stale-owner-map partition went undetected"
+    )
+    assert "reside on" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="reside on"):
+        run_once(
+            pm.membership_model(2, 3, bug="owner_map_stale"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
 # tiered IVF index (prefetch staging / background rebuild / generation swap)
 # ---------------------------------------------------------------------------
 
@@ -879,8 +992,8 @@ def test_model_check_battery_within_budget():
     # redone here); each 200-schedule explore is a few seconds solo, and the
     # documented <60 s budget must hold even under full-suite load
     if set(_BATTERY_SECONDS) != {
-        "fence", "ckpt", "encsvc", "membership", "autoscaler", "tiered",
-        "quant", "replica",
+        "fence", "ckpt", "encsvc", "membership", "reshard", "autoscaler",
+        "tiered", "quant", "replica",
     }:
         pytest.skip("acceptance batteries did not run in this session (-k selection)")
     total = sum(_BATTERY_SECONDS.values())

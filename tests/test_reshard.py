@@ -1,0 +1,862 @@
+"""Universal reshardability: join/dedup/key-changing state rides the handoff.
+
+Layers under test:
+
+- the reshard-policy MATRIX: every graph node kind has an explicit entry in
+  ``RESHARD_KIND_POLICIES`` (both directions — no stale entries either), and
+  an undeclared kind refuses loudly instead of guessing;
+- per-evaluator handoff round-trips: join arrangements partition by JOIN
+  key, dedup instances by their OUTPUT key, derived-key nodes (reindex /
+  flatten) compose owners through their provenance maps — each export
+  re-imports exactly and overlapping fragments refuse;
+- bounded transport: ``build_fragment_chunks`` keeps every chunk under the
+  ``PATHWAY_RESHARD_CHUNK_BYTES`` budget with at most one payload per
+  (section, node), and the persistence layer's chunk manifests make streams
+  complete-or-abort (missing/torn/short chunks read as ABSENT, never a
+  partial install);
+- chaos: the three new scale plan ops (``join_handoff_torn``,
+  ``dedup_install_kill``, ``chunk_stream_kill``) gate correctly and the
+  spawn acceptances recover down the ladder with exact output;
+- spawn acceptance: a LIVE join+groupby+dedup+reindex graph scaled
+  2 -> 4 -> 2 under ingestion, final output bit-identical to a static run,
+  with ZERO preflight refusals;
+- drift audit: every chaos plan op has a CHAOS.md row and vice versa.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.chaos import PLAN_OPS, Chaos
+from pathway_tpu.internals.keys import KEY_DTYPE, shard_of
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.parallel.membership import (
+    RESHARD_KIND_POLICIES,
+    ReshardPlan,
+    _approx_nbytes,
+    _owner_fn_derived,
+    build_fragment_chunks,
+    compute_reshard_plan,
+    reshard_chunk_bytes,
+)
+from tests.test_membership import (
+    _await_counts,
+    _port_base,
+    _spawn_elastic,
+    _terminate_group,
+    _write_files,
+)
+
+pytestmark = [pytest.mark.reshard, pytest.mark.elastic]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _all_graph_kinds() -> set:
+    from pathway_tpu.internals import parse_graph as pg
+
+    kinds = set()
+    stack = list(pg.Node.__subclasses__())
+    while stack:
+        cls = stack.pop()
+        stack.extend(cls.__subclasses__())
+        if cls.kind != "node":
+            kinds.add(cls.kind)
+    return kinds
+
+
+# -- the policy matrix ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", sorted(_all_graph_kinds()))
+def test_every_node_kind_declares_a_reshard_policy(kind):
+    """THE matrix: a graph kind without an explicit policy entry would be a
+    silent guess at handoff time — every kind must be declared."""
+    assert kind in RESHARD_KIND_POLICIES, (
+        f"node kind {kind!r} has no entry in RESHARD_KIND_POLICIES — a new "
+        "evaluator must declare how its state rides the membership handoff"
+    )
+    assert RESHARD_KIND_POLICIES[kind] in (
+        "source", "root", "bykey", "derived", "replicate", "inherit",
+    )
+
+
+def test_policy_table_has_no_stale_entries():
+    """Both directions: an entry for a kind that no longer exists is dead
+    configuration that would mask a rename."""
+    stale = set(RESHARD_KIND_POLICIES) - _all_graph_kinds()
+    assert not stale, f"RESHARD_KIND_POLICIES names unknown kinds: {stale}"
+
+
+def test_undeclared_kind_refuses_loudly():
+    """A node kind absent from the table is a typed refusal naming the fix,
+    never a silent placement guess."""
+    class _MysteryNode:
+        # deliberately NOT a pg.Node subclass: subclassing would register the
+        # fake kind process-wide and pollute the matrix tests above
+        id = 7
+        kind = "quantum_sort"
+        inputs = ()
+        config: dict = {}
+
+    node = _MysteryNode()
+
+    class _Runner:
+        _nodes = [node]
+        evaluators: dict = {}
+
+    plan = compute_reshard_plan(_Runner())
+    assert not plan.ok
+    assert "quantum_sort" in plan.refusals[0]
+    assert "RESHARD_KIND_POLICIES" in plan.refusals[0]
+    assert plan.refused_nodes[0]["kind"] == "quantum_sort"
+    # the table itself never learns about it implicitly
+    assert "quantum_sort" not in RESHARD_KIND_POLICIES
+
+
+def test_reshard_plan_positional_compat():
+    """Older call sites build ReshardPlan(policies, refusals) positionally;
+    the structured fields default empty."""
+    plan = ReshardPlan({1: "bykey"}, [])
+    assert plan.ok and plan.refused_nodes == [] and plan.derived_base == {}
+
+
+# -- join-side handoff ---------------------------------------------------------
+
+
+def _join_runner(left_rows, right_rows):
+    from pathway_tpu.engine.runner import GraphRunner
+
+    G.clear()
+    left = pw.debug.table_from_rows(
+        pw.schema_builder({"k": int, "a": int}), left_rows
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_builder({"k": int, "b": int}), right_rows
+    )
+    joined = left.join(right, left.k == right.k).select(left.a, right.b)
+    pw.io.subscribe(joined, lambda *a, **kw: None)
+    runner = GraphRunner(G._current)
+    runner.lint_exempt = True
+    runner.run(monitoring_level=pw.MonitoringLevel.NONE, max_commits=3)
+    nid = next(n.id for n in runner._nodes if n.kind == "join")
+    return runner, nid
+
+
+def test_join_reshard_export_import_roundtrip():
+    """Both arrangements partition by JOIN key and a fresh evaluator
+    rebuilds from the payloads exactly (row-for-row, both sides)."""
+    runner, nid = _join_runner(
+        [(1, 10), (2, 20), (3, 30)], [(1, 100), (2, 200)]
+    )
+    ev = runner.evaluators[nid]
+    assert ev.reshard_check() is None
+    owner = lambda keys: shard_of(keys, 2)  # noqa: E731
+    exports = ev.reshard_export(owner, 2)
+    total_left = sum(len(p.get("left", {"keys": []})["keys"])
+                     for p in exports.values() if "left" in p)
+    total_right = sum(len(p.get("right", {"keys": []})["keys"])
+                      for p in exports.values() if "right" in p)
+    assert total_left == 3 and total_right == 2
+    # a row's destination is decided by its JOIN key, not its row key
+    for payload in exports.values():
+        for side in ("left", "right"):
+            if side in payload:
+                dests = set(int(d) for d in shard_of(payload[side]["jk"], 2))
+                assert len(dests) == 1
+    fresh_runner, fresh_nid = _join_runner([], [])
+    fresh = fresh_runner.evaluators[fresh_nid]
+    for payload in exports.values():
+        fresh.reshard_import(payload)
+    fk, _ = fresh.left.row_index.items()
+    assert len(fk) == 3
+    fk, _ = fresh.right.row_index.items()
+    assert len(fk) == 2
+    # overlapping fragments (same row key twice) refuse, never merge
+    with pytest.raises(RuntimeError, match="overlap"):
+        for payload in exports.values():
+            fresh.reshard_import(payload)
+    G.clear()
+
+
+def test_join_reshard_export_parts_slices_bounded():
+    """The chunked export yields the SAME partition as the full export, in
+    pieces no larger than the row budget."""
+    runner, nid = _join_runner(
+        [(i, i * 10) for i in range(8)], [(i, i * 100) for i in range(5)]
+    )
+    ev = runner.evaluators[nid]
+    owner = lambda keys: shard_of(keys, 2)  # noqa: E731
+    pieces = list(ev.reshard_export_parts(owner, 2, 3))
+    assert all(
+        len(piece[side]["keys"]) <= 3
+        for _, piece in pieces
+        for side in piece
+    )
+    got_rows = sum(
+        len(piece[side]["keys"]) for _, piece in pieces for side in piece
+    )
+    assert got_rows == 13  # 8 left + 5 right, nothing lost or duplicated
+    fresh_runner, fresh_nid = _join_runner([], [])
+    fresh = fresh_runner.evaluators[fresh_nid]
+    for _dest, piece in pieces:
+        fresh.reshard_import(piece)
+    fk, _ = fresh.left.row_index.items()
+    assert len(fk) == 8
+    fk, _ = fresh.right.row_index.items()
+    assert len(fk) == 5
+    G.clear()
+
+
+def test_join_refuses_with_populated_replay_memo():
+    runner, nid = _join_runner([(1, 10)], [(1, 100)])
+    ev = runner.evaluators[nid]
+    ev._udf_memo = {b"k": 1}
+    assert ev.reshard_check() is not None
+    from pathway_tpu.parallel.membership import MembershipUnsupportedError
+
+    with pytest.raises(MembershipUnsupportedError):
+        ev.reshard_export(lambda keys: shard_of(keys, 2), 2)
+    G.clear()
+
+
+# -- dedup handoff -------------------------------------------------------------
+
+
+def _dedup_runner(rows):
+    from pathway_tpu.engine.runner import GraphRunner
+
+    G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"word": str, "score": int}), rows
+    )
+    best = t.deduplicate(
+        value=t.score, instance=t.word, acceptor=lambda new, old: new >= old
+    )
+    pw.io.subscribe(best, lambda *a, **kw: None)
+    runner = GraphRunner(G._current)
+    runner.lint_exempt = True
+    runner.run(monitoring_level=pw.MonitoringLevel.NONE, max_commits=3)
+    nid = next(n.id for n in runner._nodes if n.kind == "deduplicate")
+    return runner, nid
+
+
+def test_dedup_reshard_roundtrip_by_output_key():
+    runner, nid = _dedup_runner(
+        [("cat", 3), ("dog", 5), ("owl", 1), ("cat", 7)]
+    )
+    ev = runner.evaluators[nid]
+    assert len(ev.current) == 3 and len(ev._okeys) == 3
+    assert ev.reshard_check() is None
+    owner = lambda keys: shard_of(keys, 2)  # noqa: E731
+    exports = ev.reshard_export(owner, 2)
+    assert sum(len(p["current"]) for p in exports.values()) == 3
+    # destinations follow the recorded OUTPUT key, not the instance repr
+    for dest, payload in exports.items():
+        for kb in payload["okeys"].values():
+            assert int(shard_of(np.frombuffer(kb, dtype=KEY_DTYPE), 2)[0]) == dest
+    fresh_runner, fresh_nid = _dedup_runner([])
+    fresh = fresh_runner.evaluators[fresh_nid]
+    for payload in exports.values():
+        fresh.reshard_import(payload)
+    assert fresh.current == ev.current
+    assert fresh._okeys == ev._okeys
+    with pytest.raises(RuntimeError, match="overlap"):
+        fresh.reshard_import(next(iter(exports.values())))
+    G.clear()
+
+
+def test_dedup_export_parts_carry_matching_okeys():
+    runner, nid = _dedup_runner([(f"w{i}", i) for i in range(9)])
+    ev = runner.evaluators[nid]
+    owner = lambda keys: shard_of(keys, 3)  # noqa: E731
+    pieces = list(ev.reshard_export_parts(owner, 3, 2))
+    assert all(len(p["current"]) <= 2 for _, p in pieces)
+    assert sum(len(p["current"]) for _, p in pieces) == 9
+    for _dest, p in pieces:
+        assert set(p["current"]) == set(p["okeys"])
+    G.clear()
+
+
+def test_dedup_pre_upgrade_state_refuses():
+    """Instances restored without the output-key sidecar cannot be placed —
+    the preflight refuses instead of guessing from the instance repr."""
+    runner, nid = _dedup_runner([("cat", 3), ("dog", 5)])
+    ev = runner.evaluators[nid]
+    ev._okeys.popitem()
+    reason = ev.reshard_check()
+    assert reason is not None and "output-key tracking" in reason
+    G.clear()
+
+
+# -- derived-key handoff (reindex / flatten provenance) ------------------------
+
+
+def test_reshard_plan_marks_reindex_derived():
+    from pathway_tpu.engine.runner import GraphRunner
+
+    G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_builder({"word": str}), [("cat",), ("dog",)]
+    )
+    counts = t.groupby(t.word).reduce(t.word, total=pw.reducers.count())
+    renamed = counts.with_id_from(counts.word)
+    pw.io.subscribe(renamed, lambda *a, **kw: None)
+    runner = GraphRunner(G._current)
+    runner.lint_exempt = True
+    runner.run(monitoring_level=pw.MonitoringLevel.NONE, max_commits=3)
+    for node in runner._nodes:
+        ev = runner.evaluators[node.id]
+        ev._cluster_policies = tuple(
+            ev.cluster_input_policy(i) for i in range(len(node.inputs))
+        )
+    plan = compute_reshard_plan(runner)
+    assert plan.ok, plan.refusals
+    reindex_nid = next(n.id for n in runner._nodes if n.kind == "reindex")
+    assert plan.policies[reindex_nid] == f"derived:{reindex_nid}"
+    assert plan.derived_base[reindex_nid] == "bykey"
+    G.clear()
+
+
+def test_owner_fn_derived_composes_through_provenance():
+    """A derived key's owner is its provenance source's owner; unmapped keys
+    fall through to the base hash unchanged."""
+    from pathway_tpu.internals.keys import sequential_keys
+
+    src = sequential_keys(100, 4)
+    derived = sequential_keys(500, 4)
+
+    class _Ev:
+        _reshard_prov = {
+            derived[i].tobytes(): src[i].tobytes() for i in range(3)
+        }
+
+    owner = _owner_fn_derived(_Ev(), lambda keys: shard_of(keys, 4))
+    got = np.asarray(owner(derived))
+    want_mapped = shard_of(src, 4)
+    want_raw = shard_of(derived, 4)
+    assert (got[:3] == want_mapped[:3]).all()
+    assert got[3] == want_raw[3]  # no provenance entry: base hash decides
+
+
+def test_flatten_tracks_provenance_under_cluster():
+    from pathway_tpu.engine.columnar import Delta
+    from pathway_tpu.engine.runner import GraphRunner
+    from pathway_tpu.internals.keys import sequential_keys
+
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_builder({"xs": str}), [("ab",)])
+    flat = t.flatten(t.xs)
+    pw.io.subscribe(flat, lambda *a, **kw: None)
+    runner = GraphRunner(G._current)
+    runner.lint_exempt = True
+    runner.run(monitoring_level=pw.MonitoringLevel.NONE, max_commits=2)
+    ev = runner.evaluators[flat._node.id]
+    assert ev._reshard_prov == {}  # single-process: nothing tracked
+    runner._cluster = object()  # the gate is all process() consults
+    keys = sequential_keys(900, 2)
+    delta = Delta(
+        keys,
+        np.ones(2, dtype=np.int64),
+        {"xs": np.array(["ab", "c"], dtype=object)},
+    )
+    out = ev.process([delta])
+    assert len(out) == 3  # "ab" -> a,b ; "c" -> c
+    assert len(ev._reshard_prov) == 3
+    srcs = set(ev._reshard_prov.values())
+    assert srcs == {keys[0].tobytes(), keys[1].tobytes()}
+    runner._cluster = None
+    G.clear()
+
+
+# -- bounded transport: chunk build + manifest round-trips ---------------------
+
+
+def test_reshard_chunk_bytes_env_knob(monkeypatch):
+    from pathway_tpu.parallel.membership import DEFAULT_RESHARD_CHUNK_BYTES
+
+    monkeypatch.delenv("PATHWAY_RESHARD_CHUNK_BYTES", raising=False)
+    assert reshard_chunk_bytes() == DEFAULT_RESHARD_CHUNK_BYTES
+    monkeypatch.setenv("PATHWAY_RESHARD_CHUNK_BYTES", "4096")
+    assert reshard_chunk_bytes() == 4096
+    monkeypatch.setenv("PATHWAY_RESHARD_CHUNK_BYTES", "garbage")
+    assert reshard_chunk_bytes() == DEFAULT_RESHARD_CHUNK_BYTES
+    monkeypatch.setenv("PATHWAY_RESHARD_CHUNK_BYTES", "-1")
+    assert reshard_chunk_bytes() == DEFAULT_RESHARD_CHUNK_BYTES
+
+
+def test_approx_nbytes_estimates():
+    assert _approx_nbytes(np.zeros(8, dtype=np.int64)) == 64
+    assert _approx_nbytes(b"abcd") == 4
+    assert _approx_nbytes({"k": b"abcd"}) >= 4
+    assert _approx_nbytes(None) == 8
+    assert _approx_nbytes(object()) == 64
+
+
+def _plan_for(runner) -> ReshardPlan:
+    for node in runner._nodes:
+        ev = runner.evaluators[node.id]
+        ev._cluster_policies = tuple(
+            ev.cluster_input_policy(i) for i in range(len(node.inputs))
+        )
+    plan = compute_reshard_plan(runner)
+    assert plan.ok, plan.refusals
+    return plan
+
+
+def test_build_fragment_chunks_bounded_and_disjoint():
+    """Chunks respect the byte budget (small budget => many chunks), carry
+    at most one payload per (section, node), name the kinds aboard, and the
+    full set re-imports into a fresh runner exactly."""
+    from tests.test_membership import _groupby_runner
+
+    rows = [f"w{i % 7}" for i in range(40)]
+    runner, nid = _groupby_runner(rows)
+    plan = _plan_for(runner)
+    # chunk_bytes=1: every piece seals its own chunk, so the chunk count is
+    # the piece count — the budget is genuinely per-chunk, not per-stream
+    chunk_iter, stats = build_fragment_chunks(
+        runner, plan, 2, commit=5, generation=1, chunk_bytes=1
+    )
+    chunks = list(chunk_iter)
+    assert stats["chunks"] == len(chunks) >= 3
+    dests = {d for d, _ in chunks}
+    assert dests == {0, 1}  # every destination gets at least one chunk
+    for _dest, chunk in chunks:
+        assert chunk["format"] == 1
+        n_payloads = sum(
+            len(chunk[s])
+            for s in ("states", "evals", "evals_full", "evals_rebuild",
+                      "source_offsets", "source_deltas")
+        )
+        assert n_payloads <= 1  # sealed per piece under this budget
+        if chunk["evals"].get(nid) or chunk["states"].get(nid):
+            assert "groupby" in chunk["kinds"]
+    # the union re-imports into fresh evaluators bit-exactly
+    from pathway_tpu.parallel.membership import import_fragments
+
+    fresh_runner, fresh_nid = _groupby_runner([])
+    import_fragments(fresh_runner, [c for _d, c in chunks])
+    gkeys, _ = fresh_runner.evaluators[fresh_nid].gindex.items()
+    src_gkeys, _ = runner.evaluators[nid].gindex.items()
+    assert len(gkeys) == len(src_gkeys) == 7
+    G.clear()
+
+
+def test_chunk_dump_load_roundtrip(tmp_path, monkeypatch):
+    """The persistence layer writes read-back-verified chunks + a per-dest
+    manifest; the loader verifies count + crc32 and returns the chunks; a
+    torn or missing chunk makes the WHOLE stream read as absent (ValueError)
+    — complete-or-abort."""
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    cfg = pw.persistence.Config(
+        pw.persistence.Backend.filesystem(tmp_path / "store")
+    )
+    pm = PersistenceManager(cfg)
+
+    def mk(dest, nid, rows):
+        return dest, {
+            "format": 1, "from_rank": 0, "commit": 9, "generation": 1,
+            "states": {}, "evals": {nid: {"rows": rows}}, "evals_full": {},
+            "evals_rebuild": {}, "source_offsets": {}, "source_deltas": {},
+            "kinds": ["groupby"],
+        }
+
+    total = pm.dump_reshard_chunks(
+        "sig", 9, iter([mk(1, 4, list(range(50))), mk(1, 5, list(range(50)))])
+    )
+    assert total > 0
+    frags = pm.load_reshard_fragments("sig", 9, dest=1, from_n=1)
+    assert len(frags) == 2
+    assert frags[0]["evals"][4]["rows"] == list(range(50))
+    # wrong graph signature refuses
+    with pytest.raises(ValueError, match="different"):
+        pm.load_reshard_fragments("other-sig", 9, dest=1, from_n=1)
+    # torn chunk: checksum fails, the whole stream is refused
+    shard = tmp_path / "store" / "process-0" / "reshard-0000000009"
+    chunk0 = shard / "frag-00001.c0000.pkl"
+    chunk0.write_bytes(chunk0.read_bytes()[:10])
+    with pytest.raises(ValueError, match="checksum"):
+        pm.load_reshard_fragments("sig", 9, dest=1, from_n=1)
+    # missing chunk: same refusal
+    chunk0.unlink()
+    with pytest.raises(ValueError, match="missing or fails"):
+        pm.load_reshard_fragments("sig", 9, dest=1, from_n=1)
+    # no manifest at all falls back to legacy, which is also absent => loud
+    (shard / "frag-00001.mf").unlink()
+    with pytest.raises(ValueError, match="missing"):
+        pm.load_reshard_fragments("sig", 9, dest=1, from_n=1)
+
+
+def test_empty_handoff_distinguished_from_torn_write(tmp_path, monkeypatch):
+    """A donor with nothing addressed to a dest still writes a manifest (an
+    empty stream), so the loader can tell 'empty handoff' from 'torn
+    write'."""
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    cfg = pw.persistence.Config(
+        pw.persistence.Backend.filesystem(tmp_path / "store")
+    )
+    pm = PersistenceManager(cfg)
+    empty = {
+        "format": 1, "from_rank": 0, "commit": 3, "generation": 1,
+        "states": {}, "evals": {}, "evals_full": {}, "evals_rebuild": {},
+        "source_offsets": {}, "source_deltas": {}, "kinds": [],
+    }
+    pm.dump_reshard_chunks("sig", 3, iter([(1, empty)]))
+    frags = pm.load_reshard_fragments("sig", 3, dest=1, from_n=1)
+    assert len(frags) == 1 and frags[0]["evals"] == {}
+
+
+# -- chaos: the three new scale ops --------------------------------------------
+
+
+def test_new_scale_ops_gate_on_attempt(monkeypatch):
+    monkeypatch.setenv("PATHWAY_RESTART_COUNT", "0")
+    plan = {
+        "scale": [
+            {"op": "join_handoff_torn", "rank": 0, "at": 0},
+            {"op": "dedup_install_kill", "rank": 1},
+            {"op": "chunk_stream_kill", "rank": 0, "at": 1},
+        ]
+    }
+    c = Chaos(0, plan)
+    c.begin_scale_attempt()  # attempt 0
+    assert c.scale_fault("join_handoff_torn", 0) is True
+    assert c.scale_fault("join_handoff_torn", 1) is False
+    assert c.scale_fault("chunk_stream_kill", 0) is False  # at: 1
+    c.begin_scale_attempt()  # attempt 1
+    assert c.scale_fault("join_handoff_torn", 0) is False
+    assert c.scale_fault("chunk_stream_kill", 0) is True
+    assert c.scale_fault("dedup_install_kill", 1) is True  # every attempt
+
+
+def test_chunk_stream_kill_fires_after_first_chunk(tmp_path, monkeypatch):
+    """The donor dies after its FIRST chunk write, before any manifest — the
+    surviving store has chunks but no manifest, so the loader refuses."""
+    from pathway_tpu.internals import chaos as chaos_mod
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    killed: list = []
+    monkeypatch.setattr(
+        chaos_mod.os, "kill", lambda pid, sig: killed.append((pid, sig))
+    )
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    monkeypatch.setenv(
+        "PATHWAY_CHAOS_PLAN",
+        json.dumps({"scale": [{"op": "chunk_stream_kill", "rank": 0, "at": 0}]}),
+    )
+    monkeypatch.setenv("PATHWAY_RESTART_COUNT", "0")
+    from pathway_tpu.internals.chaos import get_chaos, reset_chaos
+
+    reset_chaos()
+    try:
+        chaos = get_chaos()
+        assert chaos is not None
+        chaos.begin_scale_attempt()
+        cfg = pw.persistence.Config(
+            pw.persistence.Backend.filesystem(tmp_path / "store")
+        )
+        pm = PersistenceManager(cfg)
+        chunk = {
+            "format": 1, "from_rank": 0, "commit": 2, "generation": 1,
+            "states": {}, "evals": {1: {"x": 1}}, "evals_full": {},
+            "evals_rebuild": {}, "source_offsets": {}, "source_deltas": {},
+            "kinds": ["join"],
+        }
+        pm.dump_reshard_chunks("sig", 2, iter([(1, chunk), (1, dict(chunk))]))
+        assert killed and killed[0][1] == signal.SIGKILL
+    finally:
+        monkeypatch.delenv("PATHWAY_CHAOS_PLAN")
+        reset_chaos()
+
+
+def test_join_handoff_torn_fails_readback(tmp_path, monkeypatch):
+    """A torn join chunk fails the dump's read-back verification loudly —
+    the attempt aborts before any manifest promises the stream."""
+    from pathway_tpu.persistence.engine import PersistenceManager
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    monkeypatch.setenv(
+        "PATHWAY_CHAOS_PLAN",
+        json.dumps({"scale": [{"op": "join_handoff_torn", "rank": 0, "at": 0}]}),
+    )
+    monkeypatch.setenv("PATHWAY_RESTART_COUNT", "0")
+    from pathway_tpu.internals.chaos import get_chaos, reset_chaos
+
+    reset_chaos()
+    try:
+        get_chaos().begin_scale_attempt()
+        cfg = pw.persistence.Config(
+            pw.persistence.Backend.filesystem(tmp_path / "store")
+        )
+        pm = PersistenceManager(cfg)
+        join_chunk = {
+            "format": 1, "from_rank": 0, "commit": 2, "generation": 1,
+            "states": {}, "evals": {1: {"left": {}}}, "evals_full": {},
+            "evals_rebuild": {}, "source_offsets": {}, "source_deltas": {},
+            "kinds": ["join"],
+        }
+        with pytest.raises(ValueError, match="read-back"):
+            pm.dump_reshard_chunks("sig", 2, iter([(0, join_chunk)]))
+        # a chunk with NO join state aboard is untouched by this op
+        plain = dict(join_chunk)
+        plain["kinds"] = ["groupby"]
+        reset_chaos()
+        get_chaos().begin_scale_attempt()
+        assert pm.dump_reshard_chunks("sig", 3, iter([(0, plain)])) > 0
+    finally:
+        monkeypatch.delenv("PATHWAY_CHAOS_PLAN")
+        reset_chaos()
+
+
+# -- CHAOS.md drift audit ------------------------------------------------------
+
+
+def test_chaos_md_documents_every_plan_op_both_ways():
+    """Every op in ``PLAN_OPS`` has a CHAOS.md table row under its plan key,
+    and every documented op row names a registered op — the reference doc
+    cannot drift from the injection registry in either direction."""
+    text = open(os.path.join(REPO, "CHAOS.md")).read()
+    documented: dict = {}
+    current = None
+    for line in text.splitlines():
+        m = re.match(r"^### `(\w+)`", line)
+        if m:
+            current = m.group(1)
+            continue
+        m = re.match(r"^\| `([a-z0-9_]+)` \|", line)
+        if m and current is not None:
+            documented.setdefault(current, set()).add(m.group(1))
+    for key, ops in PLAN_OPS.items():
+        assert key in documented, f"CHAOS.md has no op table for plan key {key!r}"
+        missing = set(ops) - documented[key]
+        assert not missing, f"CHAOS.md is missing {key} rows for: {missing}"
+        stale = documented[key] - set(ops)
+        assert not stale, f"CHAOS.md documents unregistered {key} ops: {stale}"
+    stray = set(documented) - set(PLAN_OPS)
+    assert not stray, f"CHAOS.md op tables for unknown plan keys: {stray}"
+
+
+# -- spawn acceptance: the universal graph rides the scale ---------------------
+
+UNIVERSAL_PROG = textwrap.dedent(
+    """
+    import json, os
+    import pathway_tpu as pw
+
+    tmp = os.environ["PATHWAY_TPU_TEST_DIR"]
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+    class WordSchema(pw.Schema):
+        word: str
+
+    t = pw.io.fs.read(
+        os.path.join(tmp, "in"), format="csv", schema=WordSchema,
+        mode="streaming",
+    )
+    counts = t.groupby(t.word).reduce(t.word, total=pw.reducers.count())
+    joined = t.join(counts, t.word == counts.word).select(
+        t.word, total=counts.total
+    )
+    best = joined.deduplicate(
+        value=joined.total, instance=joined.word,
+        acceptor=lambda new, old: new >= old,
+    )
+    final = best.with_id_from(best.word)
+
+    out_path = os.path.join(tmp, f"out_{pid}.json")
+    rows = {}
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[repr(key)] = {"word": row["word"], "total": int(row["total"])}
+        else:
+            rows.pop(repr(key), None)
+        with open(out_path + ".tmp", "w") as f:
+            json.dump(list(rows.values()), f)
+        os.replace(out_path + ".tmp", out_path)
+
+    pw.io.subscribe(final, on_change)
+    cfg = pw.persistence.Config(
+        pw.persistence.Backend.filesystem(os.path.join(tmp, "store"))
+    )
+    pw.run(persistence_config=cfg, monitoring_level=pw.MonitoringLevel.NONE)
+    """
+)
+
+
+def _spawn_universal(tmp_path, first_port, **kw):
+    """The join+groupby+dedup+reindex pipeline under the elastic spawner."""
+    import tests.test_membership as tm
+
+    saved = tm.ELASTIC_PROG
+    tm.ELASTIC_PROG = UNIVERSAL_PROG
+    try:
+        return _spawn_elastic(tmp_path, first_port, **kw)
+    finally:
+        tm.ELASTIC_PROG = saved
+
+
+def _universal_static_counts(tmp_path) -> dict:
+    """Reference: the same dataflow run statically in one process."""
+    G.clear()
+
+    class WordSchema(pw.Schema):
+        word: str
+
+    t = pw.io.fs.read(
+        str(tmp_path / "in"), format="csv", schema=WordSchema, mode="static"
+    )
+    counts = t.groupby(t.word).reduce(t.word, total=pw.reducers.count())
+    joined = t.join(counts, t.word == counts.word).select(
+        t.word, total=counts.total
+    )
+    best = joined.deduplicate(
+        value=joined.total, instance=joined.word,
+        acceptor=lambda new, old: new >= old,
+    )
+    final = best.with_id_from(best.word)
+    rows: dict = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[key] = {"word": row["word"], "total": int(row["total"])}
+        else:
+            rows.pop(key, None)
+
+    pw.io.subscribe(final, on_change)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    G.clear()
+    return {r["word"]: r["total"] for r in rows.values()}
+
+
+@pytest.mark.chaos
+def test_elastic_universal_graph_grow_shrink_exact(tmp_path):
+    """THE tentpole acceptance: a LIVE join+groupby+dedup+reindex graph
+    scaled n=2 -> 4 -> 2 under ingestion with ZERO preflight refusals,
+    final output bit-identical to the static run. The graph that used to
+    refuse the scale now rides it."""
+    (tmp_path / "in").mkdir()
+    first_port = _port_base()
+    _write_files(tmp_path, "a", {
+        "0": ["cat"] * 3 + ["dog"] * 2,
+        "1": ["cat"] * 2 + ["owl"] * 1,
+        "2": ["dog"] * 4,
+    })
+    scale_plan = [
+        {"after_commit": 4, "n": 4},
+        {"after_commit": 14, "n": 2},
+    ]
+    proc = _spawn_universal(tmp_path, first_port, n=2, scale_plan=scale_plan)
+    err = ""
+    try:
+        time.sleep(8)  # grow window
+        _write_files(tmp_path, "b", {
+            "0": ["fox"] * 3 + ["cat"] * 2,
+            "1": ["owl"] * 2,
+        })
+        time.sleep(8)  # shrink window
+        _write_files(tmp_path, "c", {"0": ["cat"] * 1 + ["bee"] * 2})
+        expected = {"cat": 8, "dog": 6, "owl": 3, "fox": 3, "bee": 2}
+        merged = _await_counts(proc, tmp_path, 4, expected)
+        assert merged == expected, f"got {merged}, want {expected}"
+    finally:
+        err = _terminate_group(proc)
+    assert "membership change complete: cluster is n=4" in err, (
+        f"grow transition never completed:\n{err}"
+    )
+    assert "membership change complete: cluster is n=2" in err, (
+        f"shrink transition never completed:\n{err}"
+    )
+    assert "REFUSED" not in err, f"the scale was refused:\n{err}"
+    assert "restarting the cluster" not in err, (
+        f"a transition fell back to restart-all:\n{err}"
+    )
+    assert _universal_static_counts(tmp_path) == merged
+
+
+@pytest.mark.chaos
+def test_elastic_join_handoff_torn_retries_exact(tmp_path):
+    """Chaos: the first attempt tears a chunk carrying join state. Read-back
+    fails the ack barrier, the attempt aborts cleanly, the retry completes —
+    output exact, no restart-all."""
+    (tmp_path / "in").mkdir()
+    first_port = _port_base()
+    _write_files(tmp_path, "a", {
+        "0": ["cat"] * 3 + ["dog"] * 2,
+        "1": ["owl"] * 2,
+    })
+    plan = {"scale": [{"op": "join_handoff_torn", "rank": 0, "at": 0, "run": 0}]}
+    proc = _spawn_universal(
+        tmp_path, first_port, n=2,
+        scale_plan=[{"after_commit": 4, "n": 3}],
+        plan=plan, max_restarts=2,
+    )
+    err = ""
+    try:
+        time.sleep(8)
+        _write_files(tmp_path, "b", {"0": ["fox"] * 3})
+        expected = {"cat": 3, "dog": 2, "owl": 2, "fox": 3}
+        merged = _await_counts(proc, tmp_path, 3, expected)
+        assert merged == expected, f"got {merged}, want {expected}"
+    finally:
+        err = _terminate_group(proc)
+    assert "aborted (transient" in err or "will retry" in err, (
+        f"the torn join chunk never aborted an attempt:\n{err}"
+    )
+    assert "membership change complete: cluster is n=3" in err, (
+        f"the retry never completed the transition:\n{err}"
+    )
+    assert "restarting the cluster" not in err, (
+        f"the torn join chunk escalated to restart-all:\n{err}"
+    )
+
+
+@pytest.mark.chaos
+def test_elastic_dedup_install_kill_recovers_exact(tmp_path):
+    """Chaos: a rank is SIGKILLed right before it applies a chunk carrying
+    dedup instance state (post-manifest install window). The ladder recovers
+    — restart-all at the committed topology — and the output stays exact."""
+    (tmp_path / "in").mkdir()
+    first_port = _port_base()
+    _write_files(tmp_path, "a", {
+        "0": ["cat"] * 2 + ["dog"] * 1,
+        "1": ["owl"] * 2,
+    })
+    plan = {"scale": [{"op": "dedup_install_kill", "rank": 1, "run": 0, "at": 0}]}
+    proc = _spawn_universal(
+        tmp_path, first_port, n=2,
+        scale_plan=[{"after_commit": 4, "n": 3}],
+        plan=plan, max_restarts=3,
+        extra_env={"PATHWAY_MEMBERSHIP_DEADLINE_S": "20",
+                   "PATHWAY_CONNECT_TIMEOUT_S": "8",
+                   "PATHWAY_FENCE_TIMEOUT_S": "12"},
+    )
+    err = ""
+    try:
+        time.sleep(14)
+        _write_files(tmp_path, "b", {"0": ["fox"] * 2})
+        expected = {"cat": 2, "dog": 1, "owl": 2, "fox": 2}
+        merged = _await_counts(proc, tmp_path, 3, expected)
+        assert merged == expected, f"got {merged}, want {expected}"
+    finally:
+        err = _terminate_group(proc)
+    assert "restarting the cluster" in err, (
+        f"the dedup install kill did not recover via the ladder:\n{err}"
+    )
