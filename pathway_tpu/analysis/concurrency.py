@@ -75,6 +75,7 @@ RUNTIME_MODULES: Tuple[str, ...] = (
     "pathway_tpu/ops/knn_quant.py",
     "pathway_tpu/engine/http_server.py",
     "pathway_tpu/engine/telemetry.py",
+    "pathway_tpu/engine/tracing.py",
     "pathway_tpu/internals/sched.py",
     "pathway_tpu/internals/protocol_models.py",
 )

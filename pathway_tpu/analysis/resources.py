@@ -1134,11 +1134,19 @@ class TelemetryContractPass(ResourcePass):
     title = "unregistered telemetry namespace"
 
     def run(self, ctx: ResourceAnalysisContext) -> List[Diagnostic]:
-        from pathway_tpu.engine.telemetry import FLIGHT_EVENT_KINDS, STAGE_NAMESPACES
+        from pathway_tpu.engine.telemetry import (
+            FLIGHT_EVENT_KINDS,
+            STAGE_NAMESPACES,
+            TRACE_SPAN_KINDS,
+        )
 
         out: List[Diagnostic] = []
         for ref in _iter_funcs(ctx):
-            out.extend(self._check_function(ref, STAGE_NAMESPACES, FLIGHT_EVENT_KINDS))
+            out.extend(
+                self._check_function(
+                    ref, STAGE_NAMESPACES, FLIGHT_EVENT_KINDS, TRACE_SPAN_KINDS
+                )
+            )
         # module-level calls (rare) ride the module "function"
         return out
 
@@ -1187,6 +1195,7 @@ class TelemetryContractPass(ResourcePass):
         ref: _FuncRef,
         namespaces: Tuple[str, ...],
         event_kinds: "frozenset[str]",
+        trace_kinds: "frozenset[str]" = frozenset(),
     ) -> List[Diagnostic]:
         out: List[Diagnostic] = []
         many_vars: Set[str] = set()
@@ -1233,6 +1242,31 @@ class TelemetryContractPass(ResourcePass):
                             "it — register the kind or fix the name",
                             module=ref.module, lineno=sub.lineno,
                             function=ref.qual, event=head,
+                        )
+                        if d is not None:
+                            out.append(d)
+                elif (
+                    callee in ("trace_span", "record_span", "start")
+                    and trace_kinds
+                    and sub.args
+                    and isinstance(sub.args[0], ast.Constant)
+                    and isinstance(sub.args[0].value, str)
+                ):
+                    # span kinds are closed-set literals: the merger and the
+                    # critical-path walk key on them. ``.start`` is scoped to
+                    # literal-string first args, so Thread.start() (no args)
+                    # never matches
+                    kind_lit = sub.args[0].value
+                    if kind_lit not in trace_kinds:
+                        d = self.diag(
+                            Severity.ERROR,
+                            f"trace span kind {kind_lit!r} in {ref.qual} is "
+                            "not in telemetry.TRACE_SPAN_KINDS: the trace "
+                            "merger and critical-path analysis key on "
+                            "registered kinds — register the kind or fix "
+                            "the name",
+                            module=ref.module, lineno=sub.lineno,
+                            function=ref.qual, span_kind=kind_lit,
                         )
                         if d is not None:
                             out.append(d)

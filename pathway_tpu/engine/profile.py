@@ -208,7 +208,7 @@ class CommitProfile:
 
     __slots__ = (
         "commit", "rank", "duration_s", "input_rows", "output_rows", "neu",
-        "ts", "ops",
+        "ts", "ts_mono", "ops",
     )
 
     def __init__(
@@ -228,7 +228,10 @@ class CommitProfile:
         self.input_rows = input_rows
         self.output_rows = output_rows
         self.neu = neu
+        # dual stamp: wall for cross-rank merge, monotonic for ordering that
+        # survives a wall-clock step mid-run (trace merger + post-mortems)
         self.ts = time.time()
+        self.ts_mono = time.monotonic()
         self.ops = ops
 
     def slowest_op(self) -> Optional[tuple]:
@@ -245,6 +248,7 @@ class CommitProfile:
             "output_rows": self.output_rows,
             "neu": self.neu,
             "ts": self.ts,
+            "ts_mono": self.ts_mono,
             "ops": [
                 {
                     "node": node_id,
@@ -424,7 +428,7 @@ class FlightRecorder:
     def record_event(self, kind: str, **details: Any) -> None:
         if not self.enabled:
             return
-        event = {"ts": time.time(), "kind": kind}
+        event = {"ts": time.time(), "ts_mono": time.monotonic(), "kind": kind}
         event.update(details)
         with self._lock:
             self._events.append(event)
@@ -467,13 +471,22 @@ class FlightRecorder:
             slowest = {
                 "name": op["name"], "kind": op["kind"], "seconds": op["seconds"],
             }
+        trace = None
+        spans_fn = _trace_spans_fn
+        if spans_fn is not None:
+            try:
+                trace = spans_fn()
+            except Exception:
+                trace = None  # observability must never kill the worker
         return {
             "reason": reason,
             "rank": self.rank,
             "pid": os.getpid(),
             "ts": time.time(),
+            "ts_mono": time.monotonic(),
             "profiles": profiles,
             "events": events,
+            "trace": trace,
             "summary": {
                 "last_commit": last["commit"] if last else None,
                 "slowest_operator": slowest,
@@ -497,9 +510,18 @@ class FlightRecorder:
                 f.write(blob)
             os.replace(tmp, path)
             self.dumps += 1
-            return path
         except (OSError, TypeError, ValueError):
             return None
+        flush_fn = _trace_flush_fn
+        if flush_fn is not None:
+            try:
+                # partial-trace guarantee: the jsonl flush rides every dump
+                # path (crash, fence, SIGTERM, chaos kill) so a dead rank's
+                # spans land next to its flight dump
+                flush_fn(os.path.dirname(path), reason)
+            except Exception:
+                pass
+        return path
 
     def reset(self) -> None:
         with self._lock:
@@ -511,6 +533,19 @@ class FlightRecorder:
 
 _recorder: Optional[FlightRecorder] = None
 _recorder_lock = threading.Lock()
+
+# tracing-plane hooks (registered by engine/tracing.py at tracer creation;
+# function-valued module globals keep this module a leaf — no engine imports):
+# _trace_spans_fn() -> recent-span payload embedded in every flight dump;
+# _trace_flush_fn(directory, reason) flushes trace-rank-N.jsonl beside it.
+_trace_spans_fn: Optional[Any] = None
+_trace_flush_fn: Optional[Any] = None
+
+
+def register_trace_hooks(spans_fn: Any, flush_fn: Any) -> None:
+    global _trace_spans_fn, _trace_flush_fn
+    _trace_spans_fn = spans_fn
+    _trace_flush_fn = flush_fn
 
 
 def get_flight_recorder() -> FlightRecorder:

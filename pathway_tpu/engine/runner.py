@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from pathway_tpu.engine import tracing as _tracing
 from pathway_tpu.engine.columnar import Delta, StateTable
 from pathway_tpu.engine.profile import CommitProfile
 from pathway_tpu.engine.profile import autoscale_signals as _autoscale_signals
@@ -67,6 +68,7 @@ class GraphRunner:
         self._profiler: Any = None
         self._recorder: Any = None
         self._profile_ops: "List[tuple] | None" = None
+        self._last_commit_profile: "CommitProfile | None" = None
         # whole-commit fusion (engine/fusion.py): the substep schedule with
         # operator chains collapsed into compiled ChainPrograms; None = stock
         # per-node dispatch (PATHWAY_FUSION=off, nested runners, nothing fuses)
@@ -234,6 +236,11 @@ class GraphRunner:
                 self._profiler = _profile.get_profiler()
             self._recorder = _profile.get_flight_recorder()
             self._recorder.configure(
+                rank=self._rank, default_dir=self._supervise_dir
+            )
+            # the tracing plane shares the recorder's rank/dump-dir config so
+            # trace-rank-N.jsonl lands beside flight-rank-N.json
+            _tracing.get_tracer().configure(
                 rank=self._rank, default_dir=self._supervise_dir
             )
         if self._cluster is not None:
@@ -1070,7 +1077,72 @@ class GraphRunner:
         the phases separate guarantees a delta is never a mix of real updates and
         forgetting updates, so ``_filter_out_results_of_forgetting`` can drop whole neu
         deltas without losing genuine data.
+
+        The commit is the root of the commit-plane trace: its trace id is a
+        pure function of ``(epoch, commit)``, so every rank's commit span is a
+        sibling in ONE trace without anything riding the wire, and barrier /
+        checkpoint spans opened below become its children via the
+        context-local parent. Queries admitted since the previous commit link
+        in (a query racing the boundary links the adjacent commit). Operator
+        child spans are synthesized AFTER the commit closes, and only for
+        sampled/promoted commits — nothing on the operator hot path.
         """
+        tracer = _tracing.get_tracer()
+        if not tracer.enabled or self._materialize_all:
+            return self._step_inner()
+        epoch = (
+            getattr(self._cluster, "epoch", 0) if self._cluster is not None else 0
+        )
+        tracer.set_epoch(epoch)
+        commit = self._commit
+        ctx = _tracing.commit_trace_context(epoch, commit, self._rank)
+        links = tuple(tracer.take_commit_links())
+        with tracer.trace_span(
+            "commit",
+            f"commit {commit}",
+            self_ctx=ctx,
+            links=links,
+            attrs={"commit": commit, "epoch": epoch},
+        ) as span:
+            any_output = self._step_inner()
+        if span is not None and span.sampled:
+            self._trace_commit_ops(tracer, span)
+        return any_output
+
+    def _trace_commit_ops(self, tracer: Any, span: Any) -> None:
+        """Lift the commit profile's per-evaluator rows into child spans of
+        the (sampled or slow-promoted) commit span. Start offsets partition
+        the commit window cumulatively — durations are what the critical-path
+        walk consumes; only the slowest rows survive the cap."""
+        commit_profile = self._last_commit_profile
+        self._last_commit_profile = None
+        if commit_profile is None or not commit_profile.ops:
+            return
+        ops = commit_profile.ops
+        if len(ops) > 48:
+            ops = sorted(ops, key=lambda op: op[3], reverse=True)[:48]
+        parent = span.context()
+        offset = 0.0
+        for node_id, name, kind, seconds, rows, retractions, neu in ops:
+            span_kind = "fused_region" if kind == "fused_chain" else "operator"
+            tracer.record_span(
+                span_kind,
+                name,
+                parent=parent,
+                ts=span.ts + offset,
+                ts_mono=span.ts_mono + offset,
+                duration_s=seconds,
+                attrs={
+                    "node": node_id,
+                    "op_kind": kind,
+                    "rows": rows,
+                    "retractions": retractions,
+                    "neu": neu,
+                },
+            )
+            offset += seconds
+
+    def _step_inner(self) -> bool:
         commit_t0 = time_mod.monotonic()
         if self._inject is None:
             # fresh drain: these deltas belong to THIS commit (the surgical
@@ -1191,13 +1263,19 @@ class GraphRunner:
                     and time_mod.monotonic() - self._last_checkpoint
                     >= self._snapshot_interval_s
                 ):
-                    if self._take_checkpoint():
-                        self._last_checkpoint = time_mod.monotonic()
+                    with _tracing.trace_span(
+                        "checkpoint", f"checkpoint {self._commit}"
+                    ):
+                        if self._take_checkpoint():
+                            self._last_checkpoint = time_mod.monotonic()
             if ckpt_due:
                 # every rank reaches this point for a due checkpoint (the
                 # decision was allgathered), including ranks with no data this
                 # commit — the protocol is a barrier sequence of its own
-                self._coordinated_checkpoint()
+                with _tracing.trace_span(
+                    "checkpoint", f"checkpoint {self._commit}"
+                ):
+                    self._coordinated_checkpoint()
         input_rows = sum(len(d) for d in self._input_deltas.values())
         if self.prober_stats is not None:
             self.prober_stats.record_commit(
@@ -1225,6 +1303,7 @@ class GraphRunner:
             self._profiler.record_commit(commit_profile)
             if self._recorder is not None:
                 self._recorder.record_commit(commit_profile)
+            self._last_commit_profile = commit_profile
             self._profile_ops = None
         if self._monitor is not None:
             self._monitor.update(self._commit, self._step_counts, self.states)
@@ -2542,6 +2621,11 @@ class GraphRunner:
                 svc_mod.stop_all_workers()
             except Exception:
                 pass
+        # final trace flush (no-op when tracing is off or no dir is known);
+        # crash/fence/chaos paths flush via the flight recorder's dump instead
+        trace_path = _tracing.get_tracer().flush(reason="finish")
+        if trace_path is not None and self._recorder is not None:
+            self._recorder.record_event("trace_flush", path=trace_path)
 
     def _lint_gate(self, *, persistence: bool) -> None:
         """Automatic graph lint before the first commit, gated by

@@ -91,6 +91,7 @@ STAGE_NAMESPACES: "tuple[str, ...]" = (
     "persist.",     # checkpoints, journal compaction
     "replica.",     # read-replica fleet: feed, follow, serve/shed, failover
     "rest.",        # REST admission/shed plane
+    "trace.",       # distributed-tracing plane: spans, promotions, flushes
 )
 
 #: registered flight-recorder event kinds (``FlightRecorder.record_event``
@@ -129,6 +130,25 @@ FLIGHT_EVENT_KINDS: "frozenset[str]" = frozenset({
     "replica_bootstrap",
     "replica_failover",
     "replica_refused",
+    "trace_flush",
+})
+
+#: registered distributed-tracing span kinds (``tracing.trace_span`` /
+#: ``start``/``record_span`` literal first args) — same contract as
+#: STAGE_NAMESPACES/FLIGHT_EVENT_KINDS, enforced by PWA205 so the merger and
+#: critical-path tooling keyed on these kinds cannot silently miss a span.
+TRACE_SPAN_KINDS: "frozenset[str]" = frozenset({
+    "barrier",       # exchange barrier wait (carries straggler attribution)
+    "checkpoint",    # coordinated checkpoint write inside a commit
+    "coalesce",      # query-coalescer admission wait
+    "commit",        # one engine commit (deterministic cross-rank trace id)
+    "encode",        # encoder-service tick (links N parent query spans)
+    "exchange",      # mesh delta receive (links the sender's commit span)
+    "fused_region",  # one fused chain executed as a single program
+    "operator",      # one evaluator run (synthesized from CommitProfile ops)
+    "replica_apply", # replica applying a commit frame from the feed
+    "replica_serve", # replica answering a read (links the commit it serves)
+    "rest",          # one REST route invocation (X-Pathway-Trace in/out)
 })
 
 

@@ -1823,6 +1823,171 @@ def replica_follow_model(
 
 
 # ---------------------------------------------------------------------------
+# trace ring / pending-buffer protocol (engine/tracing.py)
+# ---------------------------------------------------------------------------
+
+
+def trace_ring_model(
+    n_writers: int = 2,
+    n_traces: int = 2,
+    *,
+    ring_cap: int = 8,
+    bug: Optional[str] = None,
+) -> Callable[[DeterministicScheduler], Callable[[], None]]:
+    """The tracing plane's span-routing protocol (``engine/tracing.py``):
+    the bounded ring, the pending buffer unsampled spans wait in until
+    their root's slow-promotion verdict, the epoch bump an elastic
+    membership change installs mid-flight, and the crash flush the flight
+    recorder drives from a dying rank.
+
+    Threads: ``n_writers`` span writers each start+finish one span per
+    trace (the SAME trace ids cross writers — one cross-rank trace whose
+    sampling verdict every rank must derive identically); an epoch
+    installer bumps the epoch between any two steps; a crash thread flushes
+    the ring concurrently (the SIGTERM flight-dump path — file lock, then
+    ring lock, the one canonical order).
+
+    Invariants over every interleaving: **span conservation** — every span
+    a writer starts terminates in the ring or the accounted drop list, so
+    an epoch bump never orphans a buffered span; **flush-on-crash never
+    deadlocks** — the crash flush and writer promotion take the file and
+    ring locks in one global order; **sampling is consistent across a
+    trace** — the head decision is a pure function of the trace id, so no
+    trace ends half-kept, half-dropped across ranks; the flush completes
+    exactly once.
+
+    Planted bugs (each must be CAUGHT with a replayable schedule):
+    ``"orphan_on_bump"`` — the epoch installer clears the pending buffer
+    unaccounted, stranding in-flight spans; ``"flush_deadlock"`` — writer
+    promotion grabs the file lock while holding the ring lock (the AB/BA
+    inversion with the crash flush); ``"split_sampling"`` — each writer
+    flips its own per-rank coin instead of hashing the trace id."""
+
+    def model(sched: DeterministicScheduler) -> Callable[[], None]:
+        lock = sched.lock("trace.ring")
+        cv = sched.condition(lock, name="trace.cv")
+        file_lock = sched.lock("trace.file")
+        state: Dict[str, Any] = {
+            "epoch": 0,
+            "ring": [],  # (trace, writer, epoch_at_start) — kept spans
+            "pending": {},  # trace -> [(writer, epoch_at_start)] buffered
+            "dropped": [],  # ("unsampled"|"evicted", trace, writer, epoch)
+            "started": 0,
+            "finished": 0,
+            "flushes": [],  # ring snapshots the crash flush captured
+        }
+
+        def _route_locked(trace: int, w: int, sampled: bool) -> None:
+            # promotion verdict: pop THIS writer's buffered entries for the
+            # trace and route them — ring (evicting over cap, accounted) or
+            # the drop list; nothing may vanish silently
+            bucket = state["pending"].get(trace, [])
+            mine = [e for e in bucket if e[0] == w]
+            state["pending"][trace] = [e for e in bucket if e[0] != w]
+            for writer, epoch_at in mine:
+                if sampled:
+                    state["ring"].append((trace, writer, epoch_at))
+                    if len(state["ring"]) > ring_cap:
+                        state["dropped"].append(
+                            ("evicted",) + state["ring"].pop(0)
+                        )
+                else:
+                    state["dropped"].append(
+                        ("unsampled", trace, writer, epoch_at)
+                    )
+            state["finished"] += len(mine)
+            cv.notify_all()
+
+        def writer_body(w: int) -> None:
+            for trace in range(n_traces):
+                with cv:
+                    epoch_at_start = state["epoch"]
+                    state["started"] += 1
+                    state["pending"].setdefault(trace, []).append(
+                        (w, epoch_at_start)
+                    )
+                    cv.notify_all()
+                sched.yield_point(f"w{w}.t{trace}.work")
+                if bug == "split_sampling":
+                    # each rank flips its own coin — the exact divergence
+                    # the hash-of-trace-id decision function exists to bar
+                    sampled = (trace + w) % 2 == 0
+                else:
+                    # pure function of the trace id: every rank agrees
+                    sampled = trace % 2 == 0
+                if bug == "flush_deadlock":
+                    with cv:
+                        sched.yield_point(f"w{w}.t{trace}.inverted")
+                        # ring lock held, file lock wanted: AB/BA against
+                        # the crash flush's file-then-ring order
+                        with file_lock:
+                            _route_locked(trace, w, sampled)
+                else:
+                    with cv:
+                        _route_locked(trace, w, sampled)
+
+        def installer_body() -> None:
+            sched.yield_point("bump.arrive")
+            with cv:
+                state["epoch"] += 1
+                if bug == "orphan_on_bump":
+                    # the regression: "stale" buffers swept on bump — any
+                    # span between its start and its root's verdict vanishes
+                    state["pending"].clear()
+                cv.notify_all()
+
+        def crash_body() -> None:
+            sched.yield_point("crash.arrive")
+            with file_lock:
+                sched.yield_point("crash.flush")
+                with cv:
+                    state["flushes"].append(list(state["ring"]))
+                    cv.notify_all()
+
+        for w in range(n_writers):
+            sched.spawn(writer_body, w, name=f"writer{w}")
+        sched.spawn(installer_body, name="installer")
+        sched.spawn(crash_body, name="crash")
+
+        def check() -> None:
+            expected = n_writers * n_traces
+            assert state["started"] == expected
+            total = len(state["ring"]) + len(state["dropped"])
+            assert total == state["started"] and (
+                state["finished"] == state["started"]
+            ), (
+                f"span orphaned: started {state['started']}, ring+dropped "
+                f"{total}, finished {state['finished']} — an epoch bump "
+                "stranded a buffered span"
+            )
+            leftovers = [
+                entry
+                for bucket in state["pending"].values()
+                for entry in bucket
+            ]
+            assert not leftovers, f"spans left buffered: {leftovers}"
+            ringed = {trace for (trace, _, _) in state["ring"]}
+            for drop in state["dropped"]:
+                if drop[0] == "evicted":
+                    ringed.add(drop[1])
+            unsampled = {
+                drop[1] for drop in state["dropped"] if drop[0] == "unsampled"
+            }
+            split = sorted(ringed & unsampled)
+            assert not split, (
+                f"sampling split across ranks for trace(s) {split}: one rank "
+                "kept the trace, another dropped it"
+            )
+            assert len(state["flushes"]) == 1, (
+                f"crash flush ran {len(state['flushes'])} time(s), not once"
+            )
+
+        return check
+
+    return model
+
+
+# ---------------------------------------------------------------------------
 # planted lock-order inversion (the PWA101 <-> model-check bridge)
 # ---------------------------------------------------------------------------
 

@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
+from pathway_tpu.engine import tracing
 from pathway_tpu.engine.datasource import StreamingDataSource
 from pathway_tpu.engine.profile import histogram as _histogram
 from pathway_tpu.internals import dtype as dt
@@ -207,8 +208,22 @@ class PathwayWebserver:
                 async def dispatch(request: web.Request) -> web.Response:
                     handler = self._routes.get((request.method, request.path))
                     if handler is None:
-                        return web.Response(status=404, text="no such endpoint")
-                    return await handler(request)
+                        response: web.Response = web.Response(
+                            status=404, text="no such endpoint"
+                        )
+                    else:
+                        response = await handler(request)
+                    if tracing.TRACE_HEADER not in response.headers:
+                        # the trace context echoes on EVERY route — including
+                        # 404s and routes that did not open a span — so
+                        # clients can always correlate a response
+                        ctx = tracing.parse_trace_header(
+                            request.headers.get(tracing.TRACE_HEADER)
+                        ) or tracing.new_trace_context()
+                        response.headers[tracing.TRACE_HEADER] = (
+                            tracing.format_trace_header(ctx)
+                        )
+                    return response
 
                 app.router.add_route("*", "/{tail:.*}", dispatch)
                 runner = web.AppRunner(app)
@@ -280,6 +295,33 @@ class RestServerSubject:
 
     def run(self, source: StreamingDataSource) -> None:
         async def handler(request: Any) -> Any:
+            # the route's "rest" span: parented by the client's X-Pathway-Trace
+            # context (or a fresh root), covering admission -> engine commit ->
+            # future resolution, and echoed back with OUR span id so the
+            # client can look the request up in the merged trace
+            parent_ctx = tracing.parse_trace_header(
+                request.headers.get(tracing.TRACE_HEADER)
+            )
+            with tracing.trace_span(
+                "rest",
+                f"{request.method} {self.route}",
+                ctx=parent_ctx,
+                attrs={"route": self.route},
+            ) as span:
+                response = await _handle(request, span)
+                if span is not None:
+                    span.attrs["status"] = response.status
+                echo_ctx = (
+                    span.context()
+                    if span is not None
+                    else (parent_ctx or tracing.new_trace_context())
+                )
+                response.headers[tracing.TRACE_HEADER] = (
+                    tracing.format_trace_header(echo_ctx)
+                )
+            return response
+
+        async def _handle(request: Any, span: Any) -> Any:
             import aiohttp.web as web
 
             if request.method in ("POST", "PUT", "PATCH"):
@@ -393,6 +435,20 @@ class RestServerSubject:
                 if col.dtype.strip_optional() == dt.JSON and v is not None and not isinstance(v, Json):
                     v = Json(v)
                 row[name] = v
+            if span is not None:
+                # causal handoff into the engine: the NEXT commit links this
+                # query (take_commit_links in GraphRunner.step), and the
+                # encoder tick that batches the query text links it too
+                # (take_query_links keyed by text) — a coalesced batch ends
+                # up linking all N parent query spans
+                tracer = tracing.get_tracer()
+                span_ctx = span.context()
+                tracer.register_commit_link(span_ctx)
+                for field in ("query", "text", "prompt"):
+                    text = row.get(field)
+                    if isinstance(text, str) and text:
+                        tracer.register_query_link(text, span_ctx)
+                        break
             t0 = time.perf_counter()
             source.push(row, key=key, diff=1)
             try:

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from pathway_tpu.engine import telemetry
+from pathway_tpu.engine import tracing
 
 
 class EmbedCache:
@@ -258,6 +259,15 @@ class QueryCoalescer:
         mid-commit would tear down the run instead of shedding one request."""
         if not texts:
             return []
+        # the coalescer admission wait is a traced hop: a child of whatever
+        # span the calling thread holds (the commit span on the engine serving
+        # path), covering admission + the batching/encode wait
+        with tracing.trace_span(
+            "coalesce", f"coalesce {len(texts)}", attrs={"rows": len(texts)}
+        ):
+            return self._embed_traced(texts, enforce_cap=enforce_cap)
+
+    def _embed_traced(self, texts: List[str], *, enforce_cap: bool = True) -> List[Any]:
         if self._service is not None:
             return self._embed_via_service(list(texts), enforce_cap)
         req = _Request(list(texts))

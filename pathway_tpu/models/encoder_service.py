@@ -72,6 +72,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from pathway_tpu.engine import telemetry
+from pathway_tpu.engine import tracing as _tracing
 
 
 def _env_float(name: str, default: float) -> float:
@@ -598,6 +599,23 @@ class EncoderService:
                 if j == len(unique):
                     unique.append(t)
                 slot_of.append(j)
+            # a coalesced batch links its N parent query spans: drain the
+            # contexts REST handlers registered under this tick's texts. The
+            # tick span samples whenever ANY linked query's trace is sampled
+            # (the batch is shared work — every sampled parent needs it)
+            tracer = _tracing.get_tracer()
+            trace_links = (
+                tuple(tracer.take_query_links(unique)) if tracer.enabled else ()
+            )
+            enc_span = None
+            if trace_links:
+                enc_span = tracer.start(
+                    "encode",
+                    f"encode tick {len(unique)}",
+                    links=trace_links,
+                )
+                if enc_span is not None and any(l.sampled for l in trace_links):
+                    enc_span.sampled = True
             try:
                 t_enc = time.monotonic()
                 with telemetry.stage_timer("embed.svc.encode"):
@@ -609,7 +627,16 @@ class EncoderService:
                     else enc_s
                 )
                 rows = [out[j] for j in slot_of]
+                if enc_span is not None:
+                    enc_span.attrs.update(
+                        {"rows": n_rows, "unique": len(unique),
+                         "dispatches": dispatches}
+                    )
+                    tracer.finish(enc_span)
             except BaseException as exc:  # propagate to every waiter in the tick
+                if enc_span is not None:
+                    enc_span.attrs["error"] = type(exc).__name__
+                    tracer.finish(enc_span)
                 self._release_inflight(n_rows)
                 for sub in batch:
                     sub.error = exc

@@ -32,6 +32,12 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from pathway_tpu.engine.profile import get_flight_recorder as _flight_recorder
+from pathway_tpu.engine.tracing import (
+    current_context as _trace_current,
+    format_trace_header as _format_trace_header,
+    get_tracer as _get_tracer,
+    parse_trace_header as _parse_trace_header,
+)
 from pathway_tpu.engine.telemetry import (
     stage_add as _stage_add,
     stage_add_many as _stage_add_many,
@@ -116,6 +122,10 @@ class ClusterExchange:
         self._closed = False
         self._dead: Dict[int, str] = {}  # peer -> reason its link died
         self._last_heard: Dict[int, float] = {}
+        # EWMA of peer_wall - local_wall per peer, estimated from the wall
+        # stamp every heartbeat beacon carries (the trace merger aligns
+        # per-rank span files with these; see clock_offsets())
+        self._clock_offsets: Dict[int, float] = {}
         self._listener: Optional[socket.socket] = None
         self._stop = threading.Event()
         self.epoch = max(0, int(_env_float("PATHWAY_CLUSTER_EPOCH", 0)))
@@ -548,6 +558,10 @@ class ClusterExchange:
                 tag_len, payload_len, frame_epoch = self._HDR.unpack(hdr)
                 tag = self._recv_exact(conn, tag_len)
                 payload = self._recv_exact(conn, payload_len) if payload_len else b""
+                if tag == HEARTBEAT_TAG and payload:
+                    # outside _cv: the tracer push takes its own lock and must
+                    # not nest under the mesh condition
+                    self._note_peer_clock(peer, payload)
                 if tag != HEARTBEAT_TAG:
                     _stage_add_many({
                         f"exchange.peer{peer}.bytes_received": float(
@@ -766,9 +780,35 @@ class ClusterExchange:
             if stale:
                 return
             try:
-                self._send(peer, HEARTBEAT_TAG, b"")
+                # beacons carry the sender's wall clock: receivers estimate
+                # per-peer clock offsets for the trace merger's alignment
+                self._send(peer, HEARTBEAT_TAG, struct.pack("<d", time.time()))
             except (PeerShutdownError, OSError):
                 return  # _send already recorded the death
+
+    def _note_peer_clock(self, peer: int, payload: bytes) -> None:
+        """A heartbeat beacon carried the sender's wall clock: EWMA the
+        ``peer_wall - local_wall`` offset (biased by one-way latency — good to
+        ~ms on a LAN, plenty to causally order cross-rank spans) and publish
+        the table to the tracer so every flush's ``_meta`` carries it."""
+        try:
+            (sender_wall,) = struct.unpack("<d", payload)
+        except struct.error:
+            return  # malformed beacon: liveness already counted, skip the clock
+        sample = sender_wall - time.time()
+        with self._cv:
+            prev = self._clock_offsets.get(peer)
+            self._clock_offsets[peer] = (
+                sample if prev is None else prev + 0.2 * (sample - prev)
+            )
+            offsets = dict(self._clock_offsets)
+        _get_tracer().set_clock_offsets(offsets)
+
+    def clock_offsets(self) -> Dict[int, float]:
+        """Heartbeat-estimated ``peer_wall - local_wall`` seconds per peer
+        (the trace merger aligns per-rank span files with these)."""
+        with self._cv:
+            return dict(self._clock_offsets)
 
     def heartbeat_ages(self) -> Dict[int, float]:
         """Seconds since each peer was last heard from (any frame). The shared
@@ -1111,9 +1151,10 @@ class ClusterExchange:
             if wait > slowest_wait:
                 slowest_wait = wait
                 slowest_peer = peer
+        barrier_wait = time.perf_counter() - t0
         updates = {
             "exchange.barriers": 1.0,
-            "exchange.barrier_wait_s": time.perf_counter() - t0,
+            "exchange.barrier_wait_s": barrier_wait,
         }
         if slowest_peer >= 0 and slowest_wait > 0.001:
             # only meaningful blocking attributes a straggler: an inboxed
@@ -1121,6 +1162,23 @@ class ClusterExchange:
             updates[f"exchange.straggler.peer{slowest_peer}"] = 1.0
             updates[f"exchange.peer{slowest_peer}.straggler_wait_s"] = slowest_wait
         _stage_add_many(updates)
+        tracer = _get_tracer()
+        if tracer.enabled and _trace_current() is not None:
+            # a barrier inside a traced scope (the commit span's context-local
+            # parent) becomes a child span carrying the SAME straggler
+            # attribution the stage counters got — "barrier held 41 ms by
+            # rank 3" in the merged critical path
+            span = tracer.start(
+                "barrier", f"barrier {tag.decode('utf-8', 'replace')}"
+            )
+            if span is not None:
+                span.ts -= barrier_wait  # stamp the barrier's START
+                span.ts_mono -= barrier_wait
+                span.duration_s = max(barrier_wait, 1e-9)
+                if slowest_peer >= 0 and slowest_wait > 0.001:
+                    span.attrs["straggler_rank"] = slowest_peer
+                    span.attrs["straggler_wait_s"] = slowest_wait
+                tracer.finish(span)
         # cleared on SUCCESS only: when a recv raises (peer death, barrier
         # timeout) the mark must survive the unwind — the fence/crash dump's
         # summary names this tag as the pending barrier, and the next
@@ -1187,6 +1245,11 @@ class ClusterExchange:
         from pathway_tpu.internals.keys import shard_of
 
         owners = shard_of(route_keys, self.n)
+        # the sender's trace context rides each frame (5th tuple slot,
+        # length-tolerant on receive): receivers link the sender's span into
+        # their own commit trace, making the routed delta a causal edge
+        ctx = _trace_current()
+        rider = _format_trace_header(ctx) if ctx is not None else None
         parts: Dict[int, bytes] = {}
         for peer in range(self.n):
             if peer == self.me:
@@ -1195,7 +1258,7 @@ class ClusterExchange:
             if len(rows):
                 sub = delta.select(rows)
                 parts[peer] = pickle.dumps(
-                    (sub.keys, sub.diffs, sub.columns, sub.neu),
+                    (sub.keys, sub.diffs, sub.columns, sub.neu, rider),
                     protocol=pickle.HIGHEST_PROTOCOL,
                 )
             else:
@@ -1203,11 +1266,27 @@ class ClusterExchange:
         received = self.exchange_parts(tag, parts)
         mine = delta.select(np.nonzero(owners == self.me)[0])
         merged = [mine]
+        link_ctxs = []
         for peer in sorted(received):
             payload = received[peer]
             if payload:
-                keys, diffs, columns, neu = pickle.loads(payload)
+                unpacked = pickle.loads(payload)
+                keys, diffs, columns, neu = unpacked[:4]
+                if len(unpacked) > 4 and unpacked[4]:
+                    peer_ctx = _parse_trace_header(unpacked[4])
+                    if peer_ctx is not None:
+                        link_ctxs.append(peer_ctx)
                 merged.append(Delta(keys, diffs, columns, neu=neu))
+        tracer = _get_tracer()
+        if link_ctxs and tracer.enabled and ctx is not None:
+            span = tracer.start(
+                "exchange",
+                f"exchange {tag.decode('utf-8', 'replace')}",
+                links=tuple(link_ctxs),
+            )
+            if span is not None:
+                span.duration_s = 1e-9  # a causal edge, not a timed wait
+                tracer.finish(span)
         if len(merged) == 1:
             return mine
         return Delta.concat(merged, list(delta.columns))

@@ -212,6 +212,9 @@ class ReplicaFollower:
         self._fresh_as_of: "Optional[float]" = None
         self.served = 0
         self.shed = 0
+        # formatted trace context of the last applied frame's originating
+        # commit — replica_serve spans link back through this rider
+        self._trace_rider: "Optional[str]" = None
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -279,6 +282,7 @@ class ReplicaFollower:
         applied = 0
         for commit, path in self.feed.frames_after(applied_floor):
             payload = self.feed.read_frame(path)
+            apply_t0 = time.perf_counter()
             with self._lock:
                 if payload["commit"] <= self.applied_commit:
                     # double-apply guard: a frame re-listed across polls (or
@@ -288,9 +292,11 @@ class ReplicaFollower:
                     continue
                 self._apply_locked(payload)
                 self.applied_commit = int(payload["commit"])
+                self._trace_rider = payload.get("trace") or self._trace_rider
             applied += 1
             _stage_add("replica.frames_applied")
             _stage_add("replica.rows_applied", len(payload.get("keys") or ()))
+            self._trace_apply(payload, time.perf_counter() - apply_t0)
             if chaos is not None:
                 chaos.maybe_replica_kill(self.replica_id, int(payload["commit"]))
         with self._lock:
@@ -318,6 +324,49 @@ class ReplicaFollower:
         if filter_data:
             # AFTER the upsert — add_many pops filter entries for re-added keys
             self.index.filter_data.update(filter_data)  # noqa: PWA103 (caller holds self._lock)
+
+    def _trace_apply(self, payload: Dict[str, Any], elapsed: float) -> None:
+        """Emit a ``replica_apply`` span as a child of the originating
+        commit's trace (the rider the primary attached to the feed frame).
+        Backdated to cover the apply — spans never sit on the hot path."""
+        rider = payload.get("trace")
+        if not rider:
+            return
+        try:
+            from pathway_tpu.engine.tracing import (
+                get_tracer,
+                parse_trace_header,
+            )
+
+            tracer = get_tracer()
+            if not tracer.enabled:
+                return
+            parent = parse_trace_header(str(rider))
+            if parent is None:
+                return
+            span = tracer.start(
+                "replica_apply",
+                f"apply commit {int(payload['commit'])}",
+                ctx=parent,
+                attrs={
+                    "replica": self.replica_id,
+                    "commit": int(payload["commit"]),
+                    "rows": len(payload.get("keys") or ()),
+                },
+            )
+            if span is not None:
+                span.ts -= elapsed
+                span.ts_mono -= elapsed
+                span.duration_s = max(elapsed, 1e-9)
+                tracer.finish(span)
+        except Exception:
+            pass
+
+    def applied_trace_rider(self) -> "Optional[str]":
+        """Formatted trace context of the last applied feed frame's
+        originating commit (None before any traced frame applies)."""
+        with self._lock:
+            return self._trace_rider
 
     # -- serving ---------------------------------------------------------------
 
@@ -481,6 +530,60 @@ class ReplicaServer:
                     return
                 from pathway_tpu.engine.brownout import retry_after_int
 
+                # replica_serve span: child of the query's incoming trace
+                # (X-Pathway-Trace), linked back to the originating commit's
+                # trace via the rider the last applied feed frame carried
+                serve_headers: Dict[str, str] = {}
+                serve_span = None
+                serve_t0 = time.perf_counter()
+                try:
+                    from pathway_tpu.engine import tracing as _tracing
+
+                    tracer = _tracing.get_tracer()
+                    if tracer.enabled:
+                        parent = _tracing.parse_trace_header(
+                            self.headers.get(_tracing.TRACE_HEADER) or ""
+                        )
+                        links = []
+                        rider = follower_ref.applied_trace_rider()
+                        if rider:
+                            link_ctx = _tracing.parse_trace_header(rider)
+                            if link_ctx is not None:
+                                links.append(link_ctx)
+                        serve_span = tracer.start(
+                            "replica_serve",
+                            "POST /v1/retrieve",
+                            ctx=parent,
+                            links=tuple(links),
+                            attrs={"replica": follower_ref.replica_id},
+                        )
+                        if serve_span is not None:
+                            serve_headers[_tracing.TRACE_HEADER] = (
+                                _tracing.format_trace_header(
+                                    serve_span.context()
+                                )
+                            )
+                except Exception:
+                    serve_span = None
+
+                def _finish_span(
+                    status: int, commit: "Optional[int]" = None
+                ) -> None:
+                    if serve_span is None:
+                        return
+                    try:
+                        from pathway_tpu.engine.tracing import get_tracer
+
+                        serve_span.attrs["status"] = status
+                        if commit is not None:
+                            serve_span.attrs["commit"] = commit
+                        serve_span.duration_s = max(
+                            time.perf_counter() - serve_t0, 1e-9
+                        )
+                        get_tracer().finish(serve_span)
+                    except Exception:
+                        pass
+
                 try:
                     commit, results = follower_ref.search_many(
                         vectors,
@@ -489,24 +592,30 @@ class ReplicaServer:
                         filter_exprs=filters,
                     )
                 except ReplicaStaleError as exc:
+                    _finish_span(429)
+                    serve_headers.update(
+                        {"Retry-After": retry_after_int(exc.retry_after_s)}
+                    )
                     self._send_json(
                         429,
                         {
                             "error": "stale",
                             "staleness_s": round(exc.staleness_s, 4),
                         },
-                        headers={
-                            "Retry-After": retry_after_int(exc.retry_after_s)
-                        },
+                        headers=serve_headers,
                     )
                     return
                 except ReplicaNotServingError as exc:
                     # out of rotation — the router fails over; a 503 here is
                     # router-facing, never client-facing
+                    _finish_span(503)
                     self._send_json(
-                        503, {"error": "not_serving", "state": exc.state}
+                        503,
+                        {"error": "not_serving", "state": exc.state},
+                        headers=serve_headers,
                     )
                     return
+                _finish_span(200, commit)
                 self._send_json(
                     200,
                     {
@@ -516,6 +625,7 @@ class ReplicaServer:
                             for row in results
                         ],
                     },
+                    headers=serve_headers,
                 )
 
             def log_message(self, *args: Any) -> None:

@@ -1053,6 +1053,22 @@ class Supervisor:
             scaler = fleet.autoscaler_line()
             if scaler is not None:
                 self._log(f"  post-mortem {scaler}")
+        # where the dying run actually spent its time: merge whatever trace
+        # files + flight-dump trace partials the ranks left behind and name
+        # the critical-path span (engine/tracing.py one-liner)
+        trace_dir = (
+            os.environ.get("PATHWAY_FLIGHT_RECORDER_DIR")
+            or self._supervise_dir
+        )
+        if trace_dir is not None:
+            try:
+                from pathway_tpu.engine.tracing import critical_path_line
+
+                cp = critical_path_line(trace_dir)
+            except Exception:
+                cp = None
+            if cp is not None:
+                self._log(f"  post-mortem critical path: {cp}")
         self._log(f"not restarting: {why_final}")
 
     # -- entry point -----------------------------------------------------------

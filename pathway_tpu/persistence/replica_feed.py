@@ -209,6 +209,21 @@ class ReplicaFeed:
         commit_id = int(commit_id)
         frames_dir = os.path.join(self.root, _FRAMES_DIR)
         os.makedirs(frames_dir, exist_ok=True)
+        # the primary's commit span context rides the frame so a replica can
+        # link its apply/serve spans back to the originating commit's trace
+        # (frames carry no epoch, so the replica cannot re-derive the id)
+        trace_rider: "Optional[str]" = None
+        try:
+            from pathway_tpu.engine.tracing import (
+                current_context,
+                format_trace_header,
+            )
+
+            ctx = current_context()
+            if ctx is not None:
+                trace_rider = format_trace_header(ctx)
+        except Exception:
+            trace_rider = None
         payload = pickle.dumps(
             {
                 "commit": commit_id,
@@ -218,6 +233,7 @@ class ReplicaFeed:
                 else np.zeros((0, 0), dtype=np.float32),
                 "removals": list(removals or []),
                 "filter_data": dict(filter_data or {}),
+                "trace": trace_rider,
             },
             protocol=pickle.HIGHEST_PROTOCOL,
         )

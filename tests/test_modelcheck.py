@@ -935,6 +935,99 @@ def test_replica_torn_bootstrap_serve_bug_caught_and_replayable():
 
 
 # ---------------------------------------------------------------------------
+# trace ring: crash flush, epoch bump, cross-rank sampling consistency
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.trace
+def test_trace_ring_invariants_hold_exhaustive():
+    t0 = time.monotonic()
+    result = explore(
+        pm.trace_ring_model(), max_schedules=N_SCHEDULES, name="trace"
+    )
+    _BATTERY_SECONDS["trace"] = time.monotonic() - t0
+    assert result.ok, (
+        f"trace-ring invariant failed on schedule "
+        f"{result.failing_schedule}: {result.failure}"
+    )
+    assert result.distinct_schedules >= N_SCHEDULES
+
+
+@pytest.mark.trace
+def test_trace_ring_invariants_hold_seeded():
+    result = sweep_seeds(
+        pm.trace_ring_model(), n_seeds=100, base_seed=43,
+        name="trace-seeded",
+    )
+    assert result.ok, f"seed {result.failing_seed}: {result.failure}"
+    assert result.distinct_schedules == 100
+
+
+@pytest.mark.trace
+def test_trace_orphan_on_bump_bug_caught_with_seed():
+    # the orphan needs the bump to land inside a writer's start->verdict
+    # window — deep in the tree, where seeded walks reach faster than
+    # root-systematic DFS; the 1x1 instance keeps the walk dense
+    result = sweep_seeds(
+        pm.trace_ring_model(1, 1, bug="orphan_on_bump"),
+        n_seeds=300,
+        base_seed=41,
+        name="trace-orphan",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the pending-swept-on-bump regression went undetected"
+    )
+    assert "orphaned" in str(result.failure)
+    assert result.failing_seed is not None
+    # the SEED alone reproduces the orphaned span (deterministic walk)
+    with pytest.raises(InvariantViolation, match="orphaned"):
+        run_once(
+            pm.trace_ring_model(1, 1, bug="orphan_on_bump"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.trace
+def test_trace_flush_deadlock_bug_caught_with_seed():
+    # writer promotion holding the ring lock while wanting the file lock is
+    # the AB/BA inversion with the crash flush's file-then-ring order — the
+    # bug class the tracer's single re-entrant lock exists to prevent
+    result = sweep_seeds(
+        pm.trace_ring_model(1, 1, bug="flush_deadlock"),
+        n_seeds=300,
+        base_seed=47,
+        name="trace-deadlock",
+    )
+    assert isinstance(result.failure, DeadlockError), (
+        "the flush-on-crash lock inversion went undetected"
+    )
+    assert result.failing_seed is not None
+    with pytest.raises(DeadlockError):
+        run_once(
+            pm.trace_ring_model(1, 1, bug="flush_deadlock"),
+            seed=result.failing_seed,
+        )
+
+
+@pytest.mark.trace
+def test_trace_split_sampling_bug_caught_and_replayable():
+    result = explore(
+        pm.trace_ring_model(bug="split_sampling"),
+        max_schedules=400,
+        name="trace-split",
+    )
+    assert isinstance(result.failure, InvariantViolation), (
+        "the per-rank-coin sampling divergence went undetected"
+    )
+    assert "sampling split" in str(result.failure)
+    with pytest.raises(InvariantViolation, match="sampling split"):
+        run_once(
+            pm.trace_ring_model(bug="split_sampling"),
+            choices=result.failing_schedule,
+        )
+
+
+# ---------------------------------------------------------------------------
 # PWA101 <-> model check: the same inversion caught both ways
 # ---------------------------------------------------------------------------
 
@@ -993,7 +1086,7 @@ def test_model_check_battery_within_budget():
     # documented <60 s budget must hold even under full-suite load
     if set(_BATTERY_SECONDS) != {
         "fence", "ckpt", "encsvc", "membership", "reshard", "autoscaler",
-        "tiered", "quant", "replica",
+        "tiered", "quant", "replica", "trace",
     }:
         pytest.skip("acceptance batteries did not run in this session (-k selection)")
     total = sum(_BATTERY_SECONDS.values())

@@ -368,6 +368,87 @@ def test_server_503_before_bootstrap(tmp_path):
         server.close()
 
 
+@pytest.mark.trace
+def test_replica_serve_links_originating_commit_trace(tmp_path, monkeypatch):
+    """ISSUE 20 acceptance, replica leg: the primary's commit-span context
+    rides the feed frame, the replica's ``replica_apply`` span joins the
+    commit's trace as a CHILD, and a served read parents to the CALLER's
+    header while LINKING the applied commit span — `cli trace` can walk from
+    a client query back to the ingest commit whose data answered it."""
+    from pathway_tpu.engine.tracing import (
+        TRACE_HEADER,
+        commit_trace_context,
+        format_trace_header,
+        get_tracer,
+        new_trace_context,
+        parse_trace_header,
+        reset_tracing,
+    )
+
+    monkeypatch.setenv("PATHWAY_TRACE", "on")
+    monkeypatch.setenv("PATHWAY_TRACE_SAMPLE", "1.0")
+    reset_tracing()
+    tracer = get_tracer()
+    try:
+        primary = _primary(6)
+        feed = ReplicaFeed(str(tmp_path / "feed"))
+        feed.export_bootstrap(1, primary)
+        extra = _vectors(2, seed=5)
+        primary.add_many(["n0", "n1"], extra)
+        commit_ctx = commit_trace_context(0, 2, rank=0)
+        with tracer.trace_span("commit", "commit 2", self_ctx=commit_ctx):
+            feed.record_commit(2, ["n0", "n1"], extra)
+
+        follower = ReplicaFollower(feed, default_index_factory)
+        assert follower.bootstrap() == 1
+        assert follower.poll_frames() == 1
+        spans = tracer.recent_spans(limit=256)
+        apply_span = next(s for s in spans if s["kind"] == "replica_apply")
+        # the rider made the apply a CHILD of the primary's commit span
+        assert apply_span["trace_id"] == commit_ctx.trace_id
+        assert apply_span["parent_id"] == commit_ctx.span_id
+
+        server = ReplicaServer(follower)
+        try:
+            caller = new_trace_context(sampled=True)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/retrieve",
+                data=json.dumps(
+                    {"vectors": [[0.0] * DIM], "k": 2}
+                ).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    TRACE_HEADER: format_trace_header(caller),
+                },
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                echoed = parse_trace_header(resp.headers.get(TRACE_HEADER))
+            # response echoes the caller's trace with the serve span's id
+            assert echoed is not None
+            assert echoed.trace_id == caller.trace_id
+            assert echoed.span_id != caller.span_id
+            serve = next(
+                s for s in tracer.recent_spans(limit=256)
+                if s["kind"] == "replica_serve"
+            )
+            assert serve["trace_id"] == caller.trace_id
+            assert serve["parent_id"] == caller.span_id
+            assert serve["attrs"]["status"] == 200
+            assert serve["attrs"]["commit"] == 2
+            # ... and LINKS the applied commit span: query -> ingest edge
+            linked = {link["span_id"] for link in serve["links"]}
+            assert commit_ctx.span_id in linked, serve["links"]
+        finally:
+            server.close()
+    finally:
+        # env is still monkeypatched "on" here, so a bare reset would leave
+        # the process-wide tracer live for the rest of the suite
+        reset_tracing()
+        get_tracer().enabled = False
+
+
 # -- the router: kill-invisible failover ---------------------------------------
 
 

@@ -444,6 +444,29 @@ class Ev:
     assert len(found) == 1 and found[0].details["event"] == "surprise_event"
 
 
+def test_pwa205_unknown_trace_span_kind_flagged():
+    # span kinds are a closed set (telemetry.TRACE_SPAN_KINDS): the trace
+    # merger and critical-path analysis key on them, so an off-registry
+    # literal in trace_span()/start()/record_span() is flagged; variable
+    # kinds and registered literals stay quiet
+    src = '''
+from pathway_tpu.engine.tracing import get_tracer, trace_span
+
+class Sp:
+    def go(self, kind):
+        with trace_span("rest", "GET /v1/retrieve"):
+            pass
+        with get_tracer().trace_span("made_up_kind", "oops"):
+            pass
+        span = get_tracer().start("barrier", "b")
+        with trace_span(kind):
+            pass
+'''
+    found = analyze_resource_source(src).by_code("PWA205")
+    assert len(found) == 1, [d.message for d in found]
+    assert found[0].details["span_kind"] == "made_up_kind"
+
+
 def test_pwa205_registry_has_no_ghost_namespaces():
     # the registry itself can drift: every registered namespace must still
     # have at least one live mention in the analyzed tree, or the registry
